@@ -1,0 +1,39 @@
+"""ray_tpu — a TPU-native distributed ML framework.
+
+Capability surface of Ray (tasks / actors / objects, Data, Train, Tune, Serve,
+RL), re-designed TPU-first: the control plane is a lightweight native runtime
+(controller + per-host supervisor + shared-memory object store), and the tensor
+plane is JAX/XLA — device arrays move over ICI via XLA collectives under
+``jax.sharding.Mesh``, never through the object store.
+"""
+
+__version__ = "0.1.0"
+
+from ray_tpu._private.api import (  # noqa: F401
+    ObjectRef,
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    get_runtime_context,
+    init,
+    is_initialized,
+    kill,
+    method,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from ray_tpu._private.exceptions import (  # noqa: F401
+    ActorDiedError,
+    ActorUnavailableError,
+    GetTimeoutError,
+    ObjectLostError,
+    RayTpuError,
+    TaskCancelledError,
+    TaskError,
+    WorkerCrashedError,
+)

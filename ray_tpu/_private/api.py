@@ -1,0 +1,589 @@
+"""Public user API: init / remote / get / put / wait / actors.
+
+Analog of the reference's Ray Core Python surface
+(`python/ray/_private/worker.py:1214,2537,2655,2720,3113`,
+`python/ray/remote_function.py:266`, `python/ray/actor.py:854,1364`).
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import inspect
+import logging
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from ray_tpu._private import serialization
+from ray_tpu._private.config import Config
+from ray_tpu._private.core_worker import CoreWorker
+from ray_tpu._private.ids import ActorID, JobID, ObjectID
+from ray_tpu._private.task_spec import (
+    NodeAffinityStrategy,
+    PlacementGroupStrategy,
+    SchedulingStrategy,
+    SpreadStrategy,
+)
+
+logger = logging.getLogger(__name__)
+
+_global_lock = threading.RLock()
+_core: Optional[CoreWorker] = None
+_node_handle = None  # local cluster bootstrap (driver-started head)
+_namespace = "default"
+
+
+# --------------------------------------------------------------------- refs
+
+
+class ObjectRef:
+    """A future for a task return or put object (≈ ray.ObjectRef)."""
+
+    __slots__ = ("_object_id", "_owner_addr", "_skip_rc", "__weakref__")
+
+    def __init__(
+        self,
+        object_id: ObjectID,
+        owner_addr: Tuple[str, int],
+        skip_ref_counting: bool = False,
+    ):
+        self._object_id = object_id
+        self._owner_addr = tuple(owner_addr)
+        self._skip_rc = skip_ref_counting
+        if not skip_ref_counting and _core is not None:
+            _core.add_local_ref(object_id, self._owner_addr)
+
+    def hex(self) -> str:
+        return self._object_id.hex()
+
+    def __repr__(self) -> str:
+        return f"ObjectRef({self._object_id.hex()[:16]})"
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ObjectRef) and other._object_id == self._object_id
+
+    def __hash__(self) -> int:
+        return hash(self._object_id)
+
+    def __del__(self):
+        if not self._skip_rc and _core is not None:
+            try:
+                _core.remove_local_ref(self._object_id, self._owner_addr)
+            except Exception:
+                pass
+
+    def __reduce__(self):
+        return (_deserialize_ref, (self._object_id.binary(), self._owner_addr))
+
+    def future(self):
+        """concurrent.futures.Future resolving to the value."""
+        import concurrent.futures
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def run():
+            try:
+                fut.set_result(get(self))
+            except Exception as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+
+def _deserialize_ref(raw: bytes, owner) -> ObjectRef:
+    ref = ObjectRef(ObjectID(raw), tuple(owner))
+    # register as borrower with the owner (best-effort distributed refcount)
+    if _core is not None and tuple(owner) != tuple(_core.address or ()):
+        try:
+            import asyncio
+
+            asyncio.run_coroutine_threadsafe(
+                _core.clients.get(tuple(owner)).notify(
+                    "add_borrow", {"object_id": raw}
+                ),
+                _core.loop,
+            )
+        except Exception:
+            pass
+    return ref
+
+
+# --------------------------------------------------------------------- init
+
+
+def init(
+    address: Optional[str] = None,
+    *,
+    num_cpus: Optional[float] = None,
+    num_tpus: Optional[int] = None,
+    resources: Optional[Dict[str, float]] = None,
+    object_store_memory: Optional[int] = None,
+    namespace: str = "default",
+    log_to_driver: bool = True,
+    _system_config: Optional[Dict[str, Any]] = None,
+    ignore_reinit_error: bool = False,
+) -> Dict[str, Any]:
+    """Connect to (or start) a cluster. ≈ ray.init (worker.py:1214)."""
+    global _core, _node_handle, _namespace
+    with _global_lock:
+        if _core is not None:
+            if ignore_reinit_error:
+                return {"address": f"{_core.controller_addr[0]}:{_core.controller_addr[1]}"}
+            raise RuntimeError("ray_tpu.init() called twice; use shutdown() first")
+        config = Config.from_env(_system_config)
+        if object_store_memory:
+            config.object_store_memory_bytes = object_store_memory
+        _namespace = namespace
+
+        if address in (None, "local"):
+            from ray_tpu._private.node import NodeHandle
+
+            _node_handle = NodeHandle.start_head(
+                config,
+                num_cpus=num_cpus,
+                num_tpus=num_tpus,
+                resources=resources,
+            )
+            controller_addr = _node_handle.controller_addr
+            supervisor_addr = _node_handle.supervisor_addr
+        else:
+            if address == "auto":
+                address = os.environ.get("RAY_TPU_ADDRESS", "")
+                if not address:
+                    raise ConnectionError("address='auto' but RAY_TPU_ADDRESS unset")
+            host, port = address.rsplit(":", 1)
+            controller_addr = (host, int(port))
+            supervisor_addr = _find_local_supervisor(config, controller_addr)
+
+        core = CoreWorker(
+            config,
+            controller_addr,
+            supervisor_addr,
+            _new_job_id(controller_addr),
+            role="driver",
+        )
+        core.start()
+        _core = core
+        core._run(
+            core.clients.get(controller_addr).call(
+                "job_register",
+                {"job_id_hex": core.job_id.hex(), "driver_address": core.address},
+            )
+        )
+        return {
+            "address": f"{controller_addr[0]}:{controller_addr[1]}",
+            "node_id": core.node_id_hex,
+            "session_dir": getattr(_node_handle, "session_dir", ""),
+        }
+
+
+def _new_job_id(controller_addr) -> JobID:
+    """Controller-issued job number (cluster-unique across drivers)."""
+    import asyncio
+
+    from ray_tpu._private.rpc import RpcClient
+
+    async def ask():
+        client = RpcClient(controller_addr)
+        try:
+            return await client.call("job_new")
+        finally:
+            await client.close()
+
+    return JobID.from_int(asyncio.run(ask()))
+
+
+def _find_local_supervisor(config, controller_addr):
+    import asyncio
+
+    from ray_tpu._private.rpc import RpcClient
+
+    async def find():
+        client = RpcClient(controller_addr)
+        try:
+            views = await client.call("node_views")
+        finally:
+            await client.close()
+        alive = [v for v in views if v["alive"]]
+        if not alive:
+            return None
+        # prefer a supervisor on this host
+        import socket
+
+        local_names = {"127.0.0.1", "localhost", socket.gethostname()}
+        try:
+            local_names.add(socket.gethostbyname(socket.gethostname()))
+        except OSError:
+            pass
+        for v in alive:
+            if v["address"][0] in local_names:
+                return tuple(v["address"])
+        return tuple(alive[0]["address"])
+
+    return asyncio.run(find())
+
+
+def _connect_existing(core: CoreWorker) -> None:
+    """Install an already-started CoreWorker as this process's runtime
+    (used by worker processes)."""
+    global _core
+    _core = core
+
+
+def shutdown() -> None:
+    global _core, _node_handle
+    with _global_lock:
+        if _core is not None:
+            try:
+                _core._run(
+                    _core.clients.get(_core.controller_addr).call(
+                        "job_finish", {"job_id_hex": _core.job_id.hex()}, timeout=2
+                    ),
+                    timeout=3,
+                )
+            except Exception:
+                pass
+            _core.shutdown()
+            _core = None
+        if _node_handle is not None:
+            _node_handle.stop()
+            _node_handle = None
+
+
+def is_initialized() -> bool:
+    return _core is not None
+
+
+def _require_core() -> CoreWorker:
+    if _core is None:
+        init()
+    return _core
+
+
+# --------------------------------------------------------------------- core ops
+
+
+def put(value: Any) -> ObjectRef:
+    core = _require_core()
+    oid, owner = core.put(value)
+    return ObjectRef(oid, owner)
+
+
+def get(
+    refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float] = None
+) -> Any:
+    core = _require_core()
+    single = isinstance(refs, ObjectRef)
+    batch = [refs] if single else list(refs)
+    for r in batch:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(f"get() expects ObjectRef(s), got {type(r).__name__}")
+    values = core.get(batch, timeout=timeout)
+    return values[0] if single else values
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+    core = _require_core()
+    if num_returns > len(refs):
+        raise ValueError("num_returns exceeds the number of refs")
+    return core.wait(list(refs), num_returns=num_returns, timeout=timeout)
+
+
+def kill(actor: "ActorHandle", *, no_restart: bool = True) -> None:
+    _require_core().kill_actor(actor._actor_id, no_restart=no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False) -> None:
+    """Best-effort cancellation of a queued task."""
+    core = _require_core()
+    task = core._inflight_tasks.get(ref._object_id.task_id())
+    if task is not None and task.lease is not None:
+        import asyncio
+
+        asyncio.run_coroutine_threadsafe(
+            core.clients.get(task.lease.worker_addr).call(
+                "cancel", {"task_id": ref._object_id.task_id().binary()}
+            ),
+            core.loop,
+        )
+
+
+def nodes() -> List[Dict[str, Any]]:
+    core = _require_core()
+    return core._run(core.clients.get(core.controller_addr).call("node_views"))
+
+
+def cluster_resources() -> Dict[str, float]:
+    core = _require_core()
+    status = core._run(core.clients.get(core.controller_addr).call("cluster_status"))
+    return status["total_resources"]
+
+
+def available_resources() -> Dict[str, float]:
+    core = _require_core()
+    status = core._run(core.clients.get(core.controller_addr).call("cluster_status"))
+    return status["available_resources"]
+
+
+class RuntimeContext:
+    def __init__(self, core: CoreWorker):
+        self._core = core
+
+    @property
+    def job_id(self) -> str:
+        return self._core.job_id.hex()
+
+    @property
+    def node_id(self) -> str:
+        return self._core.node_id_hex
+
+    @property
+    def worker_id(self) -> str:
+        return self._core.worker_id.hex()
+
+    @property
+    def actor_id(self) -> Optional[str]:
+        return self._core.actor_id.hex() if self._core.actor_id else None
+
+    def get_tpu_chips(self) -> List[int]:
+        raw = os.environ.get("TPU_VISIBLE_CHIPS", "")
+        return [int(c) for c in raw.split(",") if c.strip()]
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext(_require_core())
+
+
+# --------------------------------------------------------------------- remote
+
+
+class RemoteFunction:
+    """≈ ray.remote_function.RemoteFunction (remote_function.py:40)."""
+
+    def __init__(self, fn, options: Dict[str, Any]):
+        self._fn = fn
+        self._options = options
+        self._blob: Optional[bytes] = None
+        self._key: Optional[str] = None
+        functools.update_wrapper(self, fn)
+
+    def _materialize(self):
+        if self._key is None:
+            self._blob = serialization.dumps(self._fn)
+            self._key = hashlib.sha256(self._blob).hexdigest()
+        return self._key, self._blob
+
+    def options(self, **overrides) -> "RemoteFunction":
+        new = dict(self._options)
+        new.update(overrides)
+        rf = RemoteFunction(self._fn, new)
+        rf._key, rf._blob = self._key, self._blob
+        return rf
+
+    def remote(self, *args, **kwargs):
+        core = _require_core()
+        opts = self._options
+        key, blob = self._materialize()
+        resources = _resources_from_options(opts)
+        num_returns = opts.get("num_returns", 1)
+        oids = core.submit_task(
+            None,
+            args,
+            kwargs,
+            name=opts.get("name") or self._fn.__qualname__,
+            num_returns=num_returns,
+            resources=resources,
+            strategy=_strategy_from_options(opts),
+            max_retries=opts.get("max_retries", -1),
+            retry_exceptions=bool(opts.get("retry_exceptions", False)),
+            runtime_env=opts.get("runtime_env"),
+            function_key=key,
+            function_blob=blob,
+        )
+        refs = [ObjectRef(oid, core.address) for oid in oids]
+        return refs[0] if num_returns == 1 else refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function {self._fn.__name__}() cannot be called directly; "
+            f"use .remote()"
+        )
+
+
+def _resources_from_options(opts: Dict[str, Any]) -> Optional[Dict[str, float]]:
+    """None = unspecified (framework default); explicit zeros are preserved."""
+    specified = False
+    resources: Dict[str, float] = {}
+    if opts.get("resources") is not None:
+        resources.update({k: float(v) for k, v in opts["resources"].items()})
+        specified = True
+    if opts.get("num_cpus") is not None:
+        resources["CPU"] = float(opts["num_cpus"])
+        specified = True
+    if opts.get("num_tpus") is not None:
+        resources["TPU"] = float(opts["num_tpus"])
+        specified = True
+    if opts.get("memory") is not None:
+        resources["memory"] = float(opts["memory"])
+        specified = True
+    return resources if specified else None
+
+
+def _strategy_from_options(opts: Dict[str, Any]) -> SchedulingStrategy:
+    strat = opts.get("scheduling_strategy")
+    if isinstance(strat, SchedulingStrategy):
+        return strat
+    if strat == "SPREAD":
+        return SpreadStrategy()
+    pg = opts.get("placement_group")
+    if pg is not None:
+        return PlacementGroupStrategy(
+            pg_id_hex=pg.id.hex(),
+            bundle_index=opts.get("placement_group_bundle_index", -1),
+        )
+    return SchedulingStrategy()
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, num_returns: int = 1) -> "ActorMethod":
+        return ActorMethod(self._handle, self._name, num_returns)
+
+    def remote(self, *args, **kwargs):
+        core = _require_core()
+        oids = core.submit_actor_task(
+            self._handle._actor_id,
+            self._name,
+            args,
+            kwargs,
+            num_returns=self._num_returns,
+            max_task_retries=self._handle._max_task_retries,
+        )
+        refs = [ObjectRef(oid, core.address) for oid in oids]
+        return refs[0] if self._num_returns == 1 else refs
+
+    def __call__(self, *a, **k):
+        raise TypeError(f"actor method {self._name}() must be invoked via .remote()")
+
+
+class ActorHandle:
+    """≈ ray.actor.ActorHandle (actor.py:1226)."""
+
+    def __init__(self, actor_id: ActorID, max_task_retries: int = 0, class_name: str = ""):
+        self._actor_id = actor_id
+        self._max_task_retries = max_task_retries
+        self._class_name = class_name
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __repr__(self) -> str:
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
+
+    def __reduce__(self):
+        return (
+            ActorHandle,
+            (self._actor_id, self._max_task_retries, self._class_name),
+        )
+
+
+class ActorClass:
+    """≈ ray.actor.ActorClass (actor.py:566)."""
+
+    def __init__(self, cls, options: Dict[str, Any]):
+        self._cls = cls
+        self._options = options
+
+    def options(self, **overrides) -> "ActorClass":
+        new = dict(self._options)
+        new.update(overrides)
+        return ActorClass(self._cls, new)
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        core = _require_core()
+        opts = self._options
+        resources = _resources_from_options(opts)
+        is_async = any(
+            inspect.iscoroutinefunction(m)
+            for _, m in inspect.getmembers(self._cls, inspect.isfunction)
+        )
+        actor_id, _ = core.create_actor(
+            self._cls,
+            args,
+            kwargs,
+            name=opts.get("name", ""),
+            namespace=opts.get("namespace", _namespace),
+            resources=resources,
+            strategy=_strategy_from_options(opts),
+            max_restarts=opts.get("max_restarts", 0),
+            max_task_retries=opts.get("max_task_retries", 0),
+            max_concurrency=opts.get("max_concurrency", 1),
+            is_async=is_async,
+            runtime_env=opts.get("runtime_env"),
+            detached=opts.get("lifetime") == "detached",
+            class_name=self._cls.__name__,
+        )
+        return ActorHandle(
+            actor_id,
+            max_task_retries=opts.get("max_task_retries", 0),
+            class_name=self._cls.__name__,
+        )
+
+    def __call__(self, *a, **k):
+        raise TypeError(
+            f"actor class {self._cls.__name__} must be instantiated via .remote()"
+        )
+
+
+def remote(*args, **kwargs):
+    """``@remote`` / ``@remote(num_cpus=..., num_tpus=..., ...)``
+    ≈ ray.remote (worker.py:3113)."""
+
+    def decorate(target):
+        if inspect.isclass(target):
+            return ActorClass(target, kwargs)
+        return RemoteFunction(target, kwargs)
+
+    if len(args) == 1 and callable(args[0]) and not kwargs:
+        return decorate(args[0])
+    if args:
+        raise TypeError("@remote takes keyword options only")
+    return decorate
+
+
+def method(**opts):
+    """Per-method options decorator (num_returns), ≈ ray.method."""
+
+    def wrap(fn):
+        fn._method_options = opts
+        return fn
+
+    return wrap
+
+
+def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
+    core = _require_core()
+    rec = core._run(
+        core.clients.get(core.controller_addr).call(
+            "actor_by_name",
+            {"name": name, "namespace": namespace or _namespace},
+        )
+    )
+    if rec is None or rec["state"] == "DEAD":
+        raise ValueError(f"actor {name!r} not found in namespace {namespace or _namespace!r}")
+    return ActorHandle(
+        ActorID.from_hex(rec["actor_id_hex"]), class_name=rec.get("class_name", "")
+    )

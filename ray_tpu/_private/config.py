@@ -1,0 +1,123 @@
+"""Framework configuration flags.
+
+TPU-native analog of the reference's ``RAY_CONFIG`` macro table
+(`src/ray/common/ray_config_def.h`, 219 entries): a single typed flag table,
+overridable per-process via ``RAY_TPU_<NAME>`` environment variables and via
+the ``_system_config`` dict passed to ``ray_tpu.init`` (propagated to daemons
+through their spawn environment).
+
+Flags are plain dataclass fields; types are inferred from defaults. Env parsing
+accepts ints, floats, bools ("1/0/true/false") and strings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+@dataclasses.dataclass
+class Config:
+    # ---- RPC / control plane ----
+    rpc_connect_timeout_s: float = 10.0
+    rpc_request_timeout_s: float = 60.0
+    rpc_retry_interval_ms: int = 100
+    rpc_max_retries: int = 20
+    controller_port: int = 0  # 0 = pick free port
+    # ---- health / failure detection (≈ GcsHealthCheckManager, gcs_health_check_manager.h:39) ----
+    health_check_period_ms: int = 1000
+    health_check_timeout_ms: int = 3000
+    health_check_failure_threshold: int = 3
+    # ---- workers / scheduling ----
+    num_workers_soft_limit: int = 4  # max idle pre-started workers per node
+    worker_register_timeout_s: float = 60.0
+    worker_lease_timeout_s: float = 30.0
+    idle_worker_killing_time_ms: int = 60_000
+    # hybrid policy: prefer local node until its utilization crosses this
+    # threshold, then pack remote nodes by score (hybrid_scheduling_policy.h:50).
+    scheduler_spread_threshold: float = 0.5
+    max_tasks_in_flight_per_worker: int = 10
+    # ---- object store ----
+    object_store_memory_bytes: int = 2 * 1024**3
+    # objects <= this are inlined in task replies / in-process store
+    # (reference inlines <100KB returns, core_worker.cc:2852 path).
+    max_direct_call_object_size: int = 100 * 1024
+    object_transfer_chunk_bytes: int = 8 * 1024**2
+    object_spilling_threshold: float = 0.8
+    object_spilling_dir: str = ""
+    # ---- retries / lineage ----
+    task_max_retries: int = 3
+    actor_max_restarts: int = 0
+    lineage_max_bytes: int = 64 * 1024**2
+    # ---- logging / observability ----
+    log_dir: str = ""
+    event_buffer_size: int = 10_000
+    metrics_report_interval_ms: int = 5000
+    task_event_buffer_size: int = 100_000
+    # ---- TPU ----
+    tpu_chips_per_host: int = 0  # 0 = autodetect via jax
+    tpu_topology: str = ""  # e.g. "v5p-64"; "" = autodetect
+    # ---- testing ----
+    fake_cluster: bool = False
+
+    @classmethod
+    def from_env(cls, overrides: Dict[str, Any] | None = None) -> "Config":
+        cfg = cls()
+        for f in dataclasses.fields(cls):
+            env_key = _ENV_PREFIX + f.name.upper()
+            if env_key in os.environ:
+                setattr(cfg, f.name, _parse(os.environ[env_key], f.type, getattr(cfg, f.name)))
+        if overrides:
+            for k, v in overrides.items():
+                if not hasattr(cfg, k):
+                    raise ValueError(f"Unknown system config key: {k}")
+                setattr(cfg, k, v)
+        return cfg
+
+    def to_env(self) -> Dict[str, str]:
+        """Render non-default flags as env vars for spawned daemons."""
+        out = {}
+        default = Config()
+        for f in dataclasses.fields(self):
+            val = getattr(self, f.name)
+            if val != getattr(default, f.name):
+                out[_ENV_PREFIX + f.name.upper()] = _render(val)
+        return out
+
+
+def _parse(raw: str, typ, default):
+    t = type(default)
+    if t is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    if t is int:
+        return int(raw)
+    if t is float:
+        return float(raw)
+    return raw
+
+
+def _render(val) -> str:
+    if isinstance(val, bool):
+        return "1" if val else "0"
+    if isinstance(val, (dict, list)):
+        return json.dumps(val)
+    return str(val)
+
+
+_global_config: Config | None = None
+
+
+def global_config() -> Config:
+    global _global_config
+    if _global_config is None:
+        _global_config = Config.from_env()
+    return _global_config
+
+
+def set_global_config(cfg: Config) -> None:
+    global _global_config
+    _global_config = cfg

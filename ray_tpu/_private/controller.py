@@ -1,0 +1,657 @@
+"""Cluster controller — the global control plane daemon.
+
+TPU-native analog of the reference's GCS server (`src/ray/gcs/gcs_server/`):
+one per cluster, authoritative for node membership + health
+(≈ `GcsNodeManager` + `GcsHealthCheckManager` `gcs_health_check_manager.h:39`),
+the actor directory and restart orchestration (≈ `GcsActorManager`
+`gcs_actor_manager.cc:255,1190`), placement groups
+(≈ `GcsPlacementGroupManager`), jobs, the internal KV (≈ `gcs_kv_manager.h`,
+also serving as the function table), pubsub fan-out (≈ `src/ray/pubsub/`) and
+the task-event sink (≈ `GcsTaskManager`) backing the state API.
+
+Storage is in-memory (≈ `in_memory_store_client.h`); the record tables are
+plain dicts behind a single asyncio loop, with an optional JSON snapshot for
+restart recovery standing in for the Redis path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import dataclasses
+import logging
+import os
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private import serialization
+from ray_tpu._private.config import Config
+from ray_tpu._private.ids import ActorID, JobID, NodeID, PlacementGroupID
+from ray_tpu._private.resources import ResourceSet
+from ray_tpu._private.rpc import ClientPool, RpcServer
+from ray_tpu._private.scheduling import NodeView, PlacementError, place_bundles
+
+logger = logging.getLogger(__name__)
+
+Address = Tuple[str, int]
+
+# actor states (≈ rpc::ActorTableData::ActorState)
+ACTOR_PENDING = "PENDING_CREATION"
+ACTOR_ALIVE = "ALIVE"
+ACTOR_RESTARTING = "RESTARTING"
+ACTOR_DEAD = "DEAD"
+
+PG_PENDING = "PENDING"
+PG_CREATED = "CREATED"
+PG_REMOVED = "REMOVED"
+
+
+@dataclasses.dataclass
+class NodeRecord:
+    node_id_hex: str
+    address: Address
+    total: ResourceSet
+    available: ResourceSet
+    alive: bool = True
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    last_seen: float = 0.0
+    missed_health_checks: int = 0
+    store_stats: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+    def view(self) -> NodeView:
+        return NodeView(
+            node_id_hex=self.node_id_hex,
+            address=self.address,
+            total=self.total,
+            available=self.available,
+            alive=self.alive,
+            labels=self.labels,
+        )
+
+
+@dataclasses.dataclass
+class ActorRecord:
+    actor_id_hex: str
+    name: str
+    namespace: str
+    state: str
+    owner: Optional[Address]
+    address: Optional[Address] = None
+    worker_id_hex: str = ""
+    node_id_hex: str = ""
+    incarnation: int = 0
+    max_restarts: int = 0
+    num_restarts: int = 0
+    creation_spec: bytes = b""  # serialized TaskSpec for restarts
+    death_cause: str = ""
+    class_name: str = ""
+    job_id_hex: str = ""
+    detached: bool = False
+
+
+@dataclasses.dataclass
+class PGRecord:
+    pg_id_hex: str
+    bundles: List[Dict[str, float]]
+    strategy: str
+    state: str
+    name: str = ""
+    assignment: List[str] = dataclasses.field(default_factory=list)
+    creator_job_hex: str = ""
+
+
+@dataclasses.dataclass
+class JobRecord:
+    job_id_hex: str
+    driver_address: Optional[Address]
+    start_time: float
+    end_time: float = 0.0
+    alive: bool = True
+
+
+class Controller:
+    """Single-loop cluster controller. All state mutations happen on the
+    owning asyncio loop (no locks, mirroring the reference's single-threaded
+    GCS event loop)."""
+
+    def __init__(self, config: Config, host: str = "127.0.0.1", port: int = 0):
+        self.config = config
+        self.server = RpcServer(host, port if port else config.controller_port)
+        self.server.register_object(self)
+        self.clients = ClientPool(
+            config.rpc_connect_timeout_s, config.rpc_request_timeout_s
+        )
+        self.nodes: Dict[str, NodeRecord] = {}
+        self.actors: Dict[str, ActorRecord] = {}
+        self.named_actors: Dict[Tuple[str, str], str] = {}
+        self.pgs: Dict[str, PGRecord] = {}
+        self.jobs: Dict[str, JobRecord] = {}
+        self.kv: Dict[str, Dict[str, bytes]] = {}
+        self.subscribers: Dict[str, Set[Address]] = {}
+        self.task_events: deque = deque(maxlen=config.task_event_buffer_size)
+        self._health_task: Optional[asyncio.Task] = None
+        self._pg_retry_task: Optional[asyncio.Task] = None
+        self._next_job_int = 0
+        self._started = time.time()
+
+    # ------------------------------------------------------------- lifecycle
+
+    async def start(self) -> Address:
+        addr = await self.server.start()
+        loop = asyncio.get_running_loop()
+        self._health_task = loop.create_task(self._health_loop())
+        self._pg_retry_task = loop.create_task(self._pg_retry_loop())
+        return addr
+
+    async def _pg_retry_loop(self) -> None:
+        """Pending placement groups retry as resources free up
+        (≈ GcsPlacementGroupManager's pending queue ticking)."""
+        while True:
+            await asyncio.sleep(0.5)
+            try:
+                await self._retry_pending_pgs()
+            except Exception:
+                logger.exception("pg retry failed")
+
+    async def stop(self) -> None:
+        for t in (self._health_task, self._pg_retry_task):
+            if t is not None:
+                t.cancel()
+        await self.clients.close_all()
+        await self.server.stop()
+
+    # ------------------------------------------------------------- nodes
+
+    async def rpc_node_register(self, body) -> dict:
+        rec = NodeRecord(
+            node_id_hex=body["node_id_hex"],
+            address=tuple(body["address"]),
+            total=ResourceSet.of(body["total"]),
+            available=ResourceSet.of(body["available"]),
+            labels=body.get("labels", {}),
+            last_seen=time.monotonic(),
+        )
+        self.nodes[rec.node_id_hex] = rec
+        logger.info("node %s registered at %s", rec.node_id_hex[:8], rec.address)
+        await self._publish("nodes", {"event": "ALIVE", "node_id_hex": rec.node_id_hex})
+        await self._retry_pending_pgs()
+        return {"num_nodes": len(self.nodes)}
+
+    async def rpc_node_sync(self, body) -> None:
+        """Resource gossip from supervisors (≈ ray_syncer)."""
+        rec = self.nodes.get(body["node_id_hex"])
+        if rec is None:
+            return
+        rec.available = ResourceSet.of(body["available"])
+        if "total" in body:
+            rec.total = ResourceSet.of(body["total"])
+        rec.store_stats = body.get("store_stats", {})
+        rec.last_seen = time.monotonic()
+        rec.missed_health_checks = 0
+
+    async def rpc_node_views(self, body=None) -> list:
+        return [
+            {
+                "node_id_hex": r.node_id_hex,
+                "address": r.address,
+                "total": dict(r.total),
+                "available": dict(r.available),
+                "alive": r.alive,
+                "labels": r.labels,
+            }
+            for r in self.nodes.values()
+        ]
+
+    async def rpc_node_drain(self, body) -> None:
+        await self._mark_node_dead(body["node_id_hex"], "drained")
+
+    async def _health_loop(self) -> None:
+        from ray_tpu._private.rpc import RpcClient
+
+        period = self.config.health_check_period_ms / 1000.0
+        timeout = self.config.health_check_timeout_ms / 1000.0
+        while True:
+            await asyncio.sleep(period)
+            for rec in list(self.nodes.values()):
+                if not rec.alive:
+                    continue
+                # Passive freshness first: a recent sync counts as healthy.
+                if time.monotonic() - rec.last_seen < period:
+                    continue
+                # Dedicated short-lived probe: a dead supervisor must fail
+                # fast (ECONNREFUSED), not ride pooled-client reconnect
+                # backoff (≈ GcsHealthCheckManager's per-check gRPC deadline).
+                probe = RpcClient(rec.address, connect_timeout_s=min(1.0, timeout))
+                try:
+                    await probe.call("ping", timeout=timeout)
+                    rec.last_seen = time.monotonic()
+                    rec.missed_health_checks = 0
+                except Exception:
+                    rec.missed_health_checks += 1
+                    if (
+                        rec.missed_health_checks
+                        >= self.config.health_check_failure_threshold
+                    ):
+                        await self._mark_node_dead(rec.node_id_hex, "health check failed")
+                finally:
+                    await probe.close()
+
+    async def _mark_node_dead(self, node_hex: str, reason: str) -> None:
+        rec = self.nodes.get(node_hex)
+        if rec is None or not rec.alive:
+            return
+        rec.alive = False
+        logger.warning("node %s dead: %s", node_hex[:8], reason)
+        await self._publish("nodes", {"event": "DEAD", "node_id_hex": node_hex})
+        # fail over actors that lived there
+        for actor in list(self.actors.values()):
+            if actor.node_id_hex == node_hex and actor.state in (
+                ACTOR_ALIVE,
+                ACTOR_PENDING,
+                ACTOR_RESTARTING,
+            ):
+                await self._on_actor_failure(actor, f"node {node_hex[:8]} died")
+        # placement groups with bundles there go back to pending
+        for pg in self.pgs.values():
+            if pg.state == PG_CREATED and node_hex in pg.assignment:
+                pg.state = PG_PENDING
+                pg.assignment = []
+                await self._publish(
+                    "pg:" + pg.pg_id_hex, {"state": PG_PENDING, "pg_id_hex": pg.pg_id_hex}
+                )
+        await self._retry_pending_pgs()
+
+    # ------------------------------------------------------------- KV / functions
+
+    async def rpc_kv_put(self, body) -> bool:
+        ns = self.kv.setdefault(body.get("ns", ""), {})
+        overwrite = body.get("overwrite", True)
+        if not overwrite and body["key"] in ns:
+            return False
+        ns[body["key"]] = body["value"]
+        return True
+
+    async def rpc_kv_get(self, body):
+        return self.kv.get(body.get("ns", ""), {}).get(body["key"])
+
+    async def rpc_kv_del(self, body) -> bool:
+        return self.kv.get(body.get("ns", ""), {}).pop(body["key"], None) is not None
+
+    async def rpc_kv_exists(self, body) -> bool:
+        return body["key"] in self.kv.get(body.get("ns", ""), {})
+
+    async def rpc_kv_keys(self, body) -> list:
+        prefix = body.get("prefix", "")
+        return [k for k in self.kv.get(body.get("ns", ""), {}) if k.startswith(prefix)]
+
+    # ------------------------------------------------------------- actors
+
+    async def rpc_actor_register(self, body) -> dict:
+        """Register + schedule an actor creation.
+
+        ≈ GcsActorManager::HandleRegisterActor + GcsActorScheduler::Schedule
+        (gcs_actor_manager.cc:255, gcs_actor_scheduler.cc:49). The controller
+        picks the node; the owner then leases from that supervisor and pushes
+        the creation task (creation results flow to the owner like any task).
+        """
+        hexid = body["actor_id_hex"]
+        name = body.get("name", "")
+        namespace = body.get("namespace", "default")
+        if name:
+            existing_hex = self.named_actors.get((namespace, name))
+            if existing_hex is not None:
+                existing = self.actors.get(existing_hex)
+                if existing is not None and existing.state != ACTOR_DEAD:
+                    raise ValueError(
+                        f"actor name {name!r} already taken in namespace {namespace!r}"
+                    )
+        rec = ActorRecord(
+            actor_id_hex=hexid,
+            name=name,
+            namespace=namespace,
+            state=ACTOR_PENDING,
+            owner=tuple(body["owner"]) if body.get("owner") else None,
+            max_restarts=body.get("max_restarts", 0),
+            creation_spec=body.get("creation_spec", b""),
+            class_name=body.get("class_name", ""),
+            job_id_hex=body.get("job_id_hex", ""),
+            detached=body.get("detached", False),
+        )
+        self.actors[hexid] = rec
+        if name:
+            self.named_actors[(namespace, name)] = hexid
+        return {"ok": True}
+
+    async def rpc_actor_ready(self, body) -> None:
+        """Worker reports successful actor construction."""
+        rec = self.actors.get(body["actor_id_hex"])
+        if rec is None:
+            return
+        rec.state = ACTOR_ALIVE
+        rec.address = tuple(body["address"])
+        rec.worker_id_hex = body.get("worker_id_hex", "")
+        rec.node_id_hex = body.get("node_id_hex", "")
+        rec.incarnation += 1
+        await self._publish(
+            "actor:" + rec.actor_id_hex,
+            {
+                "state": ACTOR_ALIVE,
+                "address": rec.address,
+                "incarnation": rec.incarnation,
+            },
+        )
+
+    async def rpc_actor_creation_failed(self, body) -> None:
+        rec = self.actors.get(body["actor_id_hex"])
+        if rec is None:
+            return
+        await self._kill_actor(rec, reason=body.get("reason", "creation failed"), restart=False)
+
+    async def rpc_actor_get(self, body):
+        rec = self.actors.get(body["actor_id_hex"])
+        return dataclasses.asdict(rec) if rec else None
+
+    async def rpc_actor_by_name(self, body):
+        hexid = self.named_actors.get((body.get("namespace", "default"), body["name"]))
+        if hexid is None:
+            return None
+        rec = self.actors.get(hexid)
+        return dataclasses.asdict(rec) if rec else None
+
+    async def rpc_actor_list(self, body=None) -> list:
+        return [dataclasses.asdict(r) for r in self.actors.values()]
+
+    async def rpc_actor_kill(self, body) -> None:
+        rec = self.actors.get(body["actor_id_hex"])
+        if rec is None:
+            return
+        no_restart = body.get("no_restart", True)
+        # kill the live worker process via its supervisor
+        node = self.nodes.get(rec.node_id_hex)
+        if rec.state == ACTOR_ALIVE and node is not None and node.alive:
+            try:
+                await self.clients.get(node.address).call(
+                    "kill_worker", {"worker_id_hex": rec.worker_id_hex}, timeout=5
+                )
+            except Exception:
+                pass
+        await self._kill_actor(
+            rec, reason="killed via ray_tpu.kill", restart=not no_restart
+        )
+
+    async def rpc_worker_died(self, body) -> None:
+        """Supervisor reports a worker process exit."""
+        actor_hex = body.get("actor_id_hex", "")
+        if actor_hex and actor_hex in self.actors:
+            rec = self.actors[actor_hex]
+            if rec.state in (ACTOR_ALIVE, ACTOR_PENDING):
+                await self._on_actor_failure(
+                    rec, body.get("reason", "worker process died")
+                )
+
+    async def _on_actor_failure(self, rec: ActorRecord, reason: str) -> None:
+        if rec.num_restarts < rec.max_restarts or rec.max_restarts == -1:
+            rec.num_restarts += 1
+            rec.state = ACTOR_RESTARTING
+            rec.address = None
+            await self._publish(
+                "actor:" + rec.actor_id_hex,
+                {"state": ACTOR_RESTARTING, "num_restarts": rec.num_restarts},
+            )
+            asyncio.get_running_loop().create_task(self._restart_actor(rec))
+        else:
+            await self._kill_actor(rec, reason, restart=False)
+
+    async def _kill_actor(self, rec: ActorRecord, reason: str, restart: bool) -> None:
+        if restart and (rec.num_restarts < rec.max_restarts or rec.max_restarts == -1):
+            await self._on_actor_failure(rec, reason)
+            return
+        rec.state = ACTOR_DEAD
+        rec.death_cause = reason
+        rec.address = None
+        await self._publish(
+            "actor:" + rec.actor_id_hex, {"state": ACTOR_DEAD, "reason": reason}
+        )
+
+    async def _restart_actor(self, rec: ActorRecord) -> None:
+        """Re-run the creation task on a fresh worker (≈ gcs_actor_manager.cc:1190)."""
+        from ray_tpu._private.scheduling import pick_node
+        from ray_tpu._private.task_spec import TaskSpec  # noqa: F401 — deserialized below
+
+        try:
+            spec = serialization.loads(rec.creation_spec)
+        except Exception as e:
+            await self._kill_actor(rec, f"cannot restart: bad creation spec ({e})", False)
+            return
+        delay = 0.1
+        while rec.state == ACTOR_RESTARTING:
+            views = [r.view() for r in self.nodes.values() if r.alive]
+            node = pick_node(views, spec.required_resources(), spec.strategy)
+            if node is not None:
+                try:
+                    grant = await self.clients.get(node.address).call(
+                        "request_lease",
+                        {"spec": serialization.dumps(spec), "no_spillback": True},
+                        timeout=self.config.worker_lease_timeout_s,
+                    )
+                    if grant.get("granted"):
+                        # mark the worker as actor-hosting BEFORE it can run
+                        # (its death must reach us for restart accounting)
+                        await self.clients.get(node.address).call(
+                            "worker_set_actor",
+                            {
+                                "worker_id_hex": grant["worker_id_hex"],
+                                "actor_id_hex": rec.actor_id_hex,
+                            },
+                        )
+                        await self.clients.get(tuple(grant["worker_address"])).call(
+                            "push_task", {"spec": serialization.dumps(spec)}, timeout=30
+                        )
+                        return  # worker reports actor_ready on success
+                except Exception as e:
+                    logger.warning(
+                        "actor %s restart attempt failed: %s", rec.actor_id_hex[:8], e
+                    )
+            await asyncio.sleep(delay)
+            delay = min(delay * 2, 5.0)
+
+    # ------------------------------------------------------------- placement groups
+
+    async def rpc_pg_create(self, body) -> dict:
+        pg = PGRecord(
+            pg_id_hex=body["pg_id_hex"],
+            bundles=body["bundles"],
+            strategy=body.get("strategy", "PACK"),
+            state=PG_PENDING,
+            name=body.get("name", ""),
+            creator_job_hex=body.get("job_id_hex", ""),
+        )
+        self.pgs[pg.pg_id_hex] = pg
+        await self._try_place_pg(pg)
+        return {"state": pg.state, "assignment": pg.assignment}
+
+    async def _try_place_pg(self, pg: PGRecord) -> None:
+        views = [r.view() for r in self.nodes.values() if r.alive]
+        try:
+            assignment = place_bundles(views, pg.bundles, pg.strategy)
+        except PlacementError:
+            return  # stays pending
+        # Reserve each bundle on its node; roll back on partial failure.
+        reserved: List[Tuple[str, int]] = []
+        ok = True
+        for index, node_hex in enumerate(assignment):
+            rec = self.nodes[node_hex]
+            try:
+                await self.clients.get(rec.address).call(
+                    "reserve_bundle",
+                    {
+                        "pg_id_hex": pg.pg_id_hex,
+                        "bundle_index": index,
+                        "resources": pg.bundles[index],
+                    },
+                    timeout=10,
+                )
+                reserved.append((node_hex, index))
+            except Exception as e:
+                logger.warning("bundle reserve failed on %s: %s", node_hex[:8], e)
+                ok = False
+                break
+        if not ok:
+            for node_hex, index in reserved:
+                try:
+                    await self.clients.get(self.nodes[node_hex].address).call(
+                        "release_bundle",
+                        {"pg_id_hex": pg.pg_id_hex, "bundle_index": index},
+                        timeout=10,
+                    )
+                except Exception:
+                    pass
+            return
+        pg.assignment = assignment
+        pg.state = PG_CREATED
+        await self._publish(
+            "pg:" + pg.pg_id_hex,
+            {"state": PG_CREATED, "assignment": assignment, "pg_id_hex": pg.pg_id_hex},
+        )
+
+    async def _retry_pending_pgs(self) -> None:
+        for pg in self.pgs.values():
+            if pg.state == PG_PENDING:
+                await self._try_place_pg(pg)
+
+    async def rpc_pg_get(self, body):
+        pg = self.pgs.get(body["pg_id_hex"])
+        return dataclasses.asdict(pg) if pg else None
+
+    async def rpc_pg_list(self, body=None) -> list:
+        return [dataclasses.asdict(p) for p in self.pgs.values()]
+
+    async def rpc_pg_remove(self, body) -> None:
+        pg = self.pgs.get(body["pg_id_hex"])
+        if pg is None or pg.state == PG_REMOVED:
+            return
+        for index, node_hex in enumerate(pg.assignment):
+            rec = self.nodes.get(node_hex)
+            if rec is None or not rec.alive:
+                continue
+            try:
+                await self.clients.get(rec.address).call(
+                    "release_bundle",
+                    {"pg_id_hex": pg.pg_id_hex, "bundle_index": index},
+                    timeout=10,
+                )
+            except Exception:
+                pass
+        pg.state = PG_REMOVED
+        pg.assignment = []
+        await self._publish("pg:" + pg.pg_id_hex, {"state": PG_REMOVED})
+
+    # ------------------------------------------------------------- jobs
+
+    async def rpc_job_new(self, body=None) -> int:
+        """Issue a cluster-unique job number (drivers must not mint their own:
+        two drivers on one cluster would both claim job 1)."""
+        self._next_job_int += 1
+        return self._next_job_int
+
+    async def rpc_job_register(self, body) -> None:
+        self.jobs[body["job_id_hex"]] = JobRecord(
+            job_id_hex=body["job_id_hex"],
+            driver_address=tuple(body["driver_address"]) if body.get("driver_address") else None,
+            start_time=time.time(),
+        )
+
+    async def rpc_job_finish(self, body) -> None:
+        job = self.jobs.get(body["job_id_hex"])
+        if job:
+            job.alive = False
+            job.end_time = time.time()
+
+    async def rpc_job_list(self, body=None) -> list:
+        return [dataclasses.asdict(j) for j in self.jobs.values()]
+
+    # ------------------------------------------------------------- pubsub
+
+    async def rpc_subscribe(self, body) -> None:
+        self.subscribers.setdefault(body["channel"], set()).add(tuple(body["address"]))
+
+    async def rpc_unsubscribe(self, body) -> None:
+        self.subscribers.get(body["channel"], set()).discard(tuple(body["address"]))
+
+    async def rpc_publish(self, body) -> None:
+        await self._publish(body["channel"], body["message"])
+
+    async def _publish(self, channel: str, message: Any) -> None:
+        dead: List[Address] = []
+        # snapshot: subscribe RPCs may mutate the set while we await notifies
+        for addr in list(self.subscribers.get(channel, set())):
+            try:
+                await self.clients.get(addr).notify(
+                    "on_publish", {"channel": channel, "message": message}
+                )
+            except Exception:
+                dead.append(addr)
+        for addr in dead:
+            self.subscribers[channel].discard(addr)
+
+    # ------------------------------------------------------------- observability
+
+    async def rpc_task_events(self, body) -> None:
+        for ev in body["events"]:
+            self.task_events.append(ev)
+
+    async def rpc_state_tasks(self, body=None) -> list:
+        limit = (body or {}).get("limit", 1000)
+        return list(self.task_events)[-limit:]
+
+    async def rpc_cluster_status(self, body=None) -> dict:
+        total = ResourceSet()
+        avail = ResourceSet()
+        for r in self.nodes.values():
+            if r.alive:
+                total.add(r.total)
+                avail.add(r.available)
+        return {
+            "nodes_alive": sum(1 for r in self.nodes.values() if r.alive),
+            "nodes_dead": sum(1 for r in self.nodes.values() if not r.alive),
+            "total_resources": dict(total),
+            "available_resources": dict(avail),
+            "num_actors": len(self.actors),
+            "num_pgs": len(self.pgs),
+            "uptime_s": time.time() - self._started,
+        }
+
+    async def rpc_ping(self, body=None) -> str:
+        return "pong"
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--session-dir", default="")
+    parser.add_argument("--address-file", default="")
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
+        format="[controller] %(asctime)s %(levelname)s %(message)s",
+    )
+
+    async def run():
+        controller = Controller(Config.from_env(), args.host, args.port)
+        addr = await controller.start()
+        if args.address_file:
+            tmp = args.address_file + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(f"{addr[0]}:{addr[1]}")
+            os.replace(tmp, args.address_file)
+        logger.info("controller listening on %s:%s", *addr)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1221 @@
+"""Per-process core runtime.
+
+TPU-native analog of the reference's CoreWorker
+(`src/ray/core_worker/core_worker.h:292`): linked into the driver and every
+worker process. Owns:
+
+  * task submission with lease pipelining (≈ `CoreWorkerDirectTaskSubmitter`
+    `transport/direct_task_transport.cc:24,197,353`: leases are cached per
+    resource shape and up to ``max_tasks_in_flight_per_worker`` tasks ride one
+    leased worker),
+  * object ownership: returned/put objects are owned by this process; small
+    values live in the in-process store, large ones in the node's shared
+    arena; remote readers resolve through the owner
+    (≈ `TaskManager` + in-process memory store),
+  * reference counting + free (≈ `ReferenceCounter` `reference_count.h:61`),
+  * task retries on worker crash (≈ task retries, `task_manager.cc`),
+  * the direct actor transport with per-handle sequence numbers
+    (≈ `direct_actor_task_submitter.h`, callee ordering in the worker).
+
+All internal state lives on a background asyncio loop thread; public methods
+are thread-safe bridges (the executing user code runs on a separate thread in
+workers, mirroring the reference's task-execution/IO thread split).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import hashlib
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu._private import serialization
+from ray_tpu._private.config import Config
+from ray_tpu._private.exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    ObjectLostError,
+    TaskError,
+    WorkerCrashedError,
+)
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.object_store import ArenaFile, InProcessStore
+from ray_tpu._private.rpc import (
+    ClientPool,
+    RpcClient,
+    RpcConnectionError,
+    RpcServer,
+    RemoteError,
+)
+from ray_tpu._private.task_spec import (
+    ArgKind,
+    PlacementGroupStrategy,
+    SchedulingStrategy,
+    TaskArg,
+    TaskKind,
+    TaskSpec,
+)
+
+logger = logging.getLogger(__name__)
+
+Address = Tuple[str, int]
+
+_TRACE_PATH = os.environ.get("RAY_TPU_TRACE_FILE", "")
+
+
+def _trace(msg: str) -> None:
+    if _TRACE_PATH:
+        with open(_TRACE_PATH, "a") as f:
+            f.write(f"[{os.getpid()} {time.monotonic():.3f}] {msg}\n")
+
+# object entry states at the owner
+PENDING = "PENDING"
+INLINE = "INLINE"  # packed bytes in the in-process store
+SHARED = "SHARED"  # in a node arena; location recorded
+FAILED = "FAILED"
+
+
+@dataclasses.dataclass
+class ObjectEntry:
+    object_id: ObjectID
+    state: str = PENDING
+    size: int = 0
+    location: Optional[Address] = None  # supervisor address holding the data
+    error: Optional[Exception] = None
+    event: Optional[asyncio.Event] = None
+    local_refs: int = 0
+    borrows: int = 0
+    task_pins: int = 0  # pinned as in-flight task args
+
+
+@dataclasses.dataclass
+class _Lease:
+    lease_id: int
+    worker_id_hex: str
+    worker_addr: Address
+    supervisor_addr: Address
+    in_flight: int = 0
+    shape_key: str = ""
+    broken: bool = False
+
+
+@dataclasses.dataclass
+class _PendingTask:
+    spec: TaskSpec
+    retries_left: int = 0
+    lease: Optional[_Lease] = None
+
+
+class ActorHandleState:
+    """Client-side state for one actor handle lineage (shared across copies)."""
+
+    def __init__(self, actor_id: ActorID, caller_id: str):
+        self.actor_id = actor_id
+        self.caller_id = caller_id
+        self.seqno = 0
+        self.address: Optional[Address] = None
+        self.incarnation = -1
+        self.dead = False
+        self.death_reason = ""
+
+
+class CoreWorker:
+    def __init__(
+        self,
+        config: Config,
+        controller_addr: Address,
+        supervisor_addr: Optional[Address],
+        job_id: JobID,
+        role: str = "driver",
+        worker_id: Optional[WorkerID] = None,
+    ):
+        self.config = config
+        self.controller_addr = controller_addr
+        self.supervisor_addr = supervisor_addr
+        self.job_id = job_id
+        self.role = role
+        self.worker_id = worker_id or WorkerID.from_random()
+        self.node_id_hex = ""
+        self.arena: Optional[ArenaFile] = None
+        self.actor_id: Optional[ActorID] = None  # set when this process hosts an actor
+
+        self.in_process = InProcessStore()
+        self.objects: Dict[ObjectID, ObjectEntry] = {}
+        self._fn_cache: Dict[str, Any] = {}
+        self._fn_registered: set = set()
+        self._leases: Dict[str, List[_Lease]] = {}
+        self._lease_requests_in_flight: Dict[str, int] = {}
+        self._task_queues: Dict[str, deque] = {}
+        self._inflight_tasks: Dict[TaskID, _PendingTask] = {}
+        self._actor_states: Dict[str, ActorHandleState] = {}
+        self._actor_events: Dict[str, asyncio.Event] = {}
+        self._pub_handlers: Dict[str, List[Callable]] = {}
+        self._task_events: deque = deque()
+
+        self.loop = asyncio.new_event_loop()
+        self._loop_thread = threading.Thread(
+            target=self._run_loop, name="ray_tpu-io", daemon=True
+        )
+        self.server = RpcServer("127.0.0.1", 0)
+        self.server.register_object(self)
+        self.clients: Optional[ClientPool] = None
+        self.address: Optional[Address] = None
+        self._shutdown = False
+
+    # ------------------------------------------------------------- lifecycle
+
+    def _run_loop(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def start(self) -> None:
+        self._loop_thread.start()
+        self.address = self._run(self._async_start())
+
+    async def _async_start(self) -> Address:
+        self.clients = ClientPool(
+            self.config.rpc_connect_timeout_s, self.config.rpc_request_timeout_s
+        )
+        addr = await self.server.start()
+        if self.supervisor_addr is not None:
+            info = await self.clients.get(self.supervisor_addr).call("node_info")
+            self.node_id_hex = info["node_id_hex"]
+            self.arena = ArenaFile(info["arena_path"], info["arena_size"])
+        return addr
+
+    def shutdown(self) -> None:
+        if self._shutdown:
+            return
+        self._shutdown = True
+        try:
+            self._run(self._async_shutdown(), timeout=5)
+        except Exception:
+            pass
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self._loop_thread.join(timeout=2)
+
+    async def _async_shutdown(self):
+        for shape, leases in self._leases.items():
+            for lease in leases:
+                try:
+                    await self.clients.get(lease.supervisor_addr).call(
+                        "release_lease", {"lease_id": lease.lease_id}, timeout=2
+                    )
+                except Exception:
+                    pass
+        if self.clients:
+            await self.clients.close_all()
+        await self.server.stop()
+        if self.arena is not None:
+            self.arena.close()
+
+    def _run(self, coro, timeout: Optional[float] = None):
+        """Run a coroutine on the IO loop from any user thread."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    # ------------------------------------------------------------- functions
+
+    def _register_function(self, key: str, blob: bytes) -> None:
+        if key in self._fn_registered:
+            return
+        self._run(
+            self.clients.get(self.controller_addr).call(
+                "kv_put", {"ns": "fn", "key": key, "value": blob, "overwrite": False}
+            )
+        )
+        self._fn_registered.add(key)
+
+    def get_function(self, key: str):
+        """Fetch + cache a function/class blob from the controller fn table."""
+        fn = self._fn_cache.get(key)
+        if fn is None:
+            blob = self._run(
+                self.clients.get(self.controller_addr).call(
+                    "kv_get", {"ns": "fn", "key": key}
+                )
+            )
+            if blob is None:
+                raise KeyError(f"function {key} not in function table")
+            fn = serialization.loads(blob)
+            self._fn_cache[key] = fn
+        return fn
+
+    # ------------------------------------------------------------- submission
+
+    def build_args(self, args: Sequence[Any], kwargs: Dict[str, Any]) -> List[TaskArg]:
+        """Top-level ObjectRefs become REF args (resolved by the executor);
+        everything else packs into one VALUE payload."""
+        from ray_tpu._private.api import ObjectRef
+
+        out: List[TaskArg] = []
+        plain_args: List[Any] = []
+        for a in args:
+            if isinstance(a, ObjectRef):
+                out.append(
+                    TaskArg(ArgKind.REF, object_id=a._object_id, owner=a._owner_addr)
+                )
+                plain_args.append(_RefPlaceholder(len(out) - 1))
+            else:
+                plain_args.append(a)
+        out.insert(
+            0, TaskArg(ArgKind.VALUE, value=serialization.pack((plain_args, kwargs)))
+        )
+        return out
+
+    def submit_task(
+        self,
+        function: Any,
+        args: Sequence[Any],
+        kwargs: Dict[str, Any],
+        *,
+        name: str,
+        num_returns: int = 1,
+        resources: Optional[Dict[str, float]] = None,
+        strategy: Optional[SchedulingStrategy] = None,
+        max_retries: int = -1,
+        retry_exceptions: bool = False,
+        runtime_env: Optional[Dict[str, Any]] = None,
+        function_key: Optional[str] = None,
+        function_blob: Optional[bytes] = None,
+    ) -> List[ObjectID]:
+        if function_key is None:
+            function_blob = serialization.dumps(function)
+            function_key = hashlib.sha256(function_blob).hexdigest()
+        if function_blob is not None:
+            self._register_function(function_key, function_blob)
+        spec = TaskSpec(
+            task_id=TaskID.from_random(),
+            job_id=self.job_id,
+            kind=TaskKind.NORMAL,
+            name=name,
+            function_key=function_key,
+            args=self.build_args(args, kwargs),
+            num_returns=num_returns,
+            resources=None if resources is None else dict(resources),
+            strategy=strategy or SchedulingStrategy(),
+            max_retries=self.config.task_max_retries if max_retries < 0 else max_retries,
+            retry_exceptions=retry_exceptions,
+            owner=self.address,
+            runtime_env=runtime_env,
+        )
+        return_ids = spec.return_ids()
+        self._run(self._async_submit(spec))
+        return return_ids
+
+    async def _async_submit(self, spec: TaskSpec) -> None:
+        for oid in spec.return_ids():
+            self._ensure_entry(oid)
+        self._pin_arg_refs(spec)
+        self._record_event(spec, "SUBMITTED")
+        pending = _PendingTask(spec, retries_left=spec.max_retries)
+        self._inflight_tasks[spec.task_id] = pending
+        shape = self._shape_key(spec)
+        self._task_queues.setdefault(shape, deque()).append(pending)
+        await self._pump_shape(shape, spec)
+
+    def _shape_key(self, spec: TaskSpec) -> str:
+        env = (spec.runtime_env or {}).get("env_vars", {})
+        return repr(
+            (
+                sorted(spec.required_resources().items()),
+                spec.strategy,
+                tuple(sorted(env.items())),
+            )
+        )
+
+    async def _pump_shape(self, shape: str, proto_spec: TaskSpec) -> None:
+        """Dispatch queued tasks onto leased workers; request leases as needed."""
+        queue = self._task_queues.get(shape)
+        if not queue:
+            return
+        leases = self._leases.setdefault(shape, [])
+        cap = max(1, self.config.max_tasks_in_flight_per_worker)
+        # Least-loaded dispatch: spread tasks across granted leases; only
+        # stack (pipeline) onto a busy lease when no more leases are coming.
+        while queue:
+            candidates = [
+                l for l in leases if not l.broken and l.in_flight < cap
+            ]
+            if not candidates:
+                break
+            lease = min(candidates, key=lambda l: l.in_flight)
+            if lease.in_flight >= 1 and self._lease_requests_in_flight.get(shape, 0) > 0:
+                break  # prefer waiting for a fresh worker over serializing
+            task = queue.popleft()
+            lease.in_flight += 1
+            task.lease = lease
+            asyncio.get_running_loop().create_task(self._push(task, lease))
+        # One lease per queued task (for cluster-wide parallelism), bounded;
+        # excess tasks ride pipelining slots on granted leases as they free
+        # (≈ direct_task_transport lease amortization + per-task leases).
+        have = self._lease_requests_in_flight.get(shape, 0)
+        want = len(queue) - have
+        for _ in range(max(0, min(want, 8 - have))):
+            self._lease_requests_in_flight[shape] = (
+                self._lease_requests_in_flight.get(shape, 0) + 1
+            )
+            asyncio.get_running_loop().create_task(
+                self._request_lease(shape, proto_spec)
+            )
+
+    async def _request_lease(self, shape: str, spec: TaskSpec) -> None:
+        """Lease a worker, following spillback redirects
+        (≈ RequestNewWorkerIfNeeded, direct_task_transport.cc:353,513)."""
+        try:
+            target = await self._lease_target(spec)
+            hops = 0
+            while True:
+                grant = await self.clients.get(target).call(
+                    "request_lease",
+                    {"spec": serialization.dumps(spec), "hops": hops},
+                    timeout=self.config.worker_lease_timeout_s + 3600,
+                )
+                if grant.get("granted"):
+                    lease = _Lease(
+                        lease_id=grant["lease_id"],
+                        worker_id_hex=grant["worker_id_hex"],
+                        worker_addr=tuple(grant["worker_address"]),
+                        supervisor_addr=target,
+                        shape_key=shape,
+                    )
+                    self._leases.setdefault(shape, []).append(lease)
+                    break
+                elif grant.get("retry_at"):
+                    target = tuple(grant["retry_at"])
+                    hops = grant.get("hops", hops + 1)
+                else:
+                    raise RuntimeError(grant.get("error", "lease rejected"))
+        except Exception as e:
+            # fail one queued task of this shape (others will retry leasing)
+            queue = self._task_queues.get(shape)
+            if queue:
+                task = queue.popleft()
+                self._fail_task(task.spec, RuntimeError(f"scheduling failed: {e}"))
+                self._inflight_tasks.pop(task.spec.task_id, None)
+            return
+        finally:
+            self._lease_requests_in_flight[shape] = max(
+                0, self._lease_requests_in_flight.get(shape, 1) - 1
+            )
+        await self._pump_shape(shape, spec)
+        # a lease that arrived after the queue drained must not leak
+        if lease.in_flight == 0 and not self._task_queues.get(shape):
+            asyncio.get_running_loop().create_task(self._maybe_release(lease))
+
+    async def _lease_target(self, spec: TaskSpec) -> Address:
+        if isinstance(spec.strategy, PlacementGroupStrategy):
+            pg = await self.clients.get(self.controller_addr).call(
+                "pg_get", {"pg_id_hex": spec.strategy.pg_id_hex}
+            )
+            if pg is None or pg["state"] != "CREATED":
+                raise RuntimeError("placement group not ready")
+            index = spec.strategy.bundle_index
+            if index < 0:
+                index = 0
+                spec.strategy.bundle_index = 0
+            node_hex = pg["assignment"][index]
+            views = await self.clients.get(self.controller_addr).call("node_views")
+            for v in views:
+                if v["node_id_hex"] == node_hex:
+                    return tuple(v["address"])
+            raise RuntimeError("placement group node not found")
+        if self.supervisor_addr is not None:
+            return self.supervisor_addr
+        views = await self.clients.get(self.controller_addr).call("node_views")
+        alive = [v for v in views if v["alive"]]
+        if not alive:
+            raise RuntimeError("no alive nodes")
+        return tuple(alive[0]["address"])
+
+    async def _push(self, task: _PendingTask, lease: _Lease) -> None:
+        spec = task.spec
+        try:
+            await self.clients.get(lease.worker_addr).call(
+                "push_task", {"spec": serialization.dumps(spec)}, timeout=24 * 3600
+            )
+            self._record_event(spec, "PUSHED")
+        except (RpcConnectionError, RemoteError) as e:
+            await self._on_push_failure(task, lease, e)
+
+    async def _on_push_failure(self, task: _PendingTask, lease: _Lease, err) -> None:
+        lease.broken = True
+        await self._drop_lease(lease)
+        if task.retries_left != 0 and task.spec.task_id in self._inflight_tasks:
+            task.retries_left -= 1
+            task.lease = None
+            shape = self._shape_key(task.spec)
+            self._task_queues.setdefault(shape, deque()).append(task)
+            await self._pump_shape(shape, task.spec)
+        else:
+            self._fail_task(task.spec, WorkerCrashedError(str(err)))
+            self._inflight_tasks.pop(task.spec.task_id, None)
+
+    async def _drop_lease(self, lease: _Lease) -> None:
+        leases = self._leases.get(lease.shape_key, [])
+        if lease in leases:
+            leases.remove(lease)
+        try:
+            await self.clients.get(lease.supervisor_addr).call(
+                "release_lease", {"lease_id": lease.lease_id}, timeout=5
+            )
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------- owner RPCs
+
+    async def rpc_task_done(self, body) -> None:
+        _trace(f"task_done received {body.get('task_id', b'').hex()[:12]} err={body.get('error') is not None}")
+        """Executor reports task completion to the owner
+        (return values inline if small, else arena locations)."""
+        task_id = TaskID(body["task_id"])
+        task = self._inflight_tasks.get(task_id)
+        spec = task.spec if task else None
+        if body.get("error") is not None:
+            err = serialization.loads(body["error"])
+            retryable = body.get("retryable", False)
+            if (
+                task is not None
+                and retryable
+                and task.retries_left != 0
+            ):
+                task.retries_left -= 1
+                await self._requeue(task)
+                return
+            if spec is not None:
+                self._fail_task(spec, err)
+        else:
+            for oid_raw, kind, payload in body["results"]:
+                oid = ObjectID(oid_raw)
+                entry = self._ensure_entry(oid)
+                if kind == "inline":
+                    self.in_process.put(oid, payload)
+                    entry.state = INLINE
+                    entry.size = len(payload)
+                else:  # shared
+                    entry.state = SHARED
+                    entry.size = payload["size"]
+                    entry.location = tuple(payload["node_addr"])
+                self._wake(entry)
+            if spec is not None:
+                self._record_event(spec, "FINISHED")
+        if task is not None:
+            self._inflight_tasks.pop(task_id, None)
+            self._unpin_arg_refs(spec)
+            lease = task.lease
+            if lease is not None:
+                lease.in_flight -= 1
+                await self._pump_shape(lease.shape_key, spec)
+                if lease.in_flight == 0 and not self._task_queues.get(lease.shape_key):
+                    asyncio.get_running_loop().create_task(self._maybe_release(lease))
+
+    async def _maybe_release(self, lease: _Lease) -> None:
+        await asyncio.sleep(1.0)  # linger for reuse
+        if lease.in_flight == 0 and not self._task_queues.get(lease.shape_key):
+            await self._drop_lease(lease)
+
+    async def _requeue(self, task: _PendingTask) -> None:
+        lease = task.lease
+        if lease is not None:
+            lease.in_flight -= 1
+        task.lease = None
+        shape = self._shape_key(task.spec)
+        self._record_event(task.spec, "RETRY")
+        self._task_queues.setdefault(shape, deque()).append(task)
+        await self._pump_shape(shape, task.spec)
+
+    async def rpc_worker_failed(self, body) -> None:
+        """Supervisor notifies: a worker leased to us died."""
+        dead_hex = body["worker_id_hex"]
+        for shape, leases in self._leases.items():
+            for lease in list(leases):
+                if lease.worker_id_hex == dead_hex:
+                    lease.broken = True
+                    leases.remove(lease)
+                    # retry or fail the tasks in flight on that worker
+                    for task in list(self._inflight_tasks.values()):
+                        if task.lease is lease:
+                            if task.retries_left != 0:
+                                task.retries_left -= 1
+                                await self._requeue(task)
+                            else:
+                                self._fail_task(
+                                    task.spec,
+                                    WorkerCrashedError(
+                                        f"worker {dead_hex[:8]} died (exit "
+                                        f"{body.get('exitcode')})"
+                                    ),
+                                )
+                                self._inflight_tasks.pop(task.spec.task_id, None)
+
+    async def rpc_get_object(self, body):
+        """Remote reader resolves one of our owned objects."""
+        oid = ObjectID(body["object_id"])
+        entry = self.objects.get(oid)
+        if entry is None:
+            return {"status": "unknown"}
+        if entry.state == PENDING:
+            return {"status": "pending"}
+        if entry.state == FAILED:
+            return {"status": "error", "error": serialization.dumps(entry.error)}
+        if entry.state == INLINE:
+            return {"status": "value", "value": self.in_process.get(oid)}
+        return {
+            "status": "location",
+            "size": entry.size,
+            "node_addr": entry.location,
+        }
+
+    async def rpc_add_borrow(self, body) -> None:
+        entry = self.objects.get(ObjectID(body["object_id"]))
+        if entry is not None:
+            entry.borrows += 1
+
+    async def rpc_release_borrow(self, body) -> None:
+        entry = self.objects.get(ObjectID(body["object_id"]))
+        if entry is not None:
+            entry.borrows = max(0, entry.borrows - 1)
+            self._maybe_free(entry)
+
+    async def rpc_on_publish(self, body) -> None:
+        channel = body["channel"]
+        message = body["message"]
+        if channel.startswith("actor:"):
+            self._on_actor_update(channel[len("actor:") :], message)
+        for handler in self._pub_handlers.get(channel, []):
+            try:
+                handler(message)
+            except Exception:
+                logger.exception("pubsub handler failed for %s", channel)
+
+    async def rpc_ping(self, body=None) -> str:
+        return "pong"
+
+    def subscribe(self, channel: str, handler: Callable) -> None:
+        self._pub_handlers.setdefault(channel, []).append(handler)
+        self._run(
+            self.clients.get(self.controller_addr).call(
+                "subscribe", {"channel": channel, "address": self.address}
+            )
+        )
+
+    # ------------------------------------------------------------- objects
+
+    def _ensure_entry(self, oid: ObjectID) -> ObjectEntry:
+        entry = self.objects.get(oid)
+        if entry is None:
+            entry = ObjectEntry(oid, event=asyncio.Event())
+            self.objects[oid] = entry
+        return entry
+
+    def _wake(self, entry: ObjectEntry) -> None:
+        if entry.event is not None:
+            entry.event.set()
+
+    def _fail_task(self, spec: TaskSpec, err: Exception) -> None:
+        self._record_event(spec, "FAILED")
+        for oid in spec.return_ids():
+            entry = self._ensure_entry(oid)
+            entry.state = FAILED
+            entry.error = err
+            self._wake(entry)
+        self._unpin_arg_refs(spec)
+
+    def _pin_arg_refs(self, spec: TaskSpec) -> None:
+        for arg in spec.args:
+            if arg.kind == ArgKind.REF:
+                entry = self.objects.get(arg.object_id)
+                if entry is not None:
+                    entry.task_pins += 1
+
+    def _unpin_arg_refs(self, spec: Optional[TaskSpec]) -> None:
+        if spec is None:
+            return
+        for arg in spec.args:
+            if arg.kind == ArgKind.REF:
+                entry = self.objects.get(arg.object_id)
+                if entry is not None:
+                    entry.task_pins = max(0, entry.task_pins - 1)
+                    self._maybe_free(entry)
+
+    def put(self, value: Any) -> Tuple[ObjectID, Address]:
+        oid = ObjectID.from_put()
+        packed = serialization.pack(value)
+        entry = self._run(self._async_store_owned(oid, packed))
+        return oid, self.address
+
+    async def _async_store_owned(self, oid: ObjectID, packed: bytes) -> ObjectEntry:
+        entry = self._ensure_entry(oid)
+        if len(packed) <= self.config.max_direct_call_object_size or (
+            self.supervisor_addr is None
+        ):
+            self.in_process.put(oid, packed)
+            entry.state = INLINE
+            entry.size = len(packed)
+        else:
+            sup = self.clients.get(self.supervisor_addr)
+            r = await sup.call("store_create", {"object_id": oid.binary(), "size": len(packed)})
+            self.arena.write(r["offset"], packed)
+            await sup.call("store_seal", {"object_id": oid.binary()})
+            entry.state = SHARED
+            entry.size = len(packed)
+            entry.location = self.supervisor_addr
+        self._wake(entry)
+        return entry
+
+    def get(self, refs: Sequence["ObjectRefLike"], timeout: Optional[float] = None) -> List[Any]:
+        return self._run(
+            self._async_get_many(refs, timeout),
+            timeout=None if timeout is None else timeout + 10,
+        )
+
+    async def _async_get_many(self, refs, timeout) -> List[Any]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        return list(
+            await asyncio.gather(
+                *(self._async_get_one(r._object_id, r._owner_addr, deadline) for r in refs)
+            )
+        )
+
+    async def _async_get_one(self, oid: ObjectID, owner: Address, deadline) -> Any:
+        if tuple(owner) == tuple(self.address):
+            return await self._get_owned(oid, deadline)
+        return await self._get_remote(oid, owner, deadline)
+
+    async def _get_owned(self, oid: ObjectID, deadline) -> Any:
+        entry = self._ensure_entry(oid)
+        while entry.state == PENDING:
+            entry.event.clear()
+            try:
+                await asyncio.wait_for(
+                    entry.event.wait(),
+                    None if deadline is None else max(0.01, deadline - time.monotonic()),
+                )
+            except asyncio.TimeoutError:
+                raise GetTimeoutError(f"get timed out for {oid.hex()[:16]}")
+        if entry.state == FAILED:
+            raise entry.error
+        if entry.state == INLINE:
+            return serialization.unpack(self.in_process.get(oid))
+        return await self._read_shared(oid, entry.size, entry.location)
+
+    async def _get_remote(self, oid: ObjectID, owner: Address, deadline) -> Any:
+        delay = 0.005
+        while True:
+            try:
+                r = await self.clients.get(owner).call(
+                    "get_object", {"object_id": oid.binary()}
+                )
+            except RpcConnectionError:
+                raise ObjectLostError(oid.hex(), "owner process is gone")
+            status = r["status"]
+            if status == "value":
+                return serialization.unpack(r["value"])
+            if status == "location":
+                return await self._read_shared(oid, r["size"], tuple(r["node_addr"]))
+            if status == "error":
+                raise serialization.loads(r["error"])
+            if status == "unknown":
+                raise ObjectLostError(oid.hex(), "owner does not know this object")
+            if deadline is not None and time.monotonic() > deadline:
+                raise GetTimeoutError(f"get timed out for {oid.hex()[:16]}")
+            await asyncio.sleep(delay)
+            delay = min(delay * 2, 0.2)
+
+    async def _read_shared(self, oid: ObjectID, size: int, node_addr: Address) -> Any:
+        sup = self.clients.get(self.supervisor_addr or node_addr)
+        if self.supervisor_addr is not None and tuple(node_addr) != tuple(self.supervisor_addr):
+            await sup.call(
+                "pull_object",
+                {"object_id": oid.binary(), "from": node_addr, "size": size},
+                timeout=600,
+            )
+        # pin so the range cannot be spilled/recycled between the locate reply
+        # and our copy out of the mmap
+        loc = await sup.call("store_locate", {"object_id": oid.binary(), "pin": True})
+        if loc is None:
+            raise ObjectLostError(oid.hex(), "not in local store")
+        if self.arena is not None and self.supervisor_addr is not None:
+            try:
+                data = bytes(self.arena.view(loc["offset"], loc["size"]))
+            finally:
+                await sup.notify("store_unpin", {"object_id": oid.binary()})
+        else:
+            # no local arena (e.g. detached utility process): stream chunks
+            try:
+                pos = 0
+                chunks = []
+                while pos < size:
+                    c = await sup.call(
+                        "store_read_chunk",
+                        {
+                            "object_id": oid.binary(),
+                            "offset": pos,
+                            "length": self.config.object_transfer_chunk_bytes,
+                        },
+                    )
+                    chunks.append(c)
+                    pos += len(c)
+                data = b"".join(chunks)
+            finally:
+                await sup.notify("store_unpin", {"object_id": oid.binary()})
+        return serialization.unpack(data)
+
+    def wait(
+        self, refs, num_returns: int = 1, timeout: Optional[float] = None
+    ) -> Tuple[list, list]:
+        return self._run(self._async_wait(refs, num_returns, timeout))
+
+    async def _async_wait(self, refs, num_returns, timeout):
+        deadline = None if timeout is None else time.monotonic() + timeout
+
+        async def ready(r) -> bool:
+            oid, owner = r._object_id, r._owner_addr
+            if tuple(owner) == tuple(self.address):
+                e = self.objects.get(oid)
+                return e is not None and e.state != PENDING
+            try:
+                resp = await self.clients.get(owner).call(
+                    "get_object", {"object_id": oid.binary()}
+                )
+                return resp["status"] in ("value", "location", "error")
+            except Exception:
+                return True  # owner gone → resolved (to an error) at get
+
+        done, not_done = [], list(refs)
+        while True:
+            still = []
+            for r in not_done:
+                if await ready(r):
+                    done.append(r)
+                else:
+                    still.append(r)
+            not_done = still
+            if len(done) >= num_returns or not not_done:
+                return done, not_done
+            if deadline is not None and time.monotonic() > deadline:
+                return done, not_done
+            await asyncio.sleep(0.01)
+
+    # ---- ref counting ----
+
+    def add_local_ref(self, oid: ObjectID, owner: Address) -> None:
+        if self.address is not None and tuple(owner) == tuple(self.address):
+            entry = self._ensure_entry(oid)
+            entry.local_refs += 1
+
+    def remove_local_ref(self, oid: ObjectID, owner: Address) -> None:
+        if self._shutdown or self.address is None:
+            return
+        if tuple(owner) == tuple(self.address):
+            def dec():
+                entry = self.objects.get(oid)
+                if entry is not None:
+                    entry.local_refs = max(0, entry.local_refs - 1)
+                    self._maybe_free(entry)
+
+            try:
+                self.loop.call_soon_threadsafe(dec)
+            except RuntimeError:
+                pass
+        else:
+            async def notify():
+                try:
+                    await self.clients.get(owner).notify(
+                        "release_borrow", {"object_id": oid.binary()}
+                    )
+                except Exception:
+                    pass
+
+            try:
+                asyncio.run_coroutine_threadsafe(notify(), self.loop)
+            except RuntimeError:
+                pass
+
+    def _maybe_free(self, entry: ObjectEntry) -> None:
+        if (
+            entry.local_refs <= 0
+            and entry.borrows <= 0
+            and entry.task_pins <= 0
+            and entry.state in (INLINE, SHARED, FAILED)
+        ):
+            oid = entry.object_id
+            self.objects.pop(oid, None)
+            self.in_process.free(oid)
+            if entry.state == SHARED and entry.location is not None:
+                async def free_remote():
+                    try:
+                        await self.clients.get(entry.location).notify(
+                            "store_free", {"object_ids": [oid.binary()]}
+                        )
+                    except Exception:
+                        pass
+
+                asyncio.get_running_loop().create_task(free_remote())
+
+    # ------------------------------------------------------------- actors
+
+    def create_actor(
+        self,
+        cls: Any,
+        args,
+        kwargs,
+        *,
+        name: str = "",
+        namespace: str = "default",
+        resources: Optional[Dict[str, float]] = None,
+        strategy: Optional[SchedulingStrategy] = None,
+        max_restarts: int = 0,
+        max_task_retries: int = 0,
+        max_concurrency: int = 1,
+        is_async: bool = False,
+        runtime_env: Optional[Dict[str, Any]] = None,
+        detached: bool = False,
+        class_name: str = "",
+    ) -> Tuple[ActorID, TaskID]:
+        actor_id = ActorID.of(self.job_id)
+        blob = serialization.dumps(cls)
+        key = hashlib.sha256(blob).hexdigest()
+        self._register_function(key, blob)
+        spec = TaskSpec(
+            task_id=TaskID.for_actor_creation(actor_id),
+            job_id=self.job_id,
+            kind=TaskKind.ACTOR_CREATION,
+            name=f"{class_name}.__init__",
+            function_key=key,
+            args=self.build_args(args, kwargs),
+            num_returns=1,
+            resources={"CPU": 1.0} if resources is None else dict(resources),
+            strategy=strategy or SchedulingStrategy(),
+            owner=self.address,
+            runtime_env=runtime_env,
+            actor_id=actor_id,
+            max_restarts=max_restarts,
+            max_task_retries=max_task_retries,
+            max_concurrency=max_concurrency,
+            is_async_actor=is_async,
+        )
+        self._run(self._async_create_actor(spec, name, namespace, detached, class_name))
+        return actor_id, spec.task_id
+
+    async def _async_create_actor(
+        self, spec: TaskSpec, name: str, namespace: str, detached: bool, class_name: str
+    ) -> None:
+        hexid = spec.actor_id.hex()
+        await self.clients.get(self.controller_addr).call(
+            "actor_register",
+            {
+                "actor_id_hex": hexid,
+                "name": name,
+                "namespace": namespace,
+                "owner": self.address,
+                "max_restarts": spec.max_restarts,
+                "creation_spec": serialization.dumps(spec),
+                "class_name": class_name,
+                "job_id_hex": self.job_id.hex(),
+                "detached": detached,
+            },
+        )
+        state = ActorHandleState(spec.actor_id, caller_id=os.urandom(8).hex())
+        self._actor_states[hexid] = state
+        await self.clients.get(self.controller_addr).call(
+            "subscribe", {"channel": "actor:" + hexid, "address": self.address}
+        )
+        for oid in spec.return_ids():
+            self._ensure_entry(oid)
+        pending = _PendingTask(spec, retries_left=0)
+        self._inflight_tasks[spec.task_id] = pending
+        asyncio.get_running_loop().create_task(self._create_actor_flow(spec, pending))
+
+    async def _create_actor_flow(self, spec: TaskSpec, pending: _PendingTask) -> None:
+        try:
+            target = await self._lease_target(spec)
+            hops = 0
+            while True:
+                grant = await self.clients.get(target).call(
+                    "request_lease",
+                    {"spec": serialization.dumps(spec), "hops": hops},
+                    timeout=self.config.worker_lease_timeout_s + 3600,
+                )
+                if grant.get("granted"):
+                    break
+                if grant.get("retry_at"):
+                    target = tuple(grant["retry_at"])
+                    hops = grant.get("hops", hops + 1)
+                    continue
+                raise RuntimeError(grant.get("error", "lease rejected"))
+            await self.clients.get(target).call(
+                "worker_set_actor",
+                {
+                    "worker_id_hex": grant["worker_id_hex"],
+                    "actor_id_hex": spec.actor_id.hex(),
+                },
+            )
+            await self.clients.get(tuple(grant["worker_address"])).call(
+                "push_task", {"spec": serialization.dumps(spec)}, timeout=3600
+            )
+        except Exception as e:
+            self._fail_task(spec, ActorDiedError(spec.actor_id.hex(), f"creation failed: {e}"))
+            self._inflight_tasks.pop(spec.task_id, None)
+            try:
+                await self.clients.get(self.controller_addr).call(
+                    "actor_creation_failed",
+                    {"actor_id_hex": spec.actor_id.hex(), "reason": str(e)},
+                )
+            except Exception:
+                pass
+
+    def _on_actor_update(self, actor_hex: str, message: dict) -> None:
+        _trace(f"actor_update {actor_hex[:8]} {message}")
+        state = self._actor_states.get(actor_hex)
+        if state is None:
+            return
+        new_state = message.get("state")
+        if new_state == "ALIVE":
+            state.address = tuple(message["address"])
+            inc = message.get("incarnation", 0)
+            if state.incarnation == -1:
+                # first sighting: adopt the incarnation, keep our seqno stream
+                state.incarnation = inc
+            elif inc != state.incarnation:
+                # actor restarted on a fresh worker (executor ordering state
+                # reset there), so the handle's sequence stream restarts too
+                state.incarnation = inc
+                state.seqno = 0
+            state.dead = False
+        elif new_state == "RESTARTING":
+            state.address = None
+            self._fail_inflight_actor_tasks(actor_hex, restarting=True)
+        elif new_state == "DEAD":
+            state.dead = True
+            state.death_reason = message.get("reason", "")
+            state.address = None
+            self._fail_inflight_actor_tasks(actor_hex, restarting=False)
+        ev = self._actor_events.get(actor_hex)
+        if ev is not None:
+            ev.set()
+
+    def _fail_inflight_actor_tasks(self, actor_hex: str, restarting: bool) -> None:
+        """Tasks pushed to a now-dead incarnation will never complete: fail
+        them, or resubmit when max_task_retries allows (actor.py:75-129
+        semantics)."""
+        state = self._actor_states.get(actor_hex)
+        for task in list(self._inflight_tasks.values()):
+            spec = task.spec
+            if (
+                spec.kind != TaskKind.ACTOR_TASK
+                or spec.actor_id is None
+                or spec.actor_id.hex() != actor_hex
+            ):
+                continue
+            self._inflight_tasks.pop(spec.task_id, None)
+            if restarting and task.retries_left != 0 and state is not None:
+                task.retries_left -= 1
+                self._inflight_tasks[spec.task_id] = task
+                asyncio.get_running_loop().create_task(
+                    self._actor_resubmit(task, state)
+                )
+            else:
+                reason = (
+                    "actor restarting; task lost (set max_task_retries to retry)"
+                    if restarting
+                    else (state.death_reason if state else "actor died")
+                )
+                self._fail_task(spec, ActorDiedError(actor_hex, reason))
+
+    async def _actor_resubmit(self, task: _PendingTask, state: ActorHandleState) -> None:
+        await self._await_actor_alive(state, time.monotonic() + 600)
+        task.spec.seqno = state.seqno
+        state.seqno += 1
+        await self._actor_push(task, state)
+
+    async def actor_state(self, actor_id: ActorID) -> ActorHandleState:
+        hexid = actor_id.hex()
+        state = self._actor_states.get(hexid)
+        if state is None:
+            state = ActorHandleState(actor_id, caller_id=os.urandom(8).hex())
+            self._actor_states[hexid] = state
+            await self.clients.get(self.controller_addr).call(
+                "subscribe", {"channel": "actor:" + hexid, "address": self.address}
+            )
+        return state
+
+    def submit_actor_task(
+        self,
+        actor_id: ActorID,
+        method_name: str,
+        args,
+        kwargs,
+        *,
+        num_returns: int = 1,
+        max_task_retries: int = 0,
+    ) -> List[ObjectID]:
+        spec = TaskSpec(
+            task_id=TaskID.from_random(),
+            job_id=self.job_id,
+            kind=TaskKind.ACTOR_TASK,
+            name=method_name,
+            function_key="",
+            args=self.build_args(args, kwargs),
+            num_returns=num_returns,
+            owner=self.address,
+            actor_id=actor_id,
+            method_name=method_name,
+            max_retries=max_task_retries,
+        )
+        return_ids = spec.return_ids()
+        self._run(self._async_submit_actor_task(spec))
+        return return_ids
+
+    async def _async_submit_actor_task(self, spec: TaskSpec) -> None:
+        _trace(f"submit_actor_task {spec.name} seq? actor={spec.actor_id.hex()[:8]}")
+        for oid in spec.return_ids():
+            self._ensure_entry(oid)
+        self._pin_arg_refs(spec)
+        state = await self.actor_state(spec.actor_id)
+        spec.seqno = state.seqno
+        state.seqno += 1
+        pending = _PendingTask(spec, retries_left=spec.max_retries)
+        self._inflight_tasks[spec.task_id] = pending
+        asyncio.get_running_loop().create_task(self._actor_push(pending, state))
+
+    async def _actor_push(self, pending: _PendingTask, state: ActorHandleState) -> None:
+        spec = pending.spec
+        _trace(f"actor_push start {spec.name} seqno={spec.seqno} addr={state.address} dead={state.dead}")
+        deadline = time.monotonic() + 600
+        while True:
+            if state.dead:
+                self._fail_task(
+                    spec, ActorDiedError(state.actor_id.hex(), state.death_reason)
+                )
+                self._inflight_tasks.pop(spec.task_id, None)
+                return
+            addr = state.address
+            if addr is None:
+                await self._await_actor_alive(state, deadline)
+                continue
+            try:
+                spec.caller_id = state.caller_id  # type: ignore[attr-defined]
+                await self.clients.get(addr).call(
+                    "push_task", {"spec": serialization.dumps(spec)}, timeout=24 * 3600
+                )
+                _trace(f"actor_push pushed {spec.name} seqno={spec.seqno} to {addr}")
+                return
+            except (RpcConnectionError, RemoteError) as push_err:
+                _trace(f"actor_push error {spec.name}: {push_err!r}")
+                # actor may be restarting; refresh state from the controller
+                rec = await self.clients.get(self.controller_addr).call(
+                    "actor_get", {"actor_id_hex": spec.actor_id.hex()}
+                )
+                if rec is None or rec["state"] == "DEAD":
+                    state.dead = True
+                    state.death_reason = (rec or {}).get("death_cause", "unknown")
+                    continue
+                if rec["state"] == "ALIVE" and tuple(rec["address"]) != addr:
+                    self._on_actor_update(
+                        spec.actor_id.hex(),
+                        {
+                            "state": "ALIVE",
+                            "address": rec["address"],
+                            "incarnation": rec["incarnation"],
+                        },
+                    )
+                    if pending.retries_left == 0:
+                        self._fail_task(
+                            spec,
+                            ActorDiedError(
+                                spec.actor_id.hex(), "actor restarted; task lost"
+                            ),
+                        )
+                        self._inflight_tasks.pop(spec.task_id, None)
+                        return
+                    pending.retries_left -= 1
+                    spec.seqno = state.seqno
+                    state.seqno += 1
+                    continue
+                state.address = None
+                if time.monotonic() > deadline:
+                    self._fail_task(
+                        spec, ActorDiedError(spec.actor_id.hex(), "unreachable")
+                    )
+                    self._inflight_tasks.pop(spec.task_id, None)
+                    return
+
+    async def _await_actor_alive(self, state: ActorHandleState, deadline) -> None:
+        hexid = state.actor_id.hex()
+        ev = self._actor_events.get(hexid)
+        if ev is None:
+            ev = asyncio.Event()
+            self._actor_events[hexid] = ev
+        ev.clear()
+        # double-check via controller in case we missed the publish
+        rec = await self.clients.get(self.controller_addr).call(
+            "actor_get", {"actor_id_hex": hexid}
+        )
+        if rec is not None:
+            if rec["state"] == "ALIVE" and rec.get("address"):
+                self._on_actor_update(
+                    hexid,
+                    {
+                        "state": "ALIVE",
+                        "address": rec["address"],
+                        "incarnation": rec["incarnation"],
+                    },
+                )
+                return
+            if rec["state"] == "DEAD":
+                self._on_actor_update(hexid, {"state": "DEAD", "reason": rec["death_cause"]})
+                return
+        try:
+            await asyncio.wait_for(ev.wait(), timeout=max(0.5, deadline - time.monotonic()))
+        except asyncio.TimeoutError:
+            pass
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        self._run(
+            self.clients.get(self.controller_addr).call(
+                "actor_kill",
+                {"actor_id_hex": actor_id.hex(), "no_restart": no_restart},
+            )
+        )
+
+    # ------------------------------------------------------------- events
+
+    def _record_event(self, spec: TaskSpec, state: str) -> None:
+        self._task_events.append(
+            {
+                "task_id": spec.task_id.hex(),
+                "name": spec.name,
+                "state": state,
+                "ts": time.time(),
+                "job_id": spec.job_id.hex(),
+                "kind": spec.kind.name,
+                "node": self.node_id_hex,
+            }
+        )
+        if len(self._task_events) >= 100:
+            events = list(self._task_events)
+            self._task_events.clear()
+            asyncio.get_running_loop().create_task(self._flush_events(events))
+
+    async def _flush_events(self, events) -> None:
+        try:
+            await self.clients.get(self.controller_addr).notify(
+                "task_events", {"events": events}
+            )
+        except Exception:
+            pass
+
+
+class _RefPlaceholder:
+    """Marks where a top-level ObjectRef argument goes in the unpacked args."""
+
+    __slots__ = ("index",)
+
+    def __init__(self, index: int):
+        self.index = index

@@ -1,0 +1,334 @@
+"""Node-local shared-memory object store.
+
+TPU-native analog of the reference's plasma store
+(`src/ray/object_manager/plasma/store.h`, allocator `plasma_allocator.h` /
+`dlmalloc.cc`, lifecycle `object_lifecycle_manager.h`): one immutable-object
+arena per host, shared between the supervisor and every worker/driver process
+on that host.
+
+Design:
+  * Backing is a single sparse file in /dev/shm, mmapped by every process that
+    touches objects (`ArenaFile`). One mapping per process for its lifetime —
+    no per-object mmap churn, no resource-tracker interference.
+  * The supervisor owns allocation metadata (`NodeObjectStore`): a first-fit
+    free-list allocator with coalescing (stand-in for the dlmalloc arena; the
+    C++ allocator in src/ replaces it without changing the protocol).
+  * Clients create (RPC → offset), write payload bytes directly into the
+    mapping, then seal. Reads locate (RPC → offset,size) and copy out of the
+    mapping during deserialization. Copy-on-read keeps eviction/spilling free
+    of dangling-view hazards; pin-based zero-copy is a later optimization.
+  * Spilling under memory pressure moves sealed, unreferenced objects to disk
+    (analog of `external_storage.py:185`), restored on demand.
+
+Host RAM only: device arrays never transit this store — they stay in HBM
+inside the owning process and move over ICI via XLA collectives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import mmap
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._private.ids import ObjectID
+
+PAGE = 4096
+
+
+def _align(n: int) -> int:
+    return (n + PAGE - 1) // PAGE * PAGE
+
+
+class ArenaFile:
+    """A process-local mmap of the node's object arena."""
+
+    def __init__(self, path: str, size: int, create: bool = False):
+        self.path = path
+        self.size = size
+        flags = os.O_RDWR | (os.O_CREAT if create else 0)
+        fd = os.open(path, flags, 0o600)
+        try:
+            if create:
+                os.ftruncate(fd, size)
+            self._mm = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+
+    def view(self, offset: int, length: int) -> memoryview:
+        return memoryview(self._mm)[offset : offset + length]
+
+    def write(self, offset: int, data) -> None:
+        self._mm[offset : offset + len(data)] = data
+
+    def close(self) -> None:
+        try:
+            self._mm.close()
+        except Exception:
+            pass
+
+    def unlink(self) -> None:
+        self.close()
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class OutOfMemoryError(Exception):
+    pass
+
+
+class _FreeList:
+    """First-fit free-list allocator over [0, capacity) with coalescing."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        # sorted list of (offset, size) free ranges
+        self._free: List[Tuple[int, int]] = [(0, capacity)]
+
+    def alloc(self, size: int) -> Optional[int]:
+        size = _align(size)
+        for i, (off, sz) in enumerate(self._free):
+            if sz >= size:
+                if sz == size:
+                    self._free.pop(i)
+                else:
+                    self._free[i] = (off + size, sz - size)
+                return off
+        return None
+
+    def free(self, offset: int, size: int) -> None:
+        size = _align(size)
+        # insert sorted, coalesce neighbors
+        lo, hi = 0, len(self._free)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if self._free[mid][0] < offset:
+                lo = mid + 1
+            else:
+                hi = mid
+        self._free.insert(lo, (offset, size))
+        merged: List[Tuple[int, int]] = []
+        for off, sz in self._free:
+            if merged and merged[-1][0] + merged[-1][1] == off:
+                merged[-1] = (merged[-1][0], merged[-1][1] + sz)
+            else:
+                merged.append((off, sz))
+        self._free = merged
+
+    def free_bytes(self) -> int:
+        return sum(sz for _, sz in self._free)
+
+
+IN_MEMORY = "IN_MEMORY"
+SPILLED = "SPILLED"
+CREATING = "CREATING"
+
+
+@dataclasses.dataclass
+class ObjectMeta:
+    object_id: ObjectID
+    size: int
+    state: str = CREATING
+    offset: int = -1
+    spill_path: str = ""
+    last_access: float = 0.0
+    freed: bool = False  # owner released it; eligible for deletion
+    pins: int = 0  # readers copying out of the arena; blocks spill/free
+
+
+class NodeObjectStore:
+    """Supervisor-side object index + allocator (single-threaded: runs on the
+    supervisor's event loop)."""
+
+    def __init__(self, arena_path: str, capacity: int, spill_dir: str):
+        self.capacity = capacity
+        self.arena = ArenaFile(arena_path, capacity, create=True)
+        self._alloc = _FreeList(capacity)
+        self._objects: Dict[ObjectID, ObjectMeta] = {}
+        self._spill_dir = spill_dir
+        os.makedirs(spill_dir, exist_ok=True)
+        self.num_spilled = 0
+        self.num_restored = 0
+
+    # ---- creation ----
+
+    def create(self, object_id: ObjectID, size: int) -> int:
+        """Reserve space; returns arena offset. Spills/evicts under pressure."""
+        if object_id in self._objects:
+            meta = self._objects[object_id]
+            if meta.state != CREATING:
+                raise ValueError(f"object {object_id.hex()} already exists")
+            return meta.offset
+        offset = self._alloc.alloc(size)
+        if offset is None:
+            self._make_room(size)
+            offset = self._alloc.alloc(size)
+            if offset is None:
+                raise OutOfMemoryError(
+                    f"object store full: need {size}, free {self._alloc.free_bytes()}"
+                )
+        self._objects[object_id] = ObjectMeta(
+            object_id, size, CREATING, offset, last_access=time.monotonic()
+        )
+        return offset
+
+    def seal(self, object_id: ObjectID) -> None:
+        meta = self._objects.get(object_id)
+        if meta is None:
+            raise KeyError(f"seal of unknown object {object_id.hex()}")
+        meta.state = IN_MEMORY
+        meta.last_access = time.monotonic()
+
+    def abort(self, object_id: ObjectID) -> None:
+        meta = self._objects.pop(object_id, None)
+        if meta is not None and meta.offset >= 0:
+            self._alloc.free(meta.offset, meta.size)
+
+    # ---- reads ----
+
+    def contains(self, object_id: ObjectID) -> bool:
+        m = self._objects.get(object_id)
+        return m is not None and m.state in (IN_MEMORY, SPILLED)
+
+    def locate(self, object_id: ObjectID, pin: bool = False) -> Optional[Tuple[int, int]]:
+        """Return (offset, size), restoring from spill if needed.
+
+        With pin=True the range is protected from spill/free until unpin() —
+        readers copy out of their own mmap after the RPC returns, so the
+        window between locate and copy must not recycle the range
+        (≈ plasma's get/release pinning).
+        """
+        meta = self._objects.get(object_id)
+        if meta is None or meta.state == CREATING:
+            return None
+        if meta.state == SPILLED:
+            self._restore(meta)
+        meta.last_access = time.monotonic()
+        if pin:
+            meta.pins += 1
+        return (meta.offset, meta.size)
+
+    def unpin(self, object_id: ObjectID) -> None:
+        meta = self._objects.get(object_id)
+        if meta is None:
+            return
+        meta.pins = max(0, meta.pins - 1)
+        if meta.freed and meta.pins == 0:
+            self.free(object_id)
+
+    def read_chunk(self, object_id: ObjectID, offset: int, length: int) -> bytes:
+        loc = self.locate(object_id)
+        if loc is None:
+            raise KeyError(f"object {object_id.hex()} not in store")
+        base, size = loc
+        length = min(length, size - offset)
+        return bytes(self.arena.view(base + offset, length))
+
+    # ---- lifecycle ----
+
+    def free(self, object_id: ObjectID) -> None:
+        """Owner released the object: delete its data (deferred while pinned)."""
+        meta = self._objects.get(object_id)
+        if meta is None:
+            return
+        if meta.pins > 0:
+            meta.freed = True
+            return
+        self._objects.pop(object_id, None)
+        if meta.state == SPILLED and meta.spill_path:
+            try:
+                os.unlink(meta.spill_path)
+            except OSError:
+                pass
+        elif meta.offset >= 0:
+            self._alloc.free(meta.offset, meta.size)
+
+    def _make_room(self, need: int) -> None:
+        """Spill least-recently-used sealed objects until `need` fits."""
+        candidates = sorted(
+            (
+                m
+                for m in self._objects.values()
+                if m.state == IN_MEMORY and m.pins == 0
+            ),
+            key=lambda m: m.last_access,
+        )
+        for meta in candidates:
+            if self._alloc.free_bytes() >= _align(need):
+                return
+            self._spill(meta)
+
+    def _spill(self, meta: ObjectMeta) -> None:
+        path = os.path.join(self._spill_dir, meta.object_id.hex())
+        with open(path, "wb") as f:
+            f.write(self.arena.view(meta.offset, meta.size))
+        self._alloc.free(meta.offset, meta.size)
+        meta.offset = -1
+        meta.spill_path = path
+        meta.state = SPILLED
+        self.num_spilled += 1
+
+    def _restore(self, meta: ObjectMeta) -> None:
+        offset = self._alloc.alloc(meta.size)
+        if offset is None:
+            self._make_room(meta.size)
+            offset = self._alloc.alloc(meta.size)
+            if offset is None:
+                raise OutOfMemoryError("cannot restore spilled object: store full")
+        with open(meta.spill_path, "rb") as f:
+            self.arena.write(offset, f.read())
+        try:
+            os.unlink(meta.spill_path)
+        except OSError:
+            pass
+        meta.offset = offset
+        meta.spill_path = ""
+        meta.state = IN_MEMORY
+        self.num_restored += 1
+
+    def stats(self) -> Dict[str, float]:
+        in_mem = sum(1 for m in self._objects.values() if m.state == IN_MEMORY)
+        spilled = sum(1 for m in self._objects.values() if m.state == SPILLED)
+        return {
+            "capacity": self.capacity,
+            "free_bytes": self._alloc.free_bytes(),
+            "num_objects": len(self._objects),
+            "num_in_memory": in_mem,
+            "num_spilled_now": spilled,
+            "total_spills": self.num_spilled,
+            "total_restores": self.num_restored,
+        }
+
+    def shutdown(self) -> None:
+        self.arena.unlink()
+
+
+class InProcessStore:
+    """Per-CoreWorker store for small objects and pending futures.
+
+    Analog of the reference's in-process memory store
+    (`core_worker/store_provider/memory_store/`): small task returns and puts
+    live here in the owner process; remote readers fetch them from the owner
+    over RPC.
+    """
+
+    def __init__(self):
+        self._values: Dict[ObjectID, bytes] = {}  # packed payloads
+
+    def put(self, object_id: ObjectID, packed: bytes) -> None:
+        self._values[object_id] = packed
+
+    def get(self, object_id: ObjectID) -> Optional[bytes]:
+        return self._values.get(object_id)
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return object_id in self._values
+
+    def free(self, object_id: ObjectID) -> None:
+        self._values.pop(object_id, None)
+
+    def __len__(self) -> int:
+        return len(self._values)

@@ -1,0 +1,339 @@
+"""Async RPC substrate for the control plane.
+
+TPU-native analog of the reference's gRPC wrapper layer (`src/ray/rpc/
+grpc_server.h`, `grpc_client.h`, `client_call.h`): every daemon (controller,
+supervisor, worker) runs one ``RpcServer``; peers hold multiplexed,
+auto-reconnecting ``RpcClient``s.
+
+We deliberately do not use gRPC for the control plane: the reference needs
+gRPC for cross-language parity (C++/Java/Python all speak the same proto); our
+control plane is Python+C++ only and latency-bound by asyncio scheduling, not
+marshalling. The wire protocol is length-prefixed pickles over TCP — trivially
+inspectable, no proto toolchain in the loop, and the object-payload path never
+rides it (objects move via the shared-memory store and the chunked transfer
+protocol in object_store.py / supervisor.py).
+
+Frame: [u32 little-endian length][payload]
+Payload: pickle of (kind, msg_id, method, body)
+  kind: 0=request 1=reply 2=error 3=oneway
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import logging
+import pickle
+import socket
+import struct
+import time
+from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
+
+from ray_tpu._private import serialization
+
+logger = logging.getLogger(__name__)
+
+_LEN = struct.Struct("<I")
+REQUEST, REPLY, ERROR, ONEWAY = 0, 1, 2, 3
+
+MAX_FRAME = 512 * 1024 * 1024
+
+
+class RpcError(Exception):
+    pass
+
+
+class RpcConnectionError(RpcError):
+    pass
+
+
+class RpcTimeoutError(RpcError):
+    pass
+
+
+class RemoteError(RpcError):
+    """An exception raised inside the remote handler, re-raised locally."""
+
+    def __init__(self, method: str, cause_repr: str, cause: Exception | None = None):
+        super().__init__(f"remote handler {method!r} failed: {cause_repr}")
+        self.cause = cause
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> bytes:
+    header = await reader.readexactly(_LEN.size)
+    (length,) = _LEN.unpack(header)
+    if length > MAX_FRAME:
+        raise RpcError(f"frame too large: {length}")
+    return await reader.readexactly(length)
+
+
+def _write_frame(writer: asyncio.StreamWriter, payload: bytes) -> None:
+    writer.write(_LEN.pack(len(payload)))
+    writer.write(payload)
+
+
+class RpcServer:
+    """Method-dispatch TCP server.
+
+    Handlers are registered by name; they may be sync or async, and receive
+    (body, ) or (body, peer) if they accept two arguments.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._host = host
+        self._port = port
+        self._handlers: Dict[str, Callable] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._conns: set = set()
+
+    def register(self, method: str, handler: Callable) -> None:
+        self._handlers[method] = handler
+
+    def register_object(self, obj: Any, prefix: str = "") -> None:
+        """Register every public method of obj whose name starts with 'rpc_'."""
+        for name in dir(obj):
+            if name.startswith("rpc_"):
+                self.register(prefix + name[4:], getattr(obj, name))
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return (self._host, self._port)
+
+    @property
+    def address_str(self) -> str:
+        return f"{self._host}:{self._port}"
+
+    async def start(self) -> Tuple[str, int]:
+        self._server = await asyncio.start_server(
+            self._handle_conn, self._host, self._port, limit=MAX_FRAME
+        )
+        self._port = self._server.sockets[0].getsockname()[1]
+        return (self._host, self._port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            try:
+                await self._server.wait_closed()
+            except Exception:
+                pass
+        for w in list(self._conns):
+            try:
+                w.close()
+            except Exception:
+                pass
+
+    async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        peer = writer.get_extra_info("peername")
+        self._conns.add(writer)
+        try:
+            while True:
+                try:
+                    frame = await _read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    break
+                # Dispatch without blocking the read loop so one slow handler
+                # doesn't head-of-line-block the connection.
+                asyncio.get_running_loop().create_task(
+                    self._dispatch(frame, writer, peer)
+                )
+        finally:
+            self._conns.discard(writer)
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, frame: bytes, writer: asyncio.StreamWriter, peer):
+        kind, msg_id, method, body = pickle.loads(frame)
+        handler = self._handlers.get(method)
+        if handler is None:
+            if kind == REQUEST:
+                self._reply(writer, ERROR, msg_id, method, f"no such method: {method}")
+            return
+        try:
+            sig_args = (body, peer) if _wants_peer(handler) else (body,)
+            result = handler(*sig_args)
+            if inspect.isawaitable(result):
+                result = await result
+            if kind == REQUEST:
+                self._reply(writer, REPLY, msg_id, method, result)
+        except Exception as e:  # noqa: BLE001 — handler errors cross the wire
+            logger.debug("handler %s raised", method, exc_info=True)
+            if kind == REQUEST:
+                try:
+                    self._reply(writer, ERROR, msg_id, method, e)
+                except Exception:
+                    self._reply(writer, ERROR, msg_id, method, repr(e))
+
+    def _reply(self, writer, kind, msg_id, method, body):
+        try:
+            payload = serialization.dumps((kind, msg_id, method, body))
+            _write_frame(writer, payload)
+        except (ConnectionResetError, RuntimeError):
+            pass
+
+
+def _wants_peer(handler) -> bool:
+    try:
+        params = inspect.signature(handler).parameters
+        return len([p for p in params.values() if p.default is p.empty]) >= 2
+    except (TypeError, ValueError):
+        return False
+
+
+class RpcClient:
+    """Multiplexed client with lazy connect and bounded reconnection.
+
+    All calls must run on the owning event loop.
+    """
+
+    def __init__(
+        self,
+        address: Tuple[str, int] | str,
+        connect_timeout_s: float = 10.0,
+        request_timeout_s: float = 60.0,
+    ):
+        if isinstance(address, str):
+            host, port = address.rsplit(":", 1)
+            address = (host, int(port))
+        self._addr = address
+        self._connect_timeout = connect_timeout_s
+        self._request_timeout = request_timeout_s
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._next_id = 0
+        self._lock = asyncio.Lock()
+        self._read_task: Optional[asyncio.Task] = None
+        self._closed = False
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._addr
+
+    async def _ensure_connected(self) -> None:
+        if self._writer is not None and not self._writer.is_closing():
+            return
+        async with self._lock:
+            if self._writer is not None and not self._writer.is_closing():
+                return
+            deadline = time.monotonic() + self._connect_timeout
+            delay = 0.05
+            while True:
+                try:
+                    self._reader, self._writer = await asyncio.wait_for(
+                        asyncio.open_connection(*self._addr, limit=MAX_FRAME),
+                        timeout=max(0.1, deadline - time.monotonic()),
+                    )
+                    sock = self._writer.get_extra_info("socket")
+                    if sock is not None:
+                        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    break
+                except (OSError, asyncio.TimeoutError) as e:
+                    if time.monotonic() + delay >= deadline or self._closed:
+                        raise RpcConnectionError(
+                            f"cannot connect to {self._addr}: {e}"
+                        ) from e
+                    await asyncio.sleep(delay)
+                    delay = min(delay * 2, 1.0)
+            self._read_task = asyncio.get_running_loop().create_task(self._read_loop())
+
+    async def _read_loop(self) -> None:
+        reader = self._reader
+        try:
+            while True:
+                frame = await _read_frame(reader)
+                kind, msg_id, method, body = pickle.loads(frame)
+                fut = self._pending.pop(msg_id, None)
+                if fut is None or fut.done():
+                    continue
+                if kind == REPLY:
+                    fut.set_result(body)
+                elif kind == ERROR:
+                    if isinstance(body, Exception):
+                        fut.set_exception(RemoteError(method, repr(body), body))
+                    else:
+                        fut.set_exception(RemoteError(method, str(body)))
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError, asyncio.CancelledError):
+            pass
+        finally:
+            err = RpcConnectionError(f"connection to {self._addr} lost")
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(err)
+            self._pending.clear()
+            if self._writer is not None:
+                try:
+                    self._writer.close()
+                except Exception:
+                    pass
+            self._writer = None
+            self._reader = None
+
+    async def call(self, method: str, body: Any = None, timeout: float | None = None) -> Any:
+        await self._ensure_connected()
+        self._next_id += 1
+        msg_id = self._next_id
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[msg_id] = fut
+        _write_frame(self._writer, serialization.dumps((REQUEST, msg_id, method, body)))
+        try:
+            await self._writer.drain()
+            return await asyncio.wait_for(
+                fut, timeout if timeout is not None else self._request_timeout
+            )
+        except asyncio.TimeoutError as e:
+            self._pending.pop(msg_id, None)
+            raise RpcTimeoutError(f"{method} to {self._addr} timed out") from e
+
+    async def notify(self, method: str, body: Any = None) -> None:
+        """Fire-and-forget."""
+        await self._ensure_connected()
+        self._next_id += 1
+        _write_frame(
+            self._writer, serialization.dumps((ONEWAY, self._next_id, method, body))
+        )
+        await self._writer.drain()
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._read_task is not None:
+            self._read_task.cancel()
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+        self._writer = None
+
+
+class ClientPool:
+    """Cache of RpcClients keyed by address."""
+
+    def __init__(self, connect_timeout_s: float = 10.0, request_timeout_s: float = 60.0):
+        self._clients: Dict[Tuple[str, int], RpcClient] = {}
+        self._connect_timeout = connect_timeout_s
+        self._request_timeout = request_timeout_s
+
+    def get(self, address: Tuple[str, int] | str) -> RpcClient:
+        if isinstance(address, str):
+            host, port = address.rsplit(":", 1)
+            address = (host, int(port))
+        client = self._clients.get(address)
+        if client is None:
+            client = RpcClient(
+                address,
+                connect_timeout_s=self._connect_timeout,
+                request_timeout_s=self._request_timeout,
+            )
+            self._clients[address] = client
+        return client
+
+    def drop(self, address: Tuple[str, int]) -> None:
+        self._clients.pop(address, None)
+
+    async def close_all(self) -> None:
+        for c in self._clients.values():
+            await c.close()
+        self._clients.clear()

@@ -1,0 +1,773 @@
+"""Per-host supervisor daemon.
+
+TPU-native analog of the reference's raylet (`src/ray/raylet/`): one per host,
+it owns the worker pool (≈ `worker_pool.cc`), grants task leases with
+hybrid/spread scheduling over its synced cluster view
+(≈ `NodeManager::HandleRequestWorkerLease` `node_manager.cc:1753` +
+`ClusterTaskManager::QueueAndScheduleTask` `cluster_task_manager.h:70`,
+including spillback), hosts the node's shared-memory object store in-process
+(≈ plasma inside raylet, `object_manager/plasma/store_runner.h`), serves
+chunked cross-node object transfer (≈ `PullManager`/`PushManager`), and
+reserves placement-group bundles.
+
+TPU-first specifics: workers that will touch TPU chips are spawned with the
+TPU runtime env restored and `TPU_VISIBLE_CHIPS` pinned to their assigned
+chips (≈ reference accelerators/tpu.py:30); pure-control workers spawn with
+the TPU plugin disabled so process startup stays ~50ms.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import dataclasses
+import json
+import logging
+import os
+import subprocess
+import sys
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private import serialization
+from ray_tpu._private.config import Config
+from ray_tpu._private.ids import NodeID, ObjectID, WorkerID
+from ray_tpu._private.object_store import NodeObjectStore
+from ray_tpu._private.resources import ResourceSet, detect_node_resources
+from ray_tpu._private.rpc import ClientPool, RpcServer
+from ray_tpu._private.scheduling import NodeView, pick_node
+from ray_tpu._private.task_spec import PlacementGroupStrategy, TaskSpec
+
+logger = logging.getLogger(__name__)
+
+_TRACE_PATH = os.environ.get("RAY_TPU_TRACE_FILE", "")
+
+
+def _trace(msg: str) -> None:
+    if _TRACE_PATH:
+        with open(_TRACE_PATH, "a") as f:
+            f.write(f"[sup {os.getpid()} {time.monotonic():.3f}] {msg}\n")
+
+Address = Tuple[str, int]
+
+MAX_SPILLBACK_HOPS = 8
+
+
+@dataclasses.dataclass
+class WorkerHandle:
+    worker_id_hex: str
+    address: Address
+    pid: int
+    env_key: str
+    proc: Optional[subprocess.Popen] = None
+    idle_since: float = 0.0
+    leased: bool = False
+    is_actor: bool = False
+    actor_id_hex: str = ""
+    tpu_chips: List[int] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class Lease:
+    lease_id: int
+    worker: WorkerHandle
+    resources: ResourceSet
+    owner: Optional[Address]
+    pg_key: Optional[Tuple[str, int]] = None  # (pg_id_hex, bundle_index)
+
+
+@dataclasses.dataclass
+class _QueuedLease:
+    spec: TaskSpec
+    future: asyncio.Future
+    demand: ResourceSet
+    pg_key: Optional[Tuple[str, int]]
+
+
+class Supervisor:
+    def __init__(
+        self,
+        config: Config,
+        controller_addr: Address,
+        session_dir: str,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        resources: Optional[Dict[str, float]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        node_name: str = "",
+    ):
+        self.config = config
+        self.node_id = NodeID.from_random()
+        self.controller_addr = controller_addr
+        self.session_dir = session_dir
+        self.node_name = node_name or self.node_id.hex()[:8]
+        self.server = RpcServer(host, port)
+        self.server.register_object(self)
+        self.clients = ClientPool(
+            config.rpc_connect_timeout_s, config.rpc_request_timeout_s
+        )
+        self.total = (
+            ResourceSet.of(resources)
+            if resources is not None
+            else detect_node_resources(
+                object_store_bytes=config.object_store_memory_bytes
+            )
+        )
+        self.available = self.total.copy()
+        self.labels = labels or {}
+        arena_dir = "/dev/shm" if os.path.isdir("/dev/shm") else session_dir
+        self.arena_path = os.path.join(
+            arena_dir, f"rtpu_arena_{self.node_id.hex()[:12]}"
+        )
+        spill_dir = config.object_spilling_dir or os.path.join(
+            session_dir, "spill", self.node_id.hex()[:12]
+        )
+        self.store = NodeObjectStore(
+            self.arena_path, config.object_store_memory_bytes, spill_dir
+        )
+        # worker pool
+        self.workers: Dict[str, WorkerHandle] = {}
+        self.idle: Dict[str, Deque[WorkerHandle]] = {}  # env_key -> idle workers
+        self._spawn_waiters: Dict[str, Deque[asyncio.Future]] = {}
+        # pid -> Popen of spawned-but-not-yet-registered workers; the handle
+        # adopts its proc by pid at registration (concurrent spawns must not
+        # cross-attribute processes — exit monitoring depends on it)
+        self._spawned_procs: Dict[int, subprocess.Popen] = {}
+        self.leases: Dict[int, Lease] = {}
+        self._next_lease_id = 0
+        self._lease_queue: Deque[_QueuedLease] = deque()
+        # placement group bundles: (pg_hex, index) -> [reserved_total, bundle_available]
+        self.bundles: Dict[Tuple[str, int], List[ResourceSet]] = {}
+        # cluster view cache (synced from controller)
+        self.cluster_view: List[NodeView] = []
+        self._pulls_in_flight: Dict[ObjectID, asyncio.Future] = {}
+        self._sync_task: Optional[asyncio.Task] = None
+        self._reap_task: Optional[asyncio.Task] = None
+        self._monitor_task: Optional[asyncio.Task] = None
+        # TPU chip assignment bookkeeping
+        self._tpu_free: List[int] = list(range(int(self.total.get("TPU", 0))))
+        # original (driver) environment for spawning TPU workers
+        self._orig_env = dict(os.environ)
+        orig_axon = os.environ.get("RAY_TPU_AXON_ORIG")
+        if orig_axon is not None:
+            self._orig_env["PALLAS_AXON_POOL_IPS"] = orig_axon
+
+    # ------------------------------------------------------------- lifecycle
+
+    async def start(self) -> Address:
+        addr = await self.server.start()
+        ctrl = self.clients.get(self.controller_addr)
+        await ctrl.call(
+            "node_register",
+            {
+                "node_id_hex": self.node_id.hex(),
+                "address": addr,
+                "total": dict(self.total),
+                "available": dict(self.available),
+                "labels": {**self.labels, "node_name": self.node_name},
+            },
+        )
+        loop = asyncio.get_running_loop()
+        self._sync_task = loop.create_task(self._sync_loop())
+        self._reap_task = loop.create_task(self._reap_loop())
+        self._monitor_task = loop.create_task(self._monitor_loop())
+        logger.info(
+            "supervisor %s on %s resources=%s",
+            self.node_id.hex()[:8],
+            addr,
+            dict(self.total),
+        )
+        return addr
+
+    async def stop(self) -> None:
+        for t in (self._sync_task, self._reap_task, self._monitor_task):
+            if t is not None:
+                t.cancel()
+        for w in self.workers.values():
+            if w.proc is not None:
+                try:
+                    w.proc.terminate()
+                except Exception:
+                    pass
+        self.store.shutdown()
+        await self.clients.close_all()
+        await self.server.stop()
+
+    async def rpc_ping(self, body=None) -> str:
+        return "pong"
+
+    async def rpc_node_info(self, body=None) -> dict:
+        return {
+            "node_id_hex": self.node_id.hex(),
+            "arena_path": self.arena_path,
+            "arena_size": self.config.object_store_memory_bytes,
+            "controller": self.controller_addr,
+            "address": self.server.address,
+            "total": dict(self.total),
+        }
+
+    # ------------------------------------------------------------- sync
+
+    async def _sync_loop(self) -> None:
+        ctrl = self.clients.get(self.controller_addr)
+        while True:
+            try:
+                await ctrl.call(
+                    "node_sync",
+                    {
+                        "node_id_hex": self.node_id.hex(),
+                        "available": dict(self.available),
+                        "store_stats": self.store.stats(),
+                    },
+                    timeout=5,
+                )
+                views = await ctrl.call("node_views", timeout=5)
+                self.cluster_view = [
+                    NodeView(
+                        node_id_hex=v["node_id_hex"],
+                        address=tuple(v["address"]),
+                        total=ResourceSet.of(v["total"]),
+                        available=ResourceSet.of(v["available"]),
+                        alive=v["alive"],
+                        labels=v.get("labels", {}),
+                    )
+                    for v in views
+                ]
+            except Exception as e:
+                logger.debug("sync failed: %s", e)
+            await asyncio.sleep(0.2)
+
+    # ------------------------------------------------------------- leases
+
+    async def rpc_request_lease(self, body) -> dict:
+        """Grant a worker lease for a task, spill back, or queue.
+
+        ≈ NodeManager::HandleRequestWorkerLease (node_manager.cc:1753).
+        """
+        spec: TaskSpec = serialization.loads(body["spec"])
+        no_spillback = body.get("no_spillback", False)
+        hops = body.get("hops", 0)
+        demand = ResourceSet.of(spec.required_resources())
+
+        pg_key: Optional[Tuple[str, int]] = None
+        if isinstance(spec.strategy, PlacementGroupStrategy):
+            pg_key = (spec.strategy.pg_id_hex, spec.strategy.bundle_index)
+            if pg_key not in self.bundles:
+                return {"granted": False, "error": f"bundle {pg_key} not on this node"}
+        elif not no_spillback and hops < MAX_SPILLBACK_HOPS:
+            # Use the live local state (minus demand already queued here) in
+            # place of the possibly-stale synced view of ourselves, so a burst
+            # of lease requests spills over instead of piling up locally.
+            view = [v for v in self.cluster_view if v.node_id_hex != self.node_id.hex()]
+            view.append(self._live_self_view())
+            chosen = pick_node(
+                view,
+                spec.required_resources(),
+                spec.strategy,
+                local_node_hex=self.node_id.hex(),
+                spread_threshold=self.config.scheduler_spread_threshold,
+            )
+            if chosen is not None and chosen.node_id_hex != self.node_id.hex():
+                return {
+                    "granted": False,
+                    "retry_at": chosen.address,
+                    "hops": hops + 1,
+                }
+
+        if not self._feasible(demand, pg_key):
+            return {
+                "granted": False,
+                "error": f"infeasible demand {dict(demand)} on node "
+                f"{self.node_id.hex()[:8]} (total={dict(self.total)})",
+            }
+
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._lease_queue.append(_QueuedLease(spec, fut, demand, pg_key))
+        self._pump_lease_queue()
+        return await fut
+
+    def _self_view(self) -> NodeView:
+        return NodeView(
+            node_id_hex=self.node_id.hex(),
+            address=self.server.address,
+            total=self.total,
+            available=self.available,
+            alive=True,
+        )
+
+    def _live_self_view(self) -> NodeView:
+        """Self view net of demand already queued for leasing here."""
+        avail = self.available.copy()
+        for q in self._lease_queue:
+            if q.pg_key is None and not q.future.done():
+                for k, v in q.demand.items():
+                    cur = avail.get(k, 0.0) - v
+                    if cur <= 0:
+                        avail.pop(k, None)
+                    else:
+                        avail[k] = cur
+        return NodeView(
+            node_id_hex=self.node_id.hex(),
+            address=self.server.address,
+            total=self.total,
+            available=avail,
+            alive=True,
+        )
+
+    def _feasible(self, demand: ResourceSet, pg_key) -> bool:
+        if pg_key is not None:
+            reserved = self.bundles.get(pg_key)
+            return reserved is not None and reserved[0].fits(demand)
+        return self.total.fits(demand)
+
+    def _available_for(self, pg_key) -> ResourceSet:
+        if pg_key is not None:
+            return self.bundles[pg_key][1]
+        return self.available
+
+    def _pump_lease_queue(self) -> None:
+        """Grant queued leases FIFO while resources allow."""
+        made_progress = True
+        while made_progress and self._lease_queue:
+            made_progress = False
+            q = self._lease_queue[0]
+            if q.future.done():
+                self._lease_queue.popleft()
+                made_progress = True
+                continue
+            if q.pg_key is not None and q.pg_key not in self.bundles:
+                q.future.set_result(
+                    {"granted": False, "error": "placement group removed"}
+                )
+                self._lease_queue.popleft()
+                made_progress = True
+                continue
+            pool = self._available_for(q.pg_key)
+            if not pool.fits(q.demand):
+                break  # strict FIFO to avoid starvation
+            pool.subtract(q.demand)
+            self._lease_queue.popleft()
+            made_progress = True
+            asyncio.get_running_loop().create_task(self._grant(q))
+
+    async def _grant(self, q: _QueuedLease) -> None:
+        spec = q.spec
+        try:
+            worker = await self._acquire_worker(spec)
+        except Exception as e:
+            if q.pg_key is None or q.pg_key in self.bundles:
+                self._available_for(q.pg_key).add(q.demand)
+            self._pump_lease_queue()
+            if not q.future.done():
+                q.future.set_result({"granted": False, "error": f"worker spawn failed: {e}"})
+            return
+        self._next_lease_id += 1
+        lease = Lease(
+            lease_id=self._next_lease_id,
+            worker=worker,
+            resources=q.demand,
+            owner=spec.owner,
+            pg_key=q.pg_key,
+        )
+        worker.leased = True
+        num_tpu = int(q.demand.get("TPU", 0))
+        if num_tpu and not worker.tpu_chips:
+            worker.tpu_chips = [self._tpu_free.pop() for _ in range(num_tpu)]
+        self.leases[lease.lease_id] = lease
+        if not q.future.done():
+            q.future.set_result(
+                {
+                    "granted": True,
+                    "lease_id": lease.lease_id,
+                    "worker_id_hex": worker.worker_id_hex,
+                    "worker_address": worker.address,
+                    "node_id_hex": self.node_id.hex(),
+                }
+            )
+        else:
+            await self._release(lease.lease_id)
+
+    async def rpc_release_lease(self, body) -> None:
+        await self._release(body["lease_id"])
+
+    async def _release(self, lease_id: int) -> None:
+        lease = self.leases.pop(lease_id, None)
+        if lease is None:
+            return
+        if lease.pg_key is not None:
+            if lease.pg_key in self.bundles:
+                self.bundles[lease.pg_key][1].add(lease.resources)
+        else:
+            self.available.add(lease.resources)
+        w = lease.worker
+        _trace(f"release lease={lease_id} w={w.worker_id_hex[:8]} is_actor={w.is_actor} in_workers={w.worker_id_hex in self.workers}")
+        if w.worker_id_hex in self.workers and not w.is_actor:
+            w.leased = False
+            w.idle_since = time.monotonic()
+            if w.tpu_chips:
+                self._tpu_free.extend(w.tpu_chips)
+                w.tpu_chips = []
+            self.idle.setdefault(w.env_key, deque()).append(w)
+        self._pump_lease_queue()
+
+    # ------------------------------------------------------------- worker pool
+
+    def _env_key_for(self, spec: TaskSpec) -> str:
+        needs_tpu = spec.required_resources().get("TPU", 0) > 0
+        env_vars = (spec.runtime_env or {}).get("env_vars", {})
+        key = {"tpu": needs_tpu, "env": tuple(sorted(env_vars.items()))}
+        return repr(key)
+
+    def _worker_env(self, spec: TaskSpec) -> Dict[str, str]:
+        needs_tpu = spec.required_resources().get("TPU", 0) > 0
+        if needs_tpu:
+            env = dict(self._orig_env)
+        else:
+            env = dict(os.environ)
+            # keep non-TPU workers off the TPU plugin: fast startup, no chip claim
+            env["PALLAS_AXON_POOL_IPS"] = ""
+            env.setdefault("JAX_PLATFORMS", "cpu")
+            env["JAX_PLATFORMS"] = "cpu"
+        env.update((spec.runtime_env or {}).get("env_vars", {}))
+        return env
+
+    async def _acquire_worker(self, spec: TaskSpec) -> WorkerHandle:
+        env_key = self._env_key_for(spec)
+        pool = self.idle.setdefault(env_key, deque())
+        while pool:
+            w = pool.popleft()
+            if w.worker_id_hex in self.workers and (w.proc is None or w.proc.poll() is None):
+                return w
+        return await self._spawn_worker(spec, env_key)
+
+    async def _spawn_worker(self, spec: TaskSpec, env_key: str) -> WorkerHandle:
+        env = self._worker_env(spec)
+        env["RAY_TPU_WORKER_ENV_KEY"] = env_key
+        cmd = [
+            sys.executable,
+            "-m",
+            "ray_tpu._private.workers.default_worker",
+            "--supervisor",
+            f"{self.server.address[0]}:{self.server.address[1]}",
+            "--controller",
+            f"{self.controller_addr[0]}:{self.controller_addr[1]}",
+            "--node-id",
+            self.node_id.hex(),
+            "--arena-path",
+            self.arena_path,
+            "--arena-size",
+            str(self.config.object_store_memory_bytes),
+            "--session-dir",
+            self.session_dir,
+        ]
+        log_dir = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        wtag = f"worker-{len(self.workers)}-{os.getpid()}-{time.monotonic_ns() % 100000}"
+        out = open(os.path.join(log_dir, wtag + ".out"), "ab")
+        err = open(os.path.join(log_dir, wtag + ".err"), "ab")
+        proc = subprocess.Popen(cmd, env=env, stdout=out, stderr=err)
+        out.close()  # child holds its own duplicates; keeping ours leaks fds
+        err.close()
+        self._spawned_procs[proc.pid] = proc
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._spawn_waiters.setdefault(env_key, deque()).append(fut)
+        try:
+            handle: WorkerHandle = await asyncio.wait_for(
+                fut, timeout=self.config.worker_register_timeout_s
+            )
+        except asyncio.TimeoutError:
+            try:
+                self._spawn_waiters.get(env_key, deque()).remove(fut)
+            except ValueError:
+                pass
+            self._spawned_procs.pop(proc.pid, None)
+            proc.kill()
+            raise RuntimeError(
+                f"worker failed to register within "
+                f"{self.config.worker_register_timeout_s}s (see {log_dir}/{wtag}.err)"
+            )
+        _trace(f"spawned {handle.worker_id_hex[:8]} pid={handle.pid}")
+        return handle
+
+    async def rpc_worker_register(self, body) -> dict:
+        handle = WorkerHandle(
+            worker_id_hex=body["worker_id_hex"],
+            address=tuple(body["address"]),
+            pid=body["pid"],
+            env_key=body.get("env_key", ""),
+            idle_since=time.monotonic(),
+            # bind the Popen by the worker's own pid — never by spawn order
+            proc=self._spawned_procs.pop(body["pid"], None),
+        )
+        self.workers[handle.worker_id_hex] = handle
+        waiters = self._spawn_waiters.get(handle.env_key)
+        if waiters:
+            while waiters:
+                fut = waiters.popleft()
+                if not fut.done():
+                    fut.set_result(handle)
+                    break
+        return {"node_id_hex": self.node_id.hex()}
+
+    async def rpc_worker_set_actor(self, body) -> None:
+        """Mark a worker as hosting an actor (exempt from pool reuse/reaping)."""
+        w = self.workers.get(body["worker_id_hex"])
+        _trace(f"set_actor {body['worker_id_hex'][:8]} found={w is not None}")
+        if w is not None:
+            w.is_actor = True
+            w.actor_id_hex = body["actor_id_hex"]
+
+    async def rpc_kill_worker(self, body) -> None:
+        w = self.workers.get(body["worker_id_hex"])
+        if w is not None and w.proc is not None:
+            try:
+                w.proc.kill()
+            except Exception:
+                pass
+
+    async def rpc_tpu_visible_chips(self, body) -> list:
+        w = self.workers.get(body["worker_id_hex"])
+        return w.tpu_chips if w else []
+
+    async def _monitor_loop(self) -> None:
+        """Detect worker process exits (≈ raylet socket-disconnect detection,
+        node_manager.cc:1432). The loop must survive any handler error —
+        a dead monitor means no failure detection for the whole node."""
+        while True:
+            await asyncio.sleep(0.2)
+            for w in list(self.workers.values()):
+                try:
+                    if w.proc is not None and w.proc.poll() is not None:
+                        await self._on_worker_exit(w)
+                except Exception:
+                    logger.exception("worker-exit handling failed for %s", w.worker_id_hex[:8])
+
+    async def _on_worker_exit(self, w: WorkerHandle) -> None:
+        _trace(f"worker_exit {w.worker_id_hex[:8]} is_actor={w.is_actor} actor={w.actor_id_hex[:8]} code={w.proc.poll() if w.proc else None}")
+        self.workers.pop(w.worker_id_hex, None)
+        try:
+            self.idle.get(w.env_key, deque()).remove(w)
+        except ValueError:
+            pass
+        exitcode = w.proc.poll() if w.proc is not None else None
+        # fail leases bound to this worker and tell their owners
+        for lease in [l for l in self.leases.values() if l.worker is w]:
+            if lease.owner is not None:
+                try:
+                    await self.clients.get(lease.owner).notify(
+                        "worker_failed",
+                        {
+                            "worker_id_hex": w.worker_id_hex,
+                            "exitcode": exitcode,
+                        },
+                    )
+                except Exception:
+                    pass
+            await self._release(lease.lease_id)
+        if w.is_actor:
+            try:
+                await self.clients.get(self.controller_addr).call(
+                    "worker_died",
+                    {
+                        "worker_id_hex": w.worker_id_hex,
+                        "actor_id_hex": w.actor_id_hex,
+                        "reason": f"worker exited with code {exitcode}",
+                    },
+                    timeout=5,
+                )
+            except Exception:
+                pass
+        if w.tpu_chips:
+            self._tpu_free.extend(w.tpu_chips)
+
+    async def _reap_loop(self) -> None:
+        """Kill surplus idle workers (≈ idle worker killing in worker_pool.cc)."""
+        while True:
+            await asyncio.sleep(1.0)
+            try:
+                self._reap_once(time.monotonic())
+            except Exception:
+                logger.exception("idle reap failed")
+
+    def _reap_once(self, now: float) -> None:
+        idle_ms = self.config.idle_worker_killing_time_ms
+        for env_key, pool in self.idle.items():
+            while (
+                # over the soft cap: reap oldest, but give a 2s grace window
+                # so a just-released worker isn't killed under a racing lease
+                (
+                    len(pool) > self.config.num_workers_soft_limit
+                    and (now - pool[0].idle_since) > 2.0
+                )
+                or (
+                    pool
+                    and (now - pool[0].idle_since) * 1000 > idle_ms
+                    and len(pool) > 1
+                )
+            ):
+                w = pool.popleft()
+                _trace(f"reap {w.worker_id_hex[:8]} is_actor={w.is_actor}")
+                self.workers.pop(w.worker_id_hex, None)
+                if w.proc is not None:
+                    try:
+                        w.proc.terminate()
+                    except Exception:
+                        pass
+
+    # ------------------------------------------------------------- placement bundles
+
+    async def rpc_reserve_bundle(self, body) -> None:
+        key = (body["pg_id_hex"], body["bundle_index"])
+        demand = ResourceSet.of(body["resources"])
+        if key in self.bundles:
+            return
+        if not self.available.fits(demand):
+            raise ValueError(f"insufficient resources for bundle {key}")
+        self.available.subtract(demand)
+        self.bundles[key] = [demand.copy(), demand.copy()]
+
+    async def rpc_release_bundle(self, body) -> None:
+        key = (body["pg_id_hex"], body["bundle_index"])
+        entry = self.bundles.pop(key, None)
+        if entry is not None:
+            self.available.add(entry[0])
+        self._pump_lease_queue()
+
+    # ------------------------------------------------------------- object store
+
+    async def rpc_store_create(self, body) -> dict:
+        oid = ObjectID(body["object_id"])
+        offset = self.store.create(oid, body["size"])
+        return {"offset": offset}
+
+    async def rpc_store_seal(self, body) -> None:
+        self.store.seal(ObjectID(body["object_id"]))
+
+    async def rpc_store_abort(self, body) -> None:
+        self.store.abort(ObjectID(body["object_id"]))
+
+    async def rpc_store_locate(self, body):
+        loc = self.store.locate(ObjectID(body["object_id"]), pin=body.get("pin", False))
+        return None if loc is None else {"offset": loc[0], "size": loc[1]}
+
+    async def rpc_store_unpin(self, body) -> None:
+        self.store.unpin(ObjectID(body["object_id"]))
+
+    async def rpc_store_contains(self, body) -> bool:
+        return self.store.contains(ObjectID(body["object_id"]))
+
+    async def rpc_store_free(self, body) -> None:
+        for raw in body["object_ids"]:
+            self.store.free(ObjectID(raw))
+
+    async def rpc_store_read_chunk(self, body) -> bytes:
+        return self.store.read_chunk(
+            ObjectID(body["object_id"]), body["offset"], body["length"]
+        )
+
+    async def rpc_store_stats(self, body=None) -> dict:
+        return self.store.stats()
+
+    async def rpc_pull_object(self, body) -> dict:
+        """Fetch an object from a remote node into the local store.
+
+        ≈ PullManager (object_manager/pull_manager.cc): chunked, deduped.
+        """
+        oid = ObjectID(body["object_id"])
+        if self.store.contains(oid):
+            loc = self.store.locate(oid)
+            return {"offset": loc[0], "size": loc[1]}
+        pending = self._pulls_in_flight.get(oid)
+        if pending is not None:
+            return await pending
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pulls_in_flight[oid] = fut
+        try:
+            result = await self._do_pull(oid, tuple(body["from"]), body["size"])
+            fut.set_result(result)
+            return result
+        except Exception as e:
+            fut.set_exception(e)
+            raise
+        finally:
+            self._pulls_in_flight.pop(oid, None)
+            if not fut.done():
+                fut.cancel()
+
+    async def _do_pull(self, oid: ObjectID, source: Address, size: int) -> dict:
+        offset = self.store.create(oid, size)
+        src = self.clients.get(source)
+        chunk = self.config.object_transfer_chunk_bytes
+        pinned = False
+        try:
+            # pin at the source for the duration of the chunked transfer
+            pinned = (
+                await src.call(
+                    "store_locate", {"object_id": oid.binary(), "pin": True}, timeout=60
+                )
+                is not None
+            )
+            pos = 0
+            while pos < size:
+                data = await src.call(
+                    "store_read_chunk",
+                    {"object_id": oid.binary(), "offset": pos, "length": chunk},
+                    timeout=60,
+                )
+                self.store.arena.write(offset + pos, data)
+                pos += len(data)
+        except Exception:
+            self.store.abort(oid)
+            raise
+        finally:
+            if pinned:
+                try:
+                    await src.notify("store_unpin", {"object_id": oid.binary()})
+                except Exception:
+                    pass
+        self.store.seal(oid)
+        return {"offset": offset, "size": size}
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--controller", required=True)
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--address-file", default="")
+    parser.add_argument("--resources", default="")  # JSON
+    parser.add_argument("--node-name", default="")
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
+        format="[supervisor] %(asctime)s %(levelname)s %(message)s",
+    )
+    host, port = args.controller.rsplit(":", 1)
+    resources = json.loads(args.resources) if args.resources else None
+
+    async def run():
+        sup = Supervisor(
+            Config.from_env(),
+            (host, int(port)),
+            args.session_dir,
+            args.host,
+            args.port,
+            resources=resources,
+            node_name=args.node_name,
+        )
+        addr = await sup.start()
+        if args.address_file:
+            tmp = args.address_file + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(f"{addr[0]}:{addr[1]}")
+            os.replace(tmp, args.address_file)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,107 @@
+"""Task specifications and scheduling strategies.
+
+Analog of the reference's ``TaskSpecification`` (`src/ray/common/
+task/task_spec.h`) and the Python scheduling-strategy surface
+(`python/ray/util/scheduling_strategies.py`): a TaskSpec is the unit handed
+from a submitting CoreWorker to a supervisor (for the lease) and then to the
+executing worker (for the run).
+
+Args are pre-resolved at the submitter where possible: plain values travel as
+packed payloads, top-level ObjectRef args travel as (id, owner) pairs that the
+executing worker fetches before invoking the function — mirroring the
+reference's LocalDependencyResolver + plasma-arg split
+(`transport/dependency_resolver.h`, `core_worker.cc:2852`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, PlacementGroupID, TaskID
+
+Address = Tuple[str, int]
+
+
+class ArgKind(enum.Enum):
+    VALUE = 0  # packed payload bytes
+    REF = 1  # (ObjectID, owner Address) — fetched by the executor
+
+
+@dataclasses.dataclass
+class TaskArg:
+    kind: ArgKind
+    value: bytes | None = None
+    object_id: ObjectID | None = None
+    owner: Address | None = None
+
+
+class TaskKind(enum.Enum):
+    NORMAL = 0
+    ACTOR_CREATION = 1
+    ACTOR_TASK = 2
+
+
+@dataclasses.dataclass
+class SchedulingStrategy:
+    """Base: DEFAULT = hybrid policy."""
+
+    name: str = "DEFAULT"
+
+
+@dataclasses.dataclass
+class SpreadStrategy(SchedulingStrategy):
+    name: str = "SPREAD"
+
+
+@dataclasses.dataclass
+class NodeAffinityStrategy(SchedulingStrategy):
+    name: str = "NODE_AFFINITY"
+    node_id_hex: str = ""
+    soft: bool = False
+
+
+@dataclasses.dataclass
+class PlacementGroupStrategy(SchedulingStrategy):
+    name: str = "PLACEMENT_GROUP"
+    pg_id_hex: str = ""
+    bundle_index: int = -1  # -1 = any bundle
+
+
+@dataclasses.dataclass
+class TaskSpec:
+    task_id: TaskID
+    job_id: JobID
+    kind: TaskKind
+    name: str  # human-readable, for errors/observability
+    function_key: str  # controller function-table key (sha256 of pickled fn)
+    args: List[TaskArg]
+    num_returns: int = 1
+    # None = unspecified (defaults to 1 CPU for normal tasks); {} = explicitly
+    # zero-resource (schedulable anywhere, like the reference's num_cpus=0)
+    resources: Optional[Dict[str, float]] = None
+    strategy: SchedulingStrategy = dataclasses.field(default_factory=SchedulingStrategy)
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    owner: Optional[Address] = None
+    runtime_env: Optional[Dict[str, Any]] = None
+    # actor fields
+    actor_id: Optional[ActorID] = None
+    method_name: str = ""
+    seqno: int = -1  # per-handle sequence number for ordered actor execution
+    caller_id: str = ""  # identifies the submitting handle for ordering
+    max_concurrency: int = 1
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    is_async_actor: bool = False
+
+    def return_ids(self) -> List[ObjectID]:
+        return [
+            ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)
+        ]
+
+    def required_resources(self) -> Dict[str, float]:
+        if self.resources is None:
+            return {"CPU": 1.0}
+        return {k: v for k, v in self.resources.items() if v > 0}

@@ -1,0 +1,344 @@
+"""Worker process main + task executor.
+
+Analog of the reference's worker entrypoint
+(`python/ray/_private/workers/default_worker.py`) plus the executor half of
+CoreWorker (`CoreWorker::ExecuteTask` `core_worker.cc:2852`, scheduling queues
+`transport/actor_scheduling_queue.h`): a worker registers with its
+supervisor, then serves ``push_task`` RPCs.
+
+Execution model:
+  * normal tasks: FIFO on a single executor thread;
+  * actor tasks: per-caller-handle sequence numbers enforce submission order
+    when ``max_concurrency == 1`` (≈ ActorSchedulingQueue); threaded actors
+    (`max_concurrency > 1`) run on a thread pool in arrival order
+    (≈ out_of_order_actor_scheduling_queue.h + concurrency groups);
+  * async actors: methods that are coroutines run on a dedicated asyncio loop
+    with a ``max_concurrency`` semaphore (≈ fiber.h's fibers).
+
+TPU specifics: before the first TPU task runs, the worker pins itself to its
+assigned chips via ``TPU_VISIBLE_CHIPS`` (reference accelerators/tpu.py:30) —
+jax then initializes only those chips when user code first touches it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Optional
+
+from ray_tpu._private import serialization
+from ray_tpu._private.config import Config
+from ray_tpu._private.core_worker import CoreWorker, _RefPlaceholder
+from ray_tpu._private.exceptions import TaskError
+from ray_tpu._private.ids import JobID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.task_spec import ArgKind, TaskKind, TaskSpec
+
+logger = logging.getLogger(__name__)
+
+
+class Executor:
+    """Executes task specs pushed to this worker."""
+
+    def __init__(self, core: CoreWorker):
+        self.core = core
+        self.actor_instance: Any = None
+        self.actor_spec: Optional[TaskSpec] = None
+        self._pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="exec")
+        self._async_loop: Optional[asyncio.AbstractEventLoop] = None
+        self._async_sem: Optional[asyncio.Semaphore] = None
+        # per-caller ordering state for sync actors
+        self._expected_seq: Dict[str, int] = {}
+        self._waiting: Dict[str, Dict[int, TaskSpec]] = {}
+        self._cancelled: set = set()
+        self._tpu_env_set = False
+        self._lock = threading.Lock()
+
+    # -- entry from the IO loop (RPC handler) --
+
+    async def push_task(self, body) -> str:
+        spec: TaskSpec = serialization.loads(body["spec"])
+        if spec.kind == TaskKind.ACTOR_CREATION and spec.max_concurrency > 1:
+            # threaded actor: widen the execution pool before __init__ runs
+            self._pool = ThreadPoolExecutor(
+                max_workers=spec.max_concurrency, thread_name_prefix="exec"
+            )
+        if spec.kind == TaskKind.ACTOR_TASK and self.actor_spec is not None:
+            if self.actor_spec.max_concurrency <= 1 and not self.actor_spec.is_async_actor:
+                self._enqueue_ordered(spec)
+                return "ok"
+        if (
+            spec.kind == TaskKind.ACTOR_TASK
+            and self.actor_spec is not None
+            and self.actor_spec.is_async_actor
+        ):
+            self._submit_async(spec)
+            return "ok"
+        self._pool.submit(self._execute_guarded, spec)
+        return "ok"
+
+    async def cancel(self, body) -> bool:
+        self._cancelled.add(TaskID(body["task_id"]))
+        return True
+
+    def _enqueue_ordered(self, spec: TaskSpec) -> None:
+        caller = getattr(spec, "caller_id", "") or "_"
+        with self._lock:
+            waiting = self._waiting.setdefault(caller, {})
+            waiting[spec.seqno] = spec
+            expected = self._expected_seq.get(caller, 0)
+            while expected in waiting:
+                ready = waiting.pop(expected)
+                expected += 1
+                self._pool.submit(self._execute_guarded, ready)
+            self._expected_seq[caller] = expected
+
+    def _submit_async(self, spec: TaskSpec) -> None:
+        if self._async_loop is None:
+            self._async_loop = asyncio.new_event_loop()
+            t = threading.Thread(
+                target=self._async_loop.run_forever, name="actor-async", daemon=True
+            )
+            t.start()
+            conc = self.actor_spec.max_concurrency if self.actor_spec else 1
+            self._async_sem = asyncio.Semaphore(max(1, conc))
+
+        async def run():
+            async with self._async_sem:
+                await self._execute_async(spec)
+
+        asyncio.run_coroutine_threadsafe(run(), self._async_loop)
+
+    # -- execution --
+
+    def _execute_guarded(self, spec: TaskSpec) -> None:
+        try:
+            self._execute(spec)
+        except BaseException:
+            logger.exception("executor crashed on %s", spec.name)
+
+    def _resolve_args(self, spec: TaskSpec):
+        value_arg = spec.args[0]
+        plain_args, kwargs = serialization.unpack(value_arg.value)
+        ref_args = spec.args[1:]
+        if ref_args:
+            from ray_tpu._private.api import ObjectRef
+
+            refs = [
+                ObjectRef(a.object_id, tuple(a.owner), skip_ref_counting=True)
+                for a in ref_args
+            ]
+            values = self.core.get(refs)
+            # placeholder.index is the 0-based REF-arg order from build_args
+            plain_args = [
+                values[a.index] if isinstance(a, _RefPlaceholder) else a
+                for a in plain_args
+            ]
+        return plain_args, kwargs
+
+    def _maybe_setup_tpu(self, spec: TaskSpec) -> None:
+        if self._tpu_env_set or spec.required_resources().get("TPU", 0) <= 0:
+            return
+        try:
+            chips = self.core._run(
+                self.core.clients.get(self.core.supervisor_addr).call(
+                    "tpu_visible_chips", {"worker_id_hex": self.core.worker_id.hex()}
+                )
+            )
+            if chips and "TPU_VISIBLE_CHIPS" not in os.environ:
+                os.environ["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in chips)
+        except Exception:
+            pass
+        self._tpu_env_set = True
+
+    def _get_callable(self, spec: TaskSpec):
+        if spec.kind == TaskKind.ACTOR_TASK:
+            if self.actor_instance is None:
+                raise RuntimeError("actor task before actor creation")
+            return getattr(self.actor_instance, spec.method_name)
+        return self.core.get_function(spec.function_key)
+
+    def _execute(self, spec: TaskSpec) -> None:
+        if spec.task_id in self._cancelled:
+            from ray_tpu._private.exceptions import TaskCancelledError
+
+            self._report_error(spec, TaskCancelledError(spec.name), retryable=False)
+            return
+        self._maybe_setup_tpu(spec)
+        try:
+            args, kwargs = self._resolve_args(spec)
+            fn = self._get_callable(spec)
+            if spec.kind == TaskKind.ACTOR_CREATION:
+                cls = fn
+                self.actor_instance = cls(*args, **kwargs)
+                self.actor_spec = spec
+                self.core.actor_id = spec.actor_id
+                self.core._run(self._notify_actor_ready(spec))
+                self._report_results(spec, [None])
+                return
+            result = fn(*args, **kwargs)
+            if asyncio.iscoroutine(result):
+                # sync path hit an async def: run it to completion here
+                result = asyncio.new_event_loop().run_until_complete(result)
+            results = self._split_returns(spec, result)
+            self._report_results(spec, results)
+        except Exception as e:  # noqa: BLE001 — user exception crosses to owner
+            err = TaskError.from_exception(spec.name, e)
+            retryable = spec.retry_exceptions
+            if spec.kind == TaskKind.ACTOR_CREATION:
+                self.core._run(self._notify_creation_failed(spec, err))
+                retryable = False
+            self._report_error(spec, err, retryable)
+
+    async def _execute_async(self, spec: TaskSpec) -> None:
+        try:
+            args, kwargs = await asyncio.get_running_loop().run_in_executor(
+                None, self._resolve_args, spec
+            )
+            fn = self._get_callable(spec)
+            result = fn(*args, **kwargs)
+            if asyncio.iscoroutine(result):
+                result = await result
+            results = self._split_returns(spec, result)
+            self._report_results(spec, results)
+        except Exception as e:  # noqa: BLE001
+            self._report_error(spec, TaskError.from_exception(spec.name, e), False)
+
+    def _split_returns(self, spec: TaskSpec, result) -> list:
+        if spec.num_returns == 1:
+            return [result]
+        if not isinstance(result, (tuple, list)) or len(result) != spec.num_returns:
+            raise ValueError(
+                f"task {spec.name} declared num_returns={spec.num_returns} but "
+                f"returned {type(result).__name__}"
+            )
+        return list(result)
+
+    # -- result reporting (owner is the submitter) --
+
+    def _report_results(self, spec: TaskSpec, values: list) -> None:
+        results = []
+        for oid, value in zip(spec.return_ids(), values):
+            packed = serialization.pack(value)
+            if len(packed) <= self.core.config.max_direct_call_object_size:
+                results.append((oid.binary(), "inline", packed))
+            else:
+                self.core._run(self._store_shared(oid, packed))
+                results.append(
+                    (
+                        oid.binary(),
+                        "shared",
+                        {"size": len(packed), "node_addr": self.core.supervisor_addr},
+                    )
+                )
+        self._send_done(spec, {"task_id": spec.task_id.binary(), "results": results})
+
+    async def _store_shared(self, oid: ObjectID, packed: bytes) -> None:
+        sup = self.core.clients.get(self.core.supervisor_addr)
+        r = await sup.call("store_create", {"object_id": oid.binary(), "size": len(packed)})
+        self.core.arena.write(r["offset"], packed)
+        await sup.call("store_seal", {"object_id": oid.binary()})
+
+    def _report_error(self, spec: TaskSpec, err: Exception, retryable: bool) -> None:
+        self._send_done(
+            spec,
+            {
+                "task_id": spec.task_id.binary(),
+                "error": serialization.dumps(err),
+                "retryable": retryable,
+            },
+        )
+
+    def _send_done(self, spec: TaskSpec, body: dict) -> None:
+        async def send():
+            try:
+                await self.core.clients.get(tuple(spec.owner)).call("task_done", body)
+            except Exception:
+                logger.warning("failed to report task_done for %s", spec.name)
+            if spec.kind == TaskKind.NORMAL:
+                # tell the supervisor this slot is free (lease stays cached
+                # owner-side for pipelining; supervisor accounting unchanged)
+                pass
+
+        self.core._run(send())
+
+    async def _notify_actor_ready(self, spec: TaskSpec) -> None:
+        await self.core.clients.get(self.core.controller_addr).call(
+            "actor_ready",
+            {
+                "actor_id_hex": spec.actor_id.hex(),
+                "address": self.core.address,
+                "worker_id_hex": self.core.worker_id.hex(),
+                "node_id_hex": self.core.node_id_hex,
+            },
+        )
+
+    async def _notify_creation_failed(self, spec: TaskSpec, err) -> None:
+        try:
+            await self.core.clients.get(self.core.controller_addr).call(
+                "actor_creation_failed",
+                {"actor_id_hex": spec.actor_id.hex(), "reason": str(err)[:500]},
+            )
+        except Exception:
+            pass
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--supervisor", required=True)
+    parser.add_argument("--controller", required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--arena-path", required=True)
+    parser.add_argument("--arena-size", type=int, required=True)
+    parser.add_argument("--session-dir", default="")
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
+        format="[worker %(process)d] %(asctime)s %(levelname)s %(message)s",
+    )
+
+    def parse_addr(s):
+        host, port = s.rsplit(":", 1)
+        return (host, int(port))
+
+    config = Config.from_env()
+    core = CoreWorker(
+        config,
+        parse_addr(args.controller),
+        parse_addr(args.supervisor),
+        JobID.from_int(0),
+        role="worker",
+    )
+    core.start()
+
+    executor = Executor(core)
+    core.server.register("push_task", executor.push_task)
+    core.server.register("cancel", executor.cancel)
+
+    # make the worker-side public API work inside tasks
+    from ray_tpu._private import api
+
+    api._connect_existing(core)
+
+    ok = core._run(
+        core.clients.get(parse_addr(args.supervisor)).call(
+            "worker_register",
+            {
+                "worker_id_hex": core.worker_id.hex(),
+                "address": core.address,
+                "pid": os.getpid(),
+                "env_key": os.environ.get("RAY_TPU_WORKER_ENV_KEY", ""),
+            },
+        )
+    )
+    logger.info("worker %s registered, serving", core.worker_id.hex()[:8])
+    threading.Event().wait()  # serve forever; supervisor kills us
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+"""Device-mesh construction for DP / FSDP / TP / SP / EP / PP axes.
+
+This is the TPU-native replacement for the reference's process-group world
+(`ray.util.collective` + torch.distributed NCCL groups, SURVEY §2.2/§5):
+instead of N ranks and explicit NCCL calls, parallelism is expressed as named
+axes of a `jax.sharding.Mesh`; XLA/GSPMD inserts the ICI collectives.
+
+Canonical axis names (used by sharding rules and the trainer):
+  * ``dp``   — pure data parallel (gradient all-reduce over ICI/DCN)
+  * ``fsdp`` — data parallel with parameter/optimizer sharding (ZeRO-3-style,
+               all-gather params forward, reduce-scatter grads)
+  * ``tp``   — tensor (megatron) parallelism within attention/MLP blocks
+  * ``sp``   — sequence/context parallelism (ring attention over this axis)
+  * ``ep``   — expert parallelism for MoE layers
+  * ``pp``   — pipeline stages (usually over DCN between slices)
+
+Mesh-axis ordering follows the scaling-book recipe: the innermost (fastest
+varying) axes map to the densest ICI links, so tp/sp live innermost, dp/fsdp
+outermost, pp over DCN.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """A declarative mesh: axis name → size. Unlisted axes have size 1."""
+
+    axes: Tuple[Tuple[str, int], ...]
+
+    @classmethod
+    def of(cls, **sizes: int) -> "MeshSpec":
+        for name in sizes:
+            if name not in AXIS_ORDER:
+                raise ValueError(f"unknown mesh axis {name!r}; valid: {AXIS_ORDER}")
+        ordered = tuple((a, sizes.get(a, 1)) for a in AXIS_ORDER if sizes.get(a, 1) > 1)
+        return cls(ordered if ordered else (("dp", 1),))
+
+    @property
+    def size(self) -> int:
+        return math.prod(s for _, s in self.axes)
+
+    @property
+    def names(self) -> Tuple[str, ...]:
+        return tuple(a for a, _ in self.axes)
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(s for _, s in self.axes)
+
+    def axis_size(self, name: str) -> int:
+        for a, s in self.axes:
+            if a == name:
+                return s
+        return 1
+
+    @classmethod
+    def auto(cls, n_devices: int, *, model_needs_tp: int = 1, fsdp: bool = True) -> "MeshSpec":
+        """Simple auto-layout: give tp what the model needs, rest to fsdp/dp."""
+        tp = min(model_needs_tp, n_devices)
+        rest = n_devices // tp
+        if fsdp:
+            return cls.of(fsdp=rest, tp=tp)
+        return cls.of(dp=rest, tp=tp)
+
+
+def build_mesh(spec: MeshSpec, devices: Optional[Sequence] = None):
+    """Build a `jax.sharding.Mesh` with the spec's named axes.
+
+    Devices default to all visible devices; their count must equal spec.size.
+    """
+    import jax
+    import numpy as np
+
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) != spec.size:
+        raise ValueError(
+            f"mesh spec needs {spec.size} devices ({dict(spec.axes)}), "
+            f"got {len(devices)}"
+        )
+    arr = np.array(devices).reshape(spec.shape)
+    from jax.sharding import Mesh
+
+    return Mesh(arr, spec.names)
+
+
+def local_mesh(**sizes: int):
+    """Convenience: mesh over this process's visible devices."""
+    return build_mesh(MeshSpec.of(**sizes))
+
+
+def data_sharding(mesh, batch_axes: Sequence[str] = ("dp", "fsdp")):
+    """NamedSharding for a [batch, ...] input: batch split over data axes."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    present = [a for a in batch_axes if a in mesh.axis_names]
+    return NamedSharding(mesh, PartitionSpec(tuple(present) if present else None))
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec())

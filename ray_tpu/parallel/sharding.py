@@ -1,0 +1,118 @@
+"""Logical-axis sharding rules (flax-style) for model state.
+
+Replaces the reference's DDP/FSDP wrapping step
+(`train/torch/train_loop_utils.py:158` `prepare_model`): instead of wrapping
+modules, parameters carry *logical axis names* and a rule table maps them to
+mesh axes; `jax.device_put` with the resulting NamedSharding both shards and
+(under fsdp) ZeRO-partitions the state in one step. XLA then inserts the
+all-gathers/reduce-scatters GSPMD-style.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+LogicalAxes = Tuple[Optional[str], ...]
+
+
+# Default rule table: logical axis name -> mesh axis (or None = replicate).
+DEFAULT_RULES: Dict[str, Optional[Union[str, Tuple[str, ...]]]] = {
+    # activations
+    "batch": ("dp", "fsdp"),
+    "seq": "sp",
+    # params
+    "embed": "fsdp",  # ZeRO-shard the embed dim of params over fsdp
+    "vocab": "tp",
+    "mlp": "tp",
+    "heads": "tp",
+    "kv": None,
+    "head_dim": None,
+    "layers": None,
+    "expert": "ep",
+}
+
+
+@dataclasses.dataclass
+class ShardingRules:
+    rules: Dict[str, Optional[Union[str, Tuple[str, ...]]]] = dataclasses.field(
+        default_factory=lambda: dict(DEFAULT_RULES)
+    )
+
+    def with_overrides(self, **overrides) -> "ShardingRules":
+        new = dict(self.rules)
+        new.update(overrides)
+        return ShardingRules(new)
+
+    def spec(self, logical: Sequence[Optional[str]], mesh) -> "Any":
+        """PartitionSpec for one array's logical axes, dropping mesh axes the
+        mesh doesn't have (so the same model runs on any mesh)."""
+        from jax.sharding import PartitionSpec
+
+        out = []
+        used = set()
+        for name in logical:
+            target = self.rules.get(name) if name else None
+            if target is None:
+                out.append(None)
+                continue
+            targets = (target,) if isinstance(target, str) else tuple(target)
+            present = tuple(
+                t for t in targets if t in mesh.axis_names and t not in used
+            )
+            used.update(present)
+            if not present:
+                out.append(None)
+            elif len(present) == 1:
+                out.append(present[0])
+            else:
+                out.append(present)
+        return PartitionSpec(*out)
+
+
+def logical_to_spec(rules: ShardingRules, logical_tree, mesh):
+    """Map a pytree of logical-axis tuples to a pytree of PartitionSpecs."""
+    import jax
+
+    return jax.tree.map(
+        lambda ax: rules.spec(ax, mesh),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            a is None or isinstance(a, str) for a in x
+        ),
+    )
+
+
+def infer_logical_axes(params) -> Any:
+    """Heuristic logical axes for a params pytree when the model doesn't
+    annotate: 2D [in, out] weights shard ('embed','mlp')-style; 1D replicate.
+
+    Good enough for FSDP (shard the largest dim over fsdp); models in
+    ray_tpu.models annotate explicitly instead.
+    """
+    import jax
+    import numpy as np
+
+    def leaf_axes(x):
+        shape = getattr(x, "shape", ())
+        if len(shape) <= 1:
+            return (None,) * len(shape)
+        axes: list = [None] * len(shape)
+        axes[int(np.argmax(shape))] = "embed"
+        return tuple(axes)
+
+    return jax.tree.map(leaf_axes, params)
+
+
+def shard_params(params, mesh, rules: Optional[ShardingRules] = None, logical=None):
+    """Place a params pytree onto the mesh per the rules (ZeRO/fsdp aware)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    rules = rules or ShardingRules()
+    if logical is None:
+        logical = infer_logical_axes(params)
+    specs = logical_to_spec(rules, logical, mesh)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )

@@ -1,0 +1,98 @@
+"""TPU pod-slice topology and gang scheduling.
+
+Analog of the reference's TPU accelerator support
+(`python/ray/_private/accelerators/tpu.py`): pod-slice topology env vars
+(`tpu.py:44-49`), the ``TPU-<version>-head`` gang resource for multi-host
+scheduling, and chip isolation. Here a slice-wide job is a STRICT_SPREAD
+placement group: one bundle per host, each demanding the host's chips, with
+bundle 0 adding the slice-head resource — solving the reference's "gang lease"
+gap for pod-wide pjit programs (SURVEY §7 hard-parts).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from typing import Dict, List, Optional
+
+# chips per host for known TPU generations (v4/v5p: 4 chips/host; v5e/v6e: 8
+# for the common configurations; overridable).
+_CHIPS_PER_HOST = {"v4": 4, "v5p": 4, "v5litepod": 8, "v5e": 8, "v6e": 8}
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceTopology:
+    """A TPU slice, e.g. v5p-64: generation, total chips, chips per host."""
+
+    generation: str
+    num_chips: int
+    chips_per_host: int
+
+    @classmethod
+    def parse(cls, name: str) -> "SliceTopology":
+        """Parse an accelerator-type string like 'v5p-64' or 'v4-8'.
+
+        The trailing number is TensorCores for v2-v4 (2 cores/chip) and chips
+        for v5e+; we normalize to chips.
+        """
+        m = re.fullmatch(r"(v\d+[a-z]*(?:pod)?)-(\d+)", name.strip().lower())
+        if not m:
+            raise ValueError(f"cannot parse TPU topology {name!r}")
+        gen, n = m.group(1), int(m.group(2))
+        cores_per_chip = 2 if gen in ("v2", "v3", "v4", "v5p") else 1
+        chips = n // cores_per_chip
+        cph = _CHIPS_PER_HOST.get(gen, 4)
+        return cls(gen, max(chips, 1), min(cph, max(chips, 1)))
+
+    @property
+    def num_hosts(self) -> int:
+        return max(1, self.num_chips // self.chips_per_host)
+
+    @property
+    def head_resource(self) -> str:
+        """The gang-head resource name, ≈ reference's `TPU-<ver>-head`."""
+        return f"TPU-{self.generation}-{self.num_chips}-head"
+
+    def bundles(self) -> List[Dict[str, float]]:
+        """One bundle per host; bundle 0 carries the head resource."""
+        out = []
+        for host in range(self.num_hosts):
+            b: Dict[str, float] = {"TPU": float(self.chips_per_host)}
+            if host == 0:
+                b[self.head_resource] = 1.0
+            out.append(b)
+        return out
+
+    @classmethod
+    def detect(cls) -> Optional["SliceTopology"]:
+        """Detect from TPU VM metadata env (no device access)."""
+        acc = os.environ.get("TPU_ACCELERATOR_TYPE") or os.environ.get(
+            "RAY_TPU_TOPOLOGY"
+        )
+        if acc:
+            try:
+                return cls.parse(acc)
+            except ValueError:
+                return None
+        return None
+
+
+def slice_placement_group(topology: SliceTopology, name: str = ""):
+    """Reserve a whole slice as a gang: STRICT_SPREAD, one bundle per host."""
+    from ray_tpu.util.placement_group import placement_group
+
+    strategy = "STRICT_SPREAD" if topology.num_hosts > 1 else "STRICT_PACK"
+    return placement_group(
+        topology.bundles(), strategy=strategy, name=name or f"slice-{topology.generation}"
+    )
+
+
+def worker_env_for_host(topology: SliceTopology, host_index: int, coordinator: str) -> Dict[str, str]:
+    """Env vars for the per-host trainer worker: pod-slice wiring
+    (≈ reference tpu.py:44-49 TPU_WORKER_ID / TPU_WORKER_HOSTNAMES)."""
+    return {
+        "TPU_WORKER_ID": str(host_index),
+        "RAY_TPU_COORDINATOR": coordinator,
+        "RAY_TPU_NUM_HOSTS": str(topology.num_hosts),
+    }

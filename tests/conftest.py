@@ -1,0 +1,56 @@
+"""Test harness configuration.
+
+Forces JAX onto a virtual 8-device CPU backend (the TPU sharding tests run on
+a CPU mesh, per the reference's pattern of hermetic single-host clusters,
+SURVEY §4) and keeps all spawned daemons/workers off the TPU plugin.
+"""
+
+import os
+
+# Must happen before any jax backend initialization, and is inherited by every
+# daemon/worker subprocess the tests spawn.
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("RAY_TPU_LOG_LEVEL", "WARNING")
+
+try:  # sitecustomize may have imported jax already; redirect it to CPU
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def ray_init():
+    """A started single-node cluster with 4 CPUs (module-scoped for speed)."""
+    import ray_tpu
+
+    info = ray_tpu.init(
+        num_cpus=32,  # virtual: plenty of headroom for long-lived test actors
+        object_store_memory=256 * 1024 * 1024,
+        ignore_reinit_error=True,
+    )
+    yield info
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_cluster():
+    """A multi-node cluster factory; nodes added by the test."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster()
+    yield cluster
+    import ray_tpu
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    cluster.shutdown()

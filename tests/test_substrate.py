@@ -1,0 +1,269 @@
+"""Unit tests for the substrate: IDs, config, serialization, allocator,
+scheduling policies, RPC. (≈ the reference's C++ unit tier, SURVEY §4.)"""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu._private import serialization
+from ray_tpu._private.config import Config
+from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID
+from ray_tpu._private.object_store import NodeObjectStore, OutOfMemoryError, _FreeList
+from ray_tpu._private.resources import ResourceSet
+from ray_tpu._private.rpc import RemoteError, RpcClient, RpcServer
+from ray_tpu._private.scheduling import NodeView, PlacementError, pick_node, place_bundles
+from ray_tpu._private.task_spec import NodeAffinityStrategy, SchedulingStrategy, SpreadStrategy
+
+
+class TestIDs:
+    def test_roundtrip(self):
+        t = TaskID.from_random()
+        assert TaskID.from_hex(t.hex()) == t
+        assert len(t.binary()) == 16
+
+    def test_object_id_lineage(self):
+        t = TaskID.from_random()
+        o = ObjectID.for_task_return(t, 3)
+        assert o.task_id() == t
+        assert o.return_index() == 3
+        assert not o.is_put()
+        assert ObjectID.from_put().is_put()
+
+    def test_actor_id_embeds_job(self):
+        j = JobID.from_int(7)
+        a = ActorID.of(j)
+        assert a.job_id() == j
+
+    def test_nil(self):
+        assert NodeID.nil().is_nil()
+        assert not NodeID.from_random().is_nil()
+
+
+class TestConfig:
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_TASK_MAX_RETRIES", "7")
+        monkeypatch.setenv("RAY_TPU_FAKE_CLUSTER", "true")
+        cfg = Config.from_env()
+        assert cfg.task_max_retries == 7
+        assert cfg.fake_cluster is True
+
+    def test_system_config_overrides(self):
+        cfg = Config.from_env({"max_tasks_in_flight_per_worker": 3})
+        assert cfg.max_tasks_in_flight_per_worker == 3
+        with pytest.raises(ValueError):
+            Config.from_env({"not_a_flag": 1})
+
+    def test_to_env_roundtrip(self):
+        cfg = Config.from_env({"task_max_retries": 9})
+        env = cfg.to_env()
+        assert env["RAY_TPU_TASK_MAX_RETRIES"] == "9"
+
+
+class TestSerialization:
+    def test_roundtrip_plain(self):
+        obj = {"a": [1, 2, 3], "b": "hello", "c": (4.5, None)}
+        assert serialization.unpack(serialization.pack(obj)) == obj
+
+    def test_numpy_out_of_band(self):
+        arr = np.arange(1_000_000, dtype=np.float32)
+        packed = serialization.pack(arr)
+        out = serialization.unpack(packed)
+        np.testing.assert_array_equal(arr, out)
+        # out-of-band: header overhead small relative to payload
+        assert len(packed) < arr.nbytes + 10_000
+
+    def test_closure(self):
+        x = 41
+
+        def fn(y):
+            return x + y
+
+        fn2 = serialization.loads(serialization.dumps(fn))
+        assert fn2(1) == 42
+
+
+class TestFreeList:
+    def test_alloc_free_coalesce(self):
+        fl = _FreeList(1 << 20)
+        a = fl.alloc(1000)
+        b = fl.alloc(2000)
+        c = fl.alloc(3000)
+        assert {a, b, c} == {0, 4096, 8192}
+        fl.free(a, 1000)
+        fl.free(c, 3000)
+        fl.free(b, 2000)  # coalesces back to one block
+        assert fl.free_bytes() == 1 << 20
+        assert fl.alloc(1 << 20) == 0
+
+    def test_exhaustion(self):
+        fl = _FreeList(8192)
+        assert fl.alloc(8192) == 0
+        assert fl.alloc(1) is None
+
+
+class TestNodeObjectStore:
+    def test_create_seal_read_free(self, tmp_path):
+        store = NodeObjectStore(str(tmp_path / "arena"), 1 << 20, str(tmp_path / "spill"))
+        oid = ObjectID.from_put()
+        off = store.create(oid, 100)
+        store.arena.write(off, b"x" * 100)
+        store.seal(oid)
+        assert store.contains(oid)
+        assert store.read_chunk(oid, 0, 100) == b"x" * 100
+        store.free(oid)
+        assert not store.contains(oid)
+        store.shutdown()
+
+    def test_spill_restore(self, tmp_path):
+        store = NodeObjectStore(str(tmp_path / "arena"), 64 * 4096, str(tmp_path / "spill"))
+        oids = []
+        for i in range(8):
+            oid = ObjectID.from_put()
+            off = store.create(oid, 8 * 4096)
+            store.arena.write(off, bytes([i]) * (8 * 4096))
+            store.seal(oid)
+            oids.append(oid)
+        # store is now full; next create must spill LRU objects
+        extra = ObjectID.from_put()
+        off = store.create(extra, 16 * 4096)
+        store.seal(extra)
+        assert store.num_spilled >= 2
+        # spilled objects still readable (restored on demand)
+        data = store.read_chunk(oids[0], 0, 10)
+        assert data == bytes([0]) * 10
+        assert store.num_restored >= 1
+        store.shutdown()
+
+    def test_oom(self, tmp_path):
+        store = NodeObjectStore(str(tmp_path / "arena"), 8 * 4096, str(tmp_path / "spill"))
+        with pytest.raises(OutOfMemoryError):
+            store.create(ObjectID.from_put(), 64 * 4096)
+        store.shutdown()
+
+
+def _views(*specs):
+    out = []
+    for i, (total, avail) in enumerate(specs):
+        out.append(
+            NodeView(
+                node_id_hex=f"{i:032x}",
+                address=("127.0.0.1", 1000 + i),
+                total=ResourceSet.of(total),
+                available=ResourceSet.of(avail),
+            )
+        )
+    return out
+
+
+class TestSchedulingPolicies:
+    def test_hybrid_prefers_local_below_threshold(self):
+        views = _views(({"CPU": 4}, {"CPU": 4}), ({"CPU": 4}, {"CPU": 4}))
+        picked = pick_node(
+            views, {"CPU": 1}, SchedulingStrategy(), local_node_hex=views[1].node_id_hex
+        )
+        assert picked.node_id_hex == views[1].node_id_hex
+
+    def test_hybrid_spills_when_local_busy(self):
+        views = _views(({"CPU": 4}, {"CPU": 4}), ({"CPU": 4}, {"CPU": 1}))
+        picked = pick_node(
+            views,
+            {"CPU": 1},
+            SchedulingStrategy(),
+            local_node_hex=views[1].node_id_hex,
+            spread_threshold=0.5,
+        )
+        assert picked.node_id_hex == views[0].node_id_hex
+
+    def test_infeasible_returns_none(self):
+        views = _views(({"CPU": 4}, {"CPU": 4}))
+        assert pick_node(views, {"TPU": 8}, SchedulingStrategy()) is None
+
+    def test_node_affinity(self):
+        views = _views(({"CPU": 4}, {"CPU": 4}), ({"CPU": 4}, {"CPU": 4}))
+        strat = NodeAffinityStrategy(node_id_hex=views[0].node_id_hex)
+        assert pick_node(views, {"CPU": 1}, strat).node_id_hex == views[0].node_id_hex
+
+    def test_spread_balances(self):
+        views = _views(({"CPU": 4}, {"CPU": 2}), ({"CPU": 4}, {"CPU": 4}))
+        picked = pick_node(views, {"CPU": 1}, SpreadStrategy())
+        assert picked.node_id_hex == views[1].node_id_hex
+
+    def test_bundle_strict_pack(self):
+        views = _views(({"CPU": 8}, {"CPU": 8}), ({"CPU": 2}, {"CPU": 2}))
+        assignment = place_bundles(views, [{"CPU": 2}, {"CPU": 2}], "STRICT_PACK")
+        assert assignment == [views[0].node_id_hex] * 2
+
+    def test_bundle_strict_spread_infeasible(self):
+        views = _views(({"CPU": 8}, {"CPU": 8}))
+        with pytest.raises(PlacementError):
+            place_bundles(views, [{"CPU": 1}, {"CPU": 1}], "STRICT_SPREAD")
+
+    def test_bundle_strict_spread(self):
+        views = _views(({"CPU": 2}, {"CPU": 2}), ({"CPU": 2}, {"CPU": 2}))
+        assignment = place_bundles(views, [{"CPU": 1}, {"CPU": 1}], "STRICT_SPREAD")
+        assert len(set(assignment)) == 2
+
+
+class TestRpc:
+    def test_request_reply_and_errors(self):
+        async def run():
+            server = RpcServer()
+
+            async def echo(body):
+                return {"echo": body}
+
+            def boom(body):
+                raise ValueError("bad input")
+
+            server.register("echo", echo)
+            server.register("boom", boom)
+            addr = await server.start()
+            client = RpcClient(addr)
+            out = await client.call("echo", {"x": 1})
+            assert out == {"echo": {"x": 1}}
+            with pytest.raises(RemoteError) as ei:
+                await client.call("boom", {})
+            assert isinstance(ei.value.cause, ValueError)
+            # concurrent calls multiplex on one connection
+            outs = await asyncio.gather(*(client.call("echo", i) for i in range(20)))
+            assert [o["echo"] for o in outs] == list(range(20))
+            await client.close()
+            await server.stop()
+
+        asyncio.run(run())
+
+    def test_oneway_notify(self):
+        async def run():
+            server = RpcServer()
+            seen = []
+            server.register("note", lambda body: seen.append(body))
+            addr = await server.start()
+            client = RpcClient(addr)
+            await client.notify("note", "hello")
+            for _ in range(100):
+                if seen:
+                    break
+                await asyncio.sleep(0.01)
+            assert seen == ["hello"]
+            await client.close()
+            await server.stop()
+
+        asyncio.run(run())
+
+
+class TestResources:
+    def test_fits_subtract_add(self):
+        a = ResourceSet.of({"CPU": 4, "TPU": 8})
+        b = ResourceSet.of({"CPU": 2})
+        assert a.fits(b)
+        a.subtract(b)
+        assert a["CPU"] == 2
+        a.add(b)
+        assert a["CPU"] == 4
+        assert not ResourceSet.of({"CPU": 1}).fits(ResourceSet.of({"CPU": 2}))
+
+    def test_negative_raises(self):
+        with pytest.raises(ValueError):
+            ResourceSet.of({"CPU": -1})
