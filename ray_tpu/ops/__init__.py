@@ -1,0 +1,12 @@
+"""TPU-native compute ops: Pallas kernels + JAX references.
+
+This layer has no counterpart in the reference (Ray delegates device compute to
+torch/tf inside worker processes); here the hot ops are first-class so the
+libraries above (train/serve/rllib) compile one fused XLA program per step.
+"""
+
+from ray_tpu.ops.norms import layer_norm, rms_norm  # noqa: F401
+from ray_tpu.ops.rotary import apply_rotary, rope_frequencies  # noqa: F401
+from ray_tpu.ops.losses import softmax_cross_entropy  # noqa: F401
+from ray_tpu.ops.attention import attention  # noqa: F401
+from ray_tpu.ops.ring_attention import ring_attention  # noqa: F401

@@ -1,0 +1,31 @@
+"""Attention dispatcher: picks the Pallas flash kernel on TPU (or when forced),
+the XLA reference otherwise. Single entry point for all models."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+
+from ray_tpu.ops.flash_attention import flash_attention, reference_attention
+
+
+def attention(q, k, v, *, causal: bool = True, sm_scale: Optional[float] = None,
+              impl: str = "auto", bias=None):
+    """Multi-head / grouped-query attention.
+
+    q: [B, Sq, H, D]; k, v: [B, Sk, Hkv, D] with H % Hkv == 0.
+    impl: 'auto' | 'flash' | 'reference'. 'auto' uses the Pallas kernel on TPU
+    and the XLA reference elsewhere (the kernel still runs everywhere via
+    interpret mode when explicitly selected, which is how CPU tests cover it).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if bias is not None:
+        impl = "reference"
+    if impl == "auto":
+        impl = "flash" if jax.default_backend() == "tpu" else "reference"
+    if impl == "flash":
+        return flash_attention(q, k, v, sm_scale, causal)
+    return reference_attention(q, k, v, sm_scale, causal, bias=bias)

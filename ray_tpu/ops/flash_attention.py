@@ -1,0 +1,166 @@
+"""Pallas TPU flash attention (forward), online-softmax blockwise.
+
+Layout [B, S, H, D] (seq-major, matches the models). GQA supported by mapping
+each query head to its kv head in the BlockSpec index map — kv heads are never
+materialized repeated in HBM. Off-TPU the kernel runs in interpreter mode so
+the same code path is exercised by the CPU test mesh.
+
+Backward pass: custom_vjp whose bwd recomputes attention via the XLA reference
+implementation (flash-style memory savings forward, remat backward). A
+dedicated Pallas bwd kernel can replace it without touching callers.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+_LANES = 128
+
+
+def _pick_block(seq: int, target: int) -> int:
+    """Largest power-of-two divisor of seq that is <= target (>=1)."""
+    b = 1
+    while b * 2 <= target and seq % (b * 2) == 0:
+        b *= 2
+    return b
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                sm_scale, causal, block_q, block_k, num_kv):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal: skip blocks entirely in the future of this q block.
+    should_run = (qi * block_q + block_q > ki * block_k) if causal else (ki >= 0)
+
+    @pl.when(should_run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * sm_scale
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        v = v_ref[0, 0].astype(jnp.float32)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == num_kv - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    batch, seq_q, num_heads, head_dim = q.shape
+    _, seq_k, num_kv_heads, _ = k.shape
+    group = num_heads // num_kv_heads
+
+    # head-major for the kernel: [B, H, S, D]
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    block_q = _pick_block(seq_q, block_q)
+    block_k = _pick_block(seq_k, block_k)
+    grid = (batch, num_heads, seq_q // block_q, seq_k // block_k)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_kv=seq_k // block_k),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, head_dim),
+                         lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, head_dim),
+                         lambda b, h, qi, ki, g=group: (b, h // g, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, head_dim),
+                         lambda b, h, qi, ki, g=group: (b, h // g, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, head_dim),
+                               lambda b, h, qi, ki: (b, h, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, head_dim), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)
+
+
+def reference_attention(q, k, v, sm_scale=None, causal=True, bias=None):
+    """XLA reference: [B, S, H, D] x [B, S, Hkv, D] GQA attention, f32 softmax."""
+    batch, seq_q, num_heads, head_dim = q.shape
+    _, seq_k, num_kv_heads, _ = k.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(head_dim)
+    group = num_heads // num_kv_heads
+    qg = q.reshape(batch, seq_q, num_kv_heads, group, head_dim)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if bias is not None:
+        s = s + bias
+    if causal:
+        qpos = jnp.arange(seq_q)[:, None]
+        kpos = jnp.arange(seq_k)[None, :]
+        s = jnp.where(qpos >= kpos, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", p, v.astype(jnp.float32))
+    return out.reshape(batch, seq_q, num_heads, head_dim).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, sm_scale=None, causal=True,
+                    block_q=512, block_k=512):
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    interpret = jax.default_backend() != "tpu"
+    return _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+
+
+def _fwd_rule(q, k, v, sm_scale, causal, block_q, block_k):
+    return flash_attention(q, k, v, sm_scale, causal, block_q, block_k), (q, k, v)
+
+
+def _bwd_rule(sm_scale, causal, block_q, block_k, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: reference_attention(q_, k_, v_, sm_scale, causal),
+        q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd_rule, _bwd_rule)

@@ -1,0 +1,26 @@
+"""Loss ops. Cross entropy in float32 with optional z-loss, mask-aware."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits, labels, mask=None, z_loss: float = 0.0):
+    """Token-level CE. logits: [..., vocab] (any dtype), labels: [...] int,
+    mask: [...] {0,1}. Returns (mean_loss, n_tokens). The max-subtraction and
+    logsumexp run in f32 so bf16 logits are safe on the MXU."""
+    logits = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    label_logits = jnp.take_along_axis(
+        logits, labels[..., None], axis=-1
+    ).squeeze(-1)
+    loss = lse - label_logits
+    if z_loss > 0.0:
+        loss = loss + z_loss * jnp.square(lse)
+    if mask is None:
+        n = jnp.array(loss.size, jnp.float32)
+        return jnp.mean(loss), n
+    mask = mask.astype(jnp.float32)
+    n = jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.sum(loss * mask) / n, n

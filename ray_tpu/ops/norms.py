@@ -1,0 +1,28 @@
+"""Normalization ops. Computed in float32 regardless of input dtype (bf16-safe),
+cast back to the input dtype so XLA fuses them into neighboring matmuls."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(x, weight, eps: float = 1e-6):
+    """RMSNorm (LLaMA-style): x * rsqrt(mean(x^2)) * weight."""
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    out = x * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (out * weight.astype(jnp.float32)).astype(dtype)
+
+
+def layer_norm(x, weight, bias, eps: float = 1e-5):
+    """LayerNorm (GPT-2-style)."""
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    out = (x - mean) * jnp.reciprocal(jnp.sqrt(var + eps))
+    out = out * weight.astype(jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(dtype)

@@ -1,0 +1,101 @@
+"""Ring attention: exact attention over sequences sharded on the `sp` mesh axis.
+
+The reference has no sequence parallelism (SURVEY §5 "absent in the
+reference"); here it is first-class. Each device holds a contiguous sequence
+chunk of q/k/v; kv chunks rotate around the ring via `lax.ppermute` (ICI
+neighbor exchange) while each device accumulates online-softmax partial
+results against its local q. After `sp` steps every q block has seen every kv
+block, with peak memory O(S_local) and compute overlapping the permute.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _partial_attn(q, k, v, q_off, k_off, causal, sm_scale):
+    """Unnormalized blockwise attention of local q against one kv chunk.
+
+    q: [B, Sq, H, D], k/v: [B, Sk, Hkv, D]. Offsets are global sequence
+    positions of element 0. Returns (num [B,Sq,H,D] f32, m, l [B,Sq,H,1] f32).
+    """
+    b, sq, h, d = q.shape
+    _, sk, hkv, _ = k.shape
+    group = h // hkv
+    qg = q.reshape(b, sq, hkv, group, d)
+    s = jnp.einsum("bqhgd,bkhd->bqhgk", qg.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if causal:
+        qpos = q_off + jnp.arange(sq)
+        kpos = k_off + jnp.arange(sk)
+        mask = qpos[:, None] >= kpos[None, :]          # [Sq, Sk]
+        s = jnp.where(mask[None, :, None, None, :], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)             # [b,q,hkv,g,1]
+    m = jnp.maximum(m, NEG_INF)                        # fully-masked rows stay finite
+    p = jnp.exp(s - m)
+    p = jnp.where(s <= NEG_INF, 0.0, p)                # kill masked contributions
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    num = jnp.einsum("bqhgk,bkhd->bqhgd", p, v.astype(jnp.float32))
+    return (num.reshape(b, sq, h, d),
+            m.reshape(b, sq, h, 1),
+            l.reshape(b, sq, h, 1))
+
+
+def ring_attention_local(q, k, v, axis_name: str, *, causal: bool = True,
+                         sm_scale: Optional[float] = None):
+    """Call inside shard_map: q/k/v are the local [B, S_local, (H|Hkv), D]
+    shards of sequences sharded over `axis_name`."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    sq = q.shape[1]
+    sk = k.shape[1]
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(carry, i):
+        acc, m_run, l_run, k_cur, v_cur = carry
+        src = (my_idx - i) % axis_size          # global chunk index k_cur holds
+        num, m_blk, l_blk = _partial_attn(
+            q, k_cur, v_cur, my_idx * sq, src * sk, causal, sm_scale)
+        m_new = jnp.maximum(m_run, m_blk)
+        alpha = jnp.exp(m_run - m_new)
+        beta = jnp.exp(m_blk - m_new)
+        acc = acc * alpha + num * beta
+        l_run = l_run * alpha + l_blk * beta
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (acc, m_new, l_run, k_nxt, v_nxt), None
+
+    b, _, h, d = q.shape
+    init = (
+        jnp.zeros((b, sq, h, d), jnp.float32),
+        jnp.full((b, sq, h, 1), NEG_INF, jnp.float32),
+        jnp.zeros((b, sq, h, 1), jnp.float32),
+    )
+    (acc, _, l_run, _, _), _ = lax.scan(
+        step, init + (k, v), jnp.arange(axis_size))
+    l_run = jnp.where(l_run == 0.0, 1.0, l_run)
+    return (acc / l_run).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, *, axis: str = "sp", causal: bool = True,
+                   sm_scale: Optional[float] = None):
+    """Whole-array entry: shards q/k/v over `axis` on their seq dim and runs
+    the ring. q: [B, S, H, D]; S must divide evenly by mesh.shape[axis]."""
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+    import functools
+
+    spec = P(None, axis, None, None)
+    fn = functools.partial(ring_attention_local, axis_name=axis,
+                           causal=causal, sm_scale=sm_scale)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(q, k, v)

@@ -1,0 +1,32 @@
+"""Rotary position embeddings (RoPE), half-rotation layout (LLaMA/GPT-NeoX)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, max_len: int, theta: float = 10000.0,
+                     dtype=jnp.float32, position_offset: int = 0):
+    """Precompute (cos, sin) tables of shape [max_len, head_dim//2]."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    pos = jnp.arange(position_offset, position_offset + max_len, dtype=jnp.float32)
+    angles = jnp.outer(pos, inv_freq)
+    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def apply_rotary(x, cos, sin, positions=None):
+    """Rotate q or k. x: [..., seq, heads, head_dim]; cos/sin: [max_len, hd//2]
+    or already gathered [..., seq, hd//2] when `positions` is None and shapes
+    match. `positions`: optional [..., seq] int32 gather indices (decode)."""
+    if positions is not None:
+        cos = cos[positions]
+        sin = sin[positions]
+    else:
+        cos = cos[: x.shape[-3]]
+        sin = sin[: x.shape[-3]]
+    # broadcast over heads: [..., seq, 1, hd//2]
+    cos = jnp.expand_dims(cos, axis=-2)
+    sin = jnp.expand_dims(sin, axis=-2)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
