@@ -1,0 +1,91 @@
+"""Benchmark: causal-LM training MFU on the local chip.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+Baseline (BASELINE.md): the reference delegates device math to torch; our
+target band is 45% MFU for the Train-equivalent path, so vs_baseline is
+measured MFU / 0.45.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+PEAK_FLOPS = {
+    # bf16 peak per chip
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6e": 918e12,
+    "TPU v6 lite": 918e12,
+    "cpu": 1e12,  # nominal, so the metric stays defined off-TPU
+}
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu")
+    for name, flops in PEAK_FLOPS.items():
+        if name.lower() in str(kind).lower():
+            return flops
+    return PEAK_FLOPS["cpu"]
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt2_small, count_params
+    from ray_tpu.models.training import (OptimizerConfig, init_train_state,
+                                         make_train_step)
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = gpt2_small()
+        batch, seq, steps = 16, 1024, 20
+    else:  # keep the CPU smoke run short
+        cfg = gpt2_small(num_layers=2, embed_dim=128, num_heads=4,
+                         vocab_size=1024, dtype=jnp.float32)
+        batch, seq, steps = 4, 128, 3
+
+    ocfg = OptimizerConfig(warmup_steps=10, decay_steps=1000)
+    state, tx = init_train_state(cfg, ocfg, jax.random.PRNGKey(0))
+    step = make_train_step(cfg, tx)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
+                                cfg.vocab_size)
+    b = {"tokens": tokens}
+
+    state, m = step(state, b)  # compile + warmup
+    float(m["loss"])  # host transfer: block_until_ready is a no-op under axon
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = step(state, b)
+    float(m["loss"])
+    dt = (time.perf_counter() - t0) / steps
+
+    n_params = count_params(state.params)
+    tokens_per_step = batch * seq
+    # Model FLOPs only (MFU convention — remat recompute excluded):
+    # fwd+bwd ≈ 6 flops/param/token + attention 12*L*S*E per token.
+    flops_per_token = 6 * n_params + 12 * cfg.num_layers * seq * cfg.embed_dim
+    achieved = flops_per_token * tokens_per_step / dt
+    mfu = achieved / _peak_flops(jax.devices()[0])
+
+    print(json.dumps({
+        "metric": "gpt2s_train_mfu" if on_tpu else "gpt2s_train_mfu_cpu_smoke",
+        "value": round(mfu, 4),
+        "unit": "fraction_of_peak",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "detail": {
+            "tokens_per_sec": round(tokens_per_step / dt),
+            "step_time_ms": round(dt * 1e3, 2),
+            "params": n_params,
+            "device": str(getattr(jax.devices()[0], "device_kind", "cpu")),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

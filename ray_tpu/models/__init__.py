@@ -1,0 +1,17 @@
+"""Model zoo: pure-JAX decoder-only transformers with logical-axis-annotated
+param pytrees (shardable onto any mesh via ray_tpu.parallel.sharding)."""
+
+from ray_tpu.models.transformer import (  # noqa: F401
+    TransformerConfig,
+    init_params,
+    forward,
+    logical_axes,
+    loss_fn,
+    count_params,
+)
+from ray_tpu.models.presets import (  # noqa: F401
+    gpt2_small,
+    gpt2_medium,
+    llama3_8b,
+    llama_debug,
+)

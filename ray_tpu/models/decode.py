@@ -1,0 +1,114 @@
+"""Incremental decoding: functional per-layer KV caches + greedy/temperature
+sampling loop, all jit-compatible (static shapes, `lax.dynamic_update_slice`).
+
+TPU-native counterpart of serving decode loops the reference leaves to
+torch/vLLM inside Serve replicas (SURVEY §2.3 Serve row): the cache is a
+pytree carried through `lax.while_loop`/scan, so one compiled program serves
+any prompt length up to max_len.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.models.transformer import TransformerConfig, forward
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class LayerKVCache:
+    """Fixed-capacity cache for one layer. k/v: [B, max_len, Hkv, D]."""
+
+    k: Any
+    v: Any
+    length: Any  # scalar int32: tokens already cached
+
+    @classmethod
+    def zeros(cls, batch: int, max_len: int, kv_heads: int, head_dim: int,
+              dtype=jnp.bfloat16) -> "LayerKVCache":
+        return cls(
+            k=jnp.zeros((batch, max_len, kv_heads, head_dim), dtype),
+            v=jnp.zeros((batch, max_len, kv_heads, head_dim), dtype),
+            length=jnp.zeros((), jnp.int32),
+        )
+
+    def update(self, k_new, v_new) -> Tuple["LayerKVCache", Any, Any]:
+        """Append [B, S, Hkv, D] new keys/values; returns (new_cache, k_all,
+        v_all) where k_all/v_all are the full fixed-size buffers."""
+        k = lax.dynamic_update_slice(
+            self.k, k_new.astype(self.k.dtype), (0, self.length, 0, 0))
+        v = lax.dynamic_update_slice(
+            self.v, v_new.astype(self.v.dtype), (0, self.length, 0, 0))
+        new = LayerKVCache(k=k, v=v, length=self.length + k_new.shape[1])
+        return new, k, v
+
+    def mask_bias(self, q_len: int):
+        """Additive bias [1,1,1,q_len,max_len]: query i (global position
+        length+i) may attend to cache slot j iff j <= length+i."""
+        max_len = self.k.shape[1]
+        qpos = self.length + jnp.arange(q_len)[:, None]
+        jpos = jnp.arange(max_len)[None, :]
+        allowed = jpos <= qpos
+        bias = jnp.where(allowed, 0.0, -1e30).astype(jnp.float32)
+        return bias[None, None, None, :, :]
+
+
+def init_caches(cfg: TransformerConfig, batch: int, max_len: int,
+                dtype=None) -> List[LayerKVCache]:
+    dtype = dtype or cfg.dtype
+    return [LayerKVCache.zeros(batch, max_len, cfg.kv_heads, cfg.head_dim,
+                               dtype) for _ in range(cfg.num_layers)]
+
+
+def prefill(cfg: TransformerConfig, params, tokens, caches):
+    """Run the prompt through the model, filling caches.
+    Returns (logits_last [B, vocab], caches)."""
+    positions = jnp.arange(tokens.shape[1])[None, :] + caches[0].length
+    logits, caches = forward(cfg, params, tokens, positions=positions,
+                             kv_caches=caches)
+    return logits[:, -1], caches
+
+
+def decode_step(cfg: TransformerConfig, params, token, caches):
+    """One token step. token: [B, 1]. Returns (logits [B, vocab], caches)."""
+    positions = caches[0].length + jnp.zeros((token.shape[0], 1), jnp.int32)
+    logits, caches = forward(cfg, params, token, positions=positions,
+                             kv_caches=caches)
+    return logits[:, -1], caches
+
+
+def sample_token(logits, key, temperature: float = 0.0, top_k: int = 0):
+    """Greedy (temperature 0) or temperature/top-k sampling. [B,V] -> [B]."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k > 0:
+        top = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < top, -1e30, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+@partial(jax.jit, static_argnums=(0, 4, 5, 6))
+def generate(cfg: TransformerConfig, params, prompt, key,
+             max_new_tokens: int, temperature: float = 0.0, top_k: int = 0):
+    """prompt [B, S] -> generated [B, max_new_tokens] (greedy or sampled).
+    One compiled program: prefill + lax.scan over decode steps."""
+    batch, prompt_len = prompt.shape
+    caches = init_caches(cfg, batch, prompt_len + max_new_tokens)
+    logits, caches = prefill(cfg, params, prompt, caches)
+
+    def body(carry, step_key):
+        logits, caches = carry
+        tok = sample_token(logits, step_key, temperature, top_k)
+        logits, caches = decode_step(cfg, params, tok[:, None], caches)
+        return (logits, caches), tok
+
+    keys = jax.random.split(key, max_new_tokens)
+    (_, _), toks = lax.scan(body, (logits, caches), keys)
+    return toks.T  # [B, T]

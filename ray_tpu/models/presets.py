@@ -1,0 +1,53 @@
+"""Named model configs. Sizes match the public architectures; dtypes default
+to bf16 compute over f32 params (the TPU-native training recipe)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ray_tpu.models.transformer import TransformerConfig
+
+
+def gpt2_small(**overrides) -> TransformerConfig:
+    """GPT-2 124M: learned positions, LayerNorm, gelu MLP, tied embeddings."""
+    kw = dict(
+        vocab_size=50257, num_layers=12, embed_dim=768, num_heads=12,
+        max_seq_len=1024, norm="layernorm", pos="learned", mlp="gelu",
+        tie_embeddings=True, norm_eps=1e-5,
+    )
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def gpt2_medium(**overrides) -> TransformerConfig:
+    kw = dict(
+        vocab_size=50257, num_layers=24, embed_dim=1024, num_heads=16,
+        max_seq_len=1024, norm="layernorm", pos="learned", mlp="gelu",
+        tie_embeddings=True, norm_eps=1e-5,
+    )
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def llama3_8b(**overrides) -> TransformerConfig:
+    """Llama-3-8B: RoPE(theta=500k), RMSNorm, SwiGLU, GQA 32/8, vocab 128256."""
+    kw = dict(
+        vocab_size=128256, num_layers=32, embed_dim=4096, num_heads=32,
+        num_kv_heads=8, mlp_dim=14336, max_seq_len=8192, norm="rmsnorm",
+        pos="rope", mlp="swiglu", rope_theta=500000.0, tie_embeddings=False,
+        norm_eps=1e-5,
+    )
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def llama_debug(**overrides) -> TransformerConfig:
+    """Tiny LLaMA-shaped config for tests and multichip dry runs."""
+    kw = dict(
+        vocab_size=256, num_layers=2, embed_dim=64, num_heads=4,
+        num_kv_heads=2, mlp_dim=128, max_seq_len=128, norm="rmsnorm",
+        pos="rope", mlp="swiglu", tie_embeddings=False,
+        dtype=jnp.float32,
+    )
+    kw.update(overrides)
+    return TransformerConfig(**kw)

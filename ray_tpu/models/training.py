@@ -1,0 +1,142 @@
+"""Jitted training-step factory for the transformer family.
+
+One compiled XLA program per step: forward (+ remat), backward, optax update —
+all under `jit` with explicit in/out shardings on a named mesh. GSPMD inserts
+the fsdp all-gathers / reduce-scatters and tp collectives; nothing here
+hand-schedules communication (SURVEY §7 stance).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.models.transformer import (TransformerConfig, forward, init_params,
+                                        logical_axes, loss_fn)
+from ray_tpu.parallel.sharding import ShardingRules, param_specs
+from ray_tpu.parallel.mesh import data_sharding
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerConfig:
+    learning_rate: float = 3e-4
+    weight_decay: float = 0.1
+    b1: float = 0.9
+    b2: float = 0.95
+    grad_clip: float = 1.0
+    warmup_steps: int = 100
+    decay_steps: int = 10000
+    min_lr_ratio: float = 0.1
+
+
+def make_optimizer(ocfg: OptimizerConfig) -> optax.GradientTransformation:
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=ocfg.learning_rate,
+        warmup_steps=ocfg.warmup_steps,
+        decay_steps=max(ocfg.decay_steps, ocfg.warmup_steps + 1),
+        end_value=ocfg.learning_rate * ocfg.min_lr_ratio)
+    return optax.chain(
+        optax.clip_by_global_norm(ocfg.grad_clip),
+        optax.adamw(schedule, b1=ocfg.b1, b2=ocfg.b2,
+                    weight_decay=ocfg.weight_decay),
+    )
+
+
+@dataclasses.dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: Any
+
+
+jax.tree_util.register_dataclass(
+    TrainState, data_fields=["params", "opt_state", "step"], meta_fields=[])
+
+
+def init_train_state(cfg: TransformerConfig, ocfg: OptimizerConfig, key,
+                     mesh=None, rules: Optional[ShardingRules] = None):
+    """Initialize params + opt state, sharded onto `mesh` if given.
+
+    Uses jit-with-out-shardings so big models materialize directly as shards
+    (no host-side full copy of each leaf)."""
+    tx = make_optimizer(ocfg)
+
+    def _init(k):
+        params = init_params(cfg, k)
+        return TrainState(params=params, opt_state=tx.init(params),
+                          step=jnp.zeros((), jnp.int32))
+
+    if mesh is None:
+        return _init(key), tx
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    abstract = jax.eval_shape(_init, key)
+    specs = _state_specs(cfg, abstract, mesh, rules)
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
+    state = jax.jit(_init, out_shardings=shardings)(key)
+    return state, tx
+
+
+def _state_specs(cfg, abstract_state, mesh, rules):
+    """PartitionSpecs for a TrainState: params by logical axes; adam moments
+    follow their params; scalars replicated."""
+    from jax.sharding import PartitionSpec
+
+    rules = rules or ShardingRules()
+    p_specs = param_specs(abstract_state.params, mesh, rules,
+                          logical_axes(cfg))
+
+    def opt_specs(opt_branch):
+        # optax states are pytrees whose leaves either mirror params
+        # (moments) or are scalars/step counts.
+        def leaf_spec(leaf):
+            shape = getattr(leaf, "shape", ())
+            for spec_leaf, p_leaf in zip(jax.tree.leaves(p_specs),
+                                         jax.tree.leaves(abstract_state.params)):
+                if getattr(p_leaf, "shape", None) == shape:
+                    return spec_leaf
+            return PartitionSpec()
+        return jax.tree.map(leaf_spec, opt_branch)
+
+    return TrainState(params=p_specs, opt_state=opt_specs(abstract_state.opt_state),
+                      step=PartitionSpec())
+
+
+def make_train_step(cfg: TransformerConfig, tx, mesh=None,
+                    rules: Optional[ShardingRules] = None,
+                    loss: Optional[Callable] = None,
+                    donate: bool = True,
+                    batch_sharding=None):
+    """Returns step(state, batch) -> (state, metrics), jitted (sharded if mesh)."""
+    loss = loss or (lambda p, b: loss_fn(cfg, p, b))
+
+    def step_fn(state: TrainState, batch):
+        (l, metrics), grads = jax.value_and_grad(loss, has_aux=True)(
+            state.params, batch)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = dict(metrics)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        return TrainState(params=new_params, opt_state=new_opt,
+                          step=state.step + 1), metrics
+
+    if mesh is None:
+        return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
+
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if batch_sharding is None:
+        batch_sharding = data_sharding(mesh)
+    repl = NamedSharding(mesh, PartitionSpec())
+    # pytree-prefix shardings: every batch leaf is batch-sharded; state keeps
+    # its existing (init-time) shardings; metrics come back replicated.
+    return jax.jit(
+        step_fn,
+        in_shardings=(None, batch_sharding),
+        out_shardings=(None, repl),
+        donate_argnums=(0,) if donate else (),
+    )

@@ -1,0 +1,311 @@
+"""Decoder-only transformer family (GPT-2 and LLaMA variants), pure JAX.
+
+Replaces the reference's stance of "models live in torch inside the worker
+loop" (e.g. `train/torch/train_loop_utils.py` wraps arbitrary nn.Modules):
+here the flagship models are JAX pytrees whose leaves carry logical axis
+names, so one `device_put` with `ShardingRules` yields DP/FSDP/TP/SP layouts
+and XLA/GSPMD inserts all collectives.
+
+Config switches:
+  * norm: 'rmsnorm' (LLaMA) | 'layernorm' (GPT-2)
+  * pos:  'rope' (LLaMA) | 'learned' (GPT-2)
+  * mlp:  'swiglu' (LLaMA) | 'gelu' (GPT-2)
+  * GQA via num_kv_heads; tied embeddings via tie_embeddings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import attention
+from ray_tpu.ops.losses import softmax_cross_entropy
+from ray_tpu.ops.norms import layer_norm, rms_norm
+from ray_tpu.ops.ring_attention import ring_attention_local
+from ray_tpu.ops.rotary import apply_rotary, rope_frequencies
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    num_layers: int = 12
+    embed_dim: int = 768
+    num_heads: int = 12
+    num_kv_heads: Optional[int] = None        # None => MHA
+    mlp_dim: Optional[int] = None             # None => 4x (gelu) / 8/3x (swiglu)
+    max_seq_len: int = 2048
+    norm: str = "rmsnorm"                     # 'rmsnorm' | 'layernorm'
+    pos: str = "rope"                         # 'rope' | 'learned'
+    mlp: str = "swiglu"                       # 'swiglu' | 'gelu'
+    rope_theta: float = 10000.0
+    tie_embeddings: bool = True
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16                 # activation/compute dtype
+    param_dtype: Any = jnp.float32
+    remat: bool = True                        # checkpoint each block
+    scan_layers: bool = True                  # stack layers, lax.scan over them
+    attn_impl: str = "auto"                   # 'auto'|'flash'|'reference'|'ring'
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+    @property
+    def hidden_dim(self) -> int:
+        if self.mlp_dim:
+            return self.mlp_dim
+        if self.mlp == "swiglu":
+            # LLaMA convention: 2/3 * 4d rounded to a multiple of 256
+            h = int(8 * self.embed_dim / 3)
+            return 256 * ((h + 255) // 256)
+        return 4 * self.embed_dim
+
+
+# ---------------------------------------------------------------------------
+# params
+
+
+def _block_params(cfg: TransformerConfig, key) -> Dict[str, Any]:
+    d, h, kvh, hd, f = (cfg.embed_dim, cfg.num_heads, cfg.kv_heads,
+                        cfg.head_dim, cfg.hidden_dim)
+    ks = jax.random.split(key, 8)
+    init = jax.nn.initializers.normal(0.02, cfg.param_dtype)
+    out_init = jax.nn.initializers.normal(
+        0.02 / math.sqrt(2 * cfg.num_layers), cfg.param_dtype)
+    p: Dict[str, Any] = {
+        "attn": {
+            "wq": init(ks[0], (d, h, hd)),
+            "wk": init(ks[1], (d, kvh, hd)),
+            "wv": init(ks[2], (d, kvh, hd)),
+            "wo": out_init(ks[3], (h, hd, d)),
+        },
+        "ln1": _norm_params(cfg, d),
+        "ln2": _norm_params(cfg, d),
+    }
+    if cfg.mlp == "swiglu":
+        p["mlp"] = {
+            "w_gate": init(ks[4], (d, f)),
+            "w_up": init(ks[5], (d, f)),
+            "w_down": out_init(ks[6], (f, d)),
+        }
+    else:
+        p["mlp"] = {
+            "w_in": init(ks[4], (d, f)),
+            "b_in": jnp.zeros((f,), cfg.param_dtype),
+            "w_out": out_init(ks[5], (f, d)),
+            "b_out": jnp.zeros((d,), cfg.param_dtype),
+        }
+    return p
+
+
+def _norm_params(cfg: TransformerConfig, dim: int):
+    if cfg.norm == "rmsnorm":
+        return {"scale": jnp.ones((dim,), cfg.param_dtype)}
+    return {"scale": jnp.ones((dim,), cfg.param_dtype),
+            "bias": jnp.zeros((dim,), cfg.param_dtype)}
+
+
+def init_params(cfg: TransformerConfig, key) -> Dict[str, Any]:
+    keys = jax.random.split(key, cfg.num_layers + 3)
+    init = jax.nn.initializers.normal(0.02, cfg.param_dtype)
+    params: Dict[str, Any] = {
+        "embed": {"table": init(keys[0], (cfg.vocab_size, cfg.embed_dim))},
+        "final_norm": _norm_params(cfg, cfg.embed_dim),
+    }
+    if cfg.pos == "learned":
+        params["pos_embed"] = {
+            "table": init(keys[1], (cfg.max_seq_len, cfg.embed_dim))}
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {
+            "kernel": init(keys[2], (cfg.embed_dim, cfg.vocab_size))}
+    blocks = [_block_params(cfg, keys[3 + i]) for i in range(cfg.num_layers)]
+    if cfg.scan_layers:
+        params["blocks"] = jax.tree.map(
+            lambda *xs: jnp.stack(xs, axis=0), *blocks)
+    else:
+        params["blocks"] = {str(i): b for i, b in enumerate(blocks)}
+    return params
+
+
+def logical_axes(cfg: TransformerConfig) -> Dict[str, Any]:
+    """Pytree (same structure as params) of logical-axis tuples."""
+    L = ("layers",) if cfg.scan_layers else ()
+
+    def norm_axes():
+        if cfg.norm == "rmsnorm":
+            return {"scale": L + ("embed_notp",)}
+        return {"scale": L + ("embed_notp",), "bias": L + ("embed_notp",)}
+
+    block = {
+        "attn": {
+            "wq": L + ("embed", "heads", "head_dim"),
+            "wk": L + ("embed", "kv", "head_dim"),
+            "wv": L + ("embed", "kv", "head_dim"),
+            "wo": L + ("heads", "head_dim", "embed"),
+        },
+        "ln1": norm_axes(),
+        "ln2": norm_axes(),
+    }
+    if cfg.mlp == "swiglu":
+        block["mlp"] = {"w_gate": L + ("embed", "mlp"),
+                        "w_up": L + ("embed", "mlp"),
+                        "w_down": L + ("mlp", "embed")}
+    else:
+        block["mlp"] = {"w_in": L + ("embed", "mlp"),
+                        "b_in": L + ("mlp",),
+                        "w_out": L + ("mlp", "embed"),
+                        "b_out": L + ("embed_notp",)}
+    axes: Dict[str, Any] = {
+        "embed": {"table": ("vocab", "embed")},
+        "final_norm": {"scale": ("embed_notp",)} if cfg.norm == "rmsnorm"
+        else {"scale": ("embed_notp",), "bias": ("embed_notp",)},
+        "blocks": block if cfg.scan_layers
+        else {str(i): jax.tree.map(lambda a: a, block)
+              for i in range(cfg.num_layers)},
+    }
+    if cfg.pos == "learned":
+        axes["pos_embed"] = {"table": (None, "embed")}
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = {"kernel": ("embed", "vocab")}
+    return axes
+
+
+def count_params(params) -> int:
+    return sum(x.size for x in jax.tree.leaves(params))
+
+
+# ---------------------------------------------------------------------------
+# forward
+
+
+def _norm(cfg, p, x):
+    if cfg.norm == "rmsnorm":
+        return rms_norm(x, p["scale"], cfg.norm_eps)
+    return layer_norm(x, p["scale"], p["bias"], cfg.norm_eps)
+
+
+def _attn(cfg, p, x, rope, positions, sp_axis, kv_cache=None):
+    b, s, d = x.shape
+    q = jnp.einsum("bsd,dhk->bshk", x, p["wq"].astype(cfg.dtype))
+    k = jnp.einsum("bsd,dhk->bshk", x, p["wk"].astype(cfg.dtype))
+    v = jnp.einsum("bsd,dhk->bshk", x, p["wv"].astype(cfg.dtype))
+    if rope is not None:
+        cos, sin = rope
+        q = apply_rotary(q, cos, sin, positions)
+        k = apply_rotary(k, cos, sin, positions)
+    if kv_cache is not None:
+        # decode: append to cache, attend over the full prefix
+        bias = kv_cache.mask_bias(s)
+        new_cache, k_all, v_all = kv_cache.update(k, v)
+        o = attention(q, k_all, v_all, causal=False, impl="reference",
+                      bias=bias)
+    elif cfg.attn_impl == "ring" and sp_axis is not None:
+        o = ring_attention_local(q, k, v, sp_axis, causal=True)
+        new_cache = None
+    else:
+        o = attention(q, k, v, causal=True, impl=cfg.attn_impl
+                      if cfg.attn_impl != "ring" else "auto")
+        new_cache = None
+    out = jnp.einsum("bshk,hkd->bsd", o, p["wo"].astype(cfg.dtype))
+    return out, new_cache
+
+
+def _mlp(cfg, p, x):
+    if cfg.mlp == "swiglu":
+        gate = jnp.einsum("bsd,df->bsf", x, p["w_gate"].astype(cfg.dtype))
+        up = jnp.einsum("bsd,df->bsf", x, p["w_up"].astype(cfg.dtype))
+        return jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up,
+                          p["w_down"].astype(cfg.dtype))
+    h = jnp.einsum("bsd,df->bsf", x, p["w_in"].astype(cfg.dtype))
+    h = jax.nn.gelu(h + p["b_in"].astype(cfg.dtype), approximate=True)
+    return jnp.einsum("bsf,fd->bsd", h,
+                      p["w_out"].astype(cfg.dtype)) + p["b_out"].astype(cfg.dtype)
+
+
+def _block(cfg, p, x, rope, positions, sp_axis, kv_cache=None):
+    a, new_cache = _attn(cfg, p["attn"], _norm(cfg, p["ln1"], x), rope,
+                         positions, sp_axis, kv_cache)
+    x = x + a
+    x = x + _mlp(cfg, p["mlp"], _norm(cfg, p["ln2"], x))
+    return x, new_cache
+
+
+def forward(cfg: TransformerConfig, params, tokens, *, positions=None,
+            sp_axis: Optional[str] = None, kv_caches=None):
+    """tokens [B, S] int32 -> logits [B, S, vocab].
+
+    sp_axis: when running inside shard_map with sequence sharded over that
+    axis, attention goes through the ring kernel and `positions` must be the
+    global positions of this shard.
+    kv_caches: optional list/stack of per-layer decode caches (see
+    ray_tpu.models.decode); when set, runs in incremental-decode mode.
+    """
+    x = params["embed"]["table"].astype(cfg.dtype)[tokens]
+    if cfg.pos == "learned":
+        pos = positions if positions is not None else jnp.arange(tokens.shape[1])
+        x = x + params["pos_embed"]["table"].astype(cfg.dtype)[pos]
+        rope = None
+    else:
+        rope = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+
+    block_fn = _block
+    if cfg.remat and kv_caches is None:
+        block_fn = jax.checkpoint(
+            _block, static_argnums=(0, 5),
+            policy=jax.checkpoint_policies.nothing_saveable)
+
+    new_caches = None
+    if cfg.scan_layers and kv_caches is None:
+        def body(h, layer_params):
+            h, _ = block_fn(cfg, layer_params, h, rope, positions, sp_axis)
+            return h, None
+        x, _ = jax.lax.scan(body, x, params["blocks"])
+    elif cfg.scan_layers:
+        new_caches = []
+        for i in range(cfg.num_layers):
+            layer_p = jax.tree.map(lambda a, i=i: a[i], params["blocks"])
+            x, c = _block(cfg, layer_p, x, rope, positions, sp_axis,
+                          kv_caches[i])
+            new_caches.append(c)
+    else:
+        new_caches = [] if kv_caches is not None else None
+        for i in range(cfg.num_layers):
+            cache = kv_caches[i] if kv_caches is not None else None
+            x, c = block_fn(cfg, params["blocks"][str(i)], x, rope,
+                            positions, sp_axis, cache)
+            if new_caches is not None:
+                new_caches.append(c)
+
+    x = _norm(cfg, params["final_norm"], x)
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("bsd,vd->bsv", x,
+                            params["embed"]["table"].astype(cfg.dtype))
+    else:
+        logits = jnp.einsum("bsd,dv->bsv", x,
+                            params["lm_head"]["kernel"].astype(cfg.dtype))
+    if kv_caches is not None:
+        return logits, new_caches
+    return logits
+
+
+def loss_fn(cfg: TransformerConfig, params, batch, *, sp_axis=None,
+            positions=None):
+    """Causal-LM loss. batch: {'tokens': [B,S], optional 'mask': [B,S]}.
+    Targets are tokens shifted left; the last position is dropped."""
+    tokens = batch["tokens"]
+    logits = forward(cfg, params, tokens, sp_axis=sp_axis, positions=positions)
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    mask = batch.get("mask")
+    if mask is not None:
+        mask = mask[:, 1:]
+    loss, n = softmax_cross_entropy(logits, targets, mask)
+    return loss, {"loss": loss, "tokens": n}

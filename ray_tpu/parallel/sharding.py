@@ -11,6 +11,7 @@ all-gathers/reduce-scatters GSPMD-style.
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Any, Dict, Optional, Sequence, Tuple, Union
 
 LogicalAxes = Tuple[Optional[str], ...]
@@ -23,10 +24,11 @@ DEFAULT_RULES: Dict[str, Optional[Union[str, Tuple[str, ...]]]] = {
     "seq": "sp",
     # params
     "embed": "fsdp",  # ZeRO-shard the embed dim of params over fsdp
+    "embed_notp": "fsdp",  # embed-sized vectors (norm scales): fsdp only
     "vocab": "tp",
     "mlp": "tp",
     "heads": "tp",
-    "kv": None,
+    "kv": "tp",
     "head_dim": None,
     "layers": None,
     "expert": "ep",
@@ -104,15 +106,45 @@ def infer_logical_axes(params) -> Any:
     return jax.tree.map(leaf_axes, params)
 
 
-def shard_params(params, mesh, rules: Optional[ShardingRules] = None, logical=None):
-    """Place a params pytree onto the mesh per the rules (ZeRO/fsdp aware)."""
+def sanitize_spec(spec, shape, mesh):
+    """Drop mesh axes from a PartitionSpec on dims they don't divide evenly
+    (e.g. 2 kv heads can't split over tp=8 — replicate instead)."""
+    from jax.sharding import PartitionSpec
+
+    out = []
+    for i, entry in enumerate(spec):
+        if entry is None or i >= len(shape):
+            out.append(entry)
+            continue
+        axes = (entry,) if isinstance(entry, str) else tuple(entry)
+        size = math.prod(mesh.shape[a] for a in axes)
+        if size and shape[i] % size == 0:
+            out.append(entry)
+        else:
+            out.append(None)
+    return PartitionSpec(*out)
+
+
+def param_specs(params, mesh, rules: Optional[ShardingRules] = None,
+                logical=None):
+    """Shape-checked PartitionSpec pytree for a params pytree."""
     import jax
-    from jax.sharding import NamedSharding
 
     rules = rules or ShardingRules()
     if logical is None:
         logical = infer_logical_axes(params)
     specs = logical_to_spec(rules, logical, mesh)
+    return jax.tree.map(
+        lambda x, s: sanitize_spec(s, getattr(x, "shape", ()), mesh),
+        params, specs)
+
+
+def shard_params(params, mesh, rules: Optional[ShardingRules] = None, logical=None):
+    """Place a params pytree onto the mesh per the rules (ZeRO/fsdp aware)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    specs = param_specs(params, mesh, rules, logical)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
     )
