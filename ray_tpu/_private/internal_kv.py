@@ -1,0 +1,54 @@
+"""Internal KV client — thin wrappers over the controller's KV service.
+
+Analog of the reference's `ray.experimental.internal_kv` (backed by the GCS
+internal KV, `src/ray/gcs/gcs_server/gcs_kv_manager.h`): a namespaced
+key→value store used by libraries (collective group metadata, serve config,
+job table) rather than by user code.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+
+def _core():
+    from ray_tpu._private.api import _require_core
+
+    return _require_core()
+
+
+def kv_put(key: str, value: Any, *, ns: str = "default", overwrite: bool = True) -> bool:
+    core = _core()
+    return core._run(
+        core.clients.get(core.controller_addr).call(
+            "kv_put", {"ns": ns, "key": key, "value": value, "overwrite": overwrite}
+        )
+    )
+
+
+def kv_get(key: str, *, ns: str = "default") -> Optional[Any]:
+    core = _core()
+    return core._run(
+        core.clients.get(core.controller_addr).call("kv_get", {"ns": ns, "key": key})
+    )
+
+
+def kv_exists(key: str, *, ns: str = "default") -> bool:
+    core = _core()
+    return core._run(
+        core.clients.get(core.controller_addr).call("kv_exists", {"ns": ns, "key": key})
+    )
+
+
+def kv_del(key: str, *, ns: str = "default") -> bool:
+    core = _core()
+    return core._run(
+        core.clients.get(core.controller_addr).call("kv_del", {"ns": ns, "key": key})
+    )
+
+
+def kv_keys(prefix: str = "", *, ns: str = "default") -> List[str]:
+    core = _core()
+    return core._run(
+        core.clients.get(core.controller_addr).call("kv_keys", {"ns": ns, "prefix": prefix})
+    )

@@ -100,6 +100,13 @@ def generate(cfg: TransformerConfig, params, prompt, key,
     """prompt [B, S] -> generated [B, max_new_tokens] (greedy or sampled).
     One compiled program: prefill + lax.scan over decode steps."""
     batch, prompt_len = prompt.shape
+    if prompt_len + max_new_tokens > cfg.max_seq_len:
+        # Position tables are sized cfg.max_seq_len; past that, gather clamps
+        # and decodes silently wrong. Fail loudly at trace time instead.
+        raise ValueError(
+            f"prompt_len ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds cfg.max_seq_len ({cfg.max_seq_len})"
+        )
     caches = init_caches(cfg, batch, prompt_len + max_new_tokens)
     logits, caches = prefill(cfg, params, prompt, caches)
 

@@ -91,16 +91,23 @@ def _state_specs(cfg, abstract_state, mesh, rules):
                           logical_axes(cfg))
 
     def opt_specs(opt_branch):
-        # optax states are pytrees whose leaves either mirror params
-        # (moments) or are scalars/step counts.
-        def leaf_spec(leaf):
-            shape = getattr(leaf, "shape", ())
-            for spec_leaf, p_leaf in zip(jax.tree.leaves(p_specs),
-                                         jax.tree.leaves(abstract_state.params)):
-                if getattr(p_leaf, "shape", None) == shape:
-                    return spec_leaf
-            return PartitionSpec()
-        return jax.tree.map(leaf_spec, opt_branch)
+        # optax states are pytrees whose sub-trees either mirror the params
+        # tree exactly (adam moments) or are scalars/step counts. Match
+        # structurally — shape-based matching would mis-assign specs when two
+        # params share a shape but have different logical axes.
+        pdef = jax.tree.structure(abstract_state.params)
+
+        def is_param_tree(x):
+            try:
+                return jax.tree.structure(x) == pdef
+            except Exception:
+                return False
+
+        return jax.tree.map(
+            lambda sub: p_specs if is_param_tree(sub) else PartitionSpec(),
+            opt_branch,
+            is_leaf=is_param_tree,
+        )
 
     return TrainState(params=p_specs, opt_state=opt_specs(abstract_state.opt_state),
                       step=PartitionSpec())

@@ -1,0 +1,579 @@
+"""Collective communication API between tasks/actors.
+
+API shaped like the reference's `ray.util.collective.collective`
+(`python/ray/util/collective/collective.py:120-655`: init_collective_group,
+create_collective_group, allreduce :258, reduce :311, broadcast :373,
+allgather :423, reducescatter :472, send :531, recv :594, barrier), re-based
+for TPU:
+
+  * backend "xla" ≈ the reference's NCCL group — but instead of explicit
+    device-to-device NCCL calls, ranks join one `jax.distributed` runtime and
+    every collective is a jitted XLA program over a one-axis device mesh, so
+    the bytes ride ICI/DCN exactly as GSPMD would move them.
+  * backend "host" ≈ the reference's Gloo group — a controller-KV rendezvous
+    over the control plane. Works between any processes with no device
+    requirements; sized for control-plane payloads (weight broadcast at init,
+    metrics reduction), not the tensor hot path. The tensor hot path in this
+    framework is mesh-sharded jit (see ray_tpu.parallel), which needs no
+    explicit collective calls at all.
+
+Both imperative (`init_collective_group` inside each worker) and declarative
+(`create_collective_group` from the driver over actor handles) setup are
+supported, mirroring collective.py:120/:151.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.util.collective.types import Backend, ReduceOp
+
+logger = logging.getLogger(__name__)
+
+_KV_NS = "collective"
+
+
+def _kv():
+    from ray_tpu._private import internal_kv
+
+    return internal_kv
+
+
+def _node_ip() -> str:
+    """Best reachable address of this host for cross-host rendezvous."""
+    import socket
+
+    ip = os.environ.get("RAY_TPU_NODE_IP")
+    if ip:
+        return ip
+    try:
+        # UDP connect picks the outbound interface without sending a packet.
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("8.8.8.8", 80))
+            ip = s.getsockname()[0]
+        finally:
+            s.close()
+        if ip and not ip.startswith("127."):
+            return ip
+    except OSError:
+        pass
+    try:
+        ip = socket.gethostbyname(socket.gethostname())
+        if ip and not ip.startswith("127."):
+            return ip
+    except OSError:
+        pass
+    return "127.0.0.1"
+
+
+# --------------------------------------------------------------------- groups
+
+
+class BaseGroup:
+    def __init__(self, world_size: int, rank: int, group_name: str):
+        if not (0 <= rank < world_size):
+            raise ValueError(f"rank {rank} out of range for world_size {world_size}")
+        self.world_size = world_size
+        self.rank = rank
+        self.group_name = group_name
+        self._decl_gen = None  # set when created from declarative KV metadata
+
+    def destroy(self) -> None:
+        pass
+
+
+def _reduce_fn(op: ReduceOp):
+    return {
+        ReduceOp.SUM: lambda a: a.sum(axis=0),
+        ReduceOp.MEAN: lambda a: a.mean(axis=0),
+        ReduceOp.PRODUCT: lambda a: a.prod(axis=0),
+        ReduceOp.MAX: lambda a: a.max(axis=0),
+        ReduceOp.MIN: lambda a: a.min(axis=0),
+    }[op]
+
+
+class HostGroup(BaseGroup):
+    """Control-plane collectives over the controller KV (gloo analog).
+
+    Protocol: every collective call gets a per-group sequence number (all
+    ranks call collectives in the same order — the standard requirement).
+    Ranks post contributions under ``{group}:{seq}:c:{rank}``; rank 0 reduces
+    and posts ``{group}:{seq}:r``; ranks poll for the result. Rank 0 deletes
+    the previous call's result right before posting the next one — safe,
+    because holding every contribution of call N implies every rank has read
+    the result of call N-1.
+    """
+
+    def __init__(self, world_size: int, rank: int, group_name: str):
+        super().__init__(world_size, rank, group_name)
+        self._seq = 0
+        self._p2p_seq: Dict[tuple, int] = {}
+
+    # ----- kv plumbing
+
+    def _key(self, seq: int, kind: str, rank: Optional[int] = None) -> str:
+        k = f"{self.group_name}:{seq}:{kind}"
+        return k if rank is None else f"{k}:{rank}"
+
+    def _poll(self, key: str, timeout_ms: int, delete: bool = False):
+        kv = _kv()
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        pause = 0.001
+        while True:
+            val = kv.kv_get(key, ns=_KV_NS)
+            if val is not None:
+                if delete:
+                    kv.kv_del(key, ns=_KV_NS)
+                return val
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"collective group {self.group_name!r} rank {self.rank}: "
+                    f"timed out waiting for {key!r}"
+                )
+            time.sleep(pause)
+            pause = min(pause * 1.5, 0.05)
+
+    def _round(self, payload, combine, timeout_ms: int):
+        """One gather-to-root + broadcast round; returns the combined result."""
+        kv = _kv()
+        seq, self._seq = self._seq, self._seq + 1
+        kv.kv_put(self._key(seq, "c", self.rank), payload, ns=_KV_NS)
+        if self.rank == 0:
+            parts = [
+                self._poll(self._key(seq, "c", r), timeout_ms, delete=True)
+                for r in range(self.world_size)
+            ]
+            result = combine(parts)
+            if seq > 0:
+                kv.kv_del(self._key(seq - 1, "r"), ns=_KV_NS)
+            kv.kv_put(self._key(seq, "r"), result, ns=_KV_NS)
+            return result
+        return self._poll(self._key(seq, "r"), timeout_ms)
+
+    # ----- ops
+
+    def allreduce(self, arr: np.ndarray, op: ReduceOp, timeout_ms: int) -> np.ndarray:
+        fn = _reduce_fn(op)
+        return self._round(
+            np.asarray(arr), lambda parts: fn(np.stack(parts)), timeout_ms
+        )
+
+    def reduce(self, arr, op: ReduceOp, root_rank: int, timeout_ms: int):
+        out = self.allreduce(arr, op, timeout_ms)
+        return out if self.rank == root_rank else np.asarray(arr)
+
+    def broadcast(self, arr, root_rank: int, timeout_ms: int):
+        # Non-root ranks post a tiny marker instead of their full tensor: only
+        # root's contribution is used, and the marker still upholds the
+        # deletion-protocol barrier.
+        payload = np.asarray(arr) if self.rank == root_rank else 0
+        return self._round(payload, lambda parts: parts[root_rank], timeout_ms)
+
+    def allgather(self, arr, timeout_ms: int) -> List[np.ndarray]:
+        return self._round(np.asarray(arr), lambda parts: list(parts), timeout_ms)
+
+    def reducescatter(self, arr, op: ReduceOp, timeout_ms: int) -> np.ndarray:
+        full = self.allreduce(arr, op, timeout_ms)
+        return np.array_split(full, self.world_size, axis=0)[self.rank]
+
+    def barrier(self, timeout_ms: int) -> None:
+        self._round(0, lambda parts: 0, timeout_ms)
+
+    def send(self, arr, dst_rank: int, timeout_ms: int) -> None:
+        key = (self.rank, dst_rank)
+        seq = self._p2p_seq.get(key, 0)
+        self._p2p_seq[key] = seq + 1
+        _kv().kv_put(
+            f"{self.group_name}:p2p:{self.rank}>{dst_rank}:{seq}",
+            np.asarray(arr),
+            ns=_KV_NS,
+        )
+
+    def recv(self, src_rank: int, timeout_ms: int) -> np.ndarray:
+        key = (src_rank, self.rank)
+        seq = self._p2p_seq.get(key, 0)
+        self._p2p_seq[key] = seq + 1
+        return self._poll(
+            f"{self.group_name}:p2p:{src_rank}>{self.rank}:{seq}",
+            timeout_ms,
+            delete=True,
+        )
+
+    def destroy(self) -> None:
+        kv = _kv()
+        try:
+            for k in kv.kv_keys(self.group_name + ":", ns=_KV_NS):
+                kv.kv_del(k, ns=_KV_NS)
+        except Exception:  # controller may already be gone at shutdown
+            pass
+
+
+class XlaGroup(BaseGroup):
+    """Device-plane collectives: jitted XLA programs over a one-axis mesh.
+
+    Each rank is one *process* of a shared `jax.distributed` runtime (for
+    world_size == 1, plain local JAX). The mesh takes one device per process;
+    a collective builds a global array with each process's contribution as its
+    addressable shard and jits the reduction with a replicated out-sharding,
+    so XLA emits the all-reduce/all-gather over ICI/DCN.
+    """
+
+    def __init__(
+        self,
+        world_size: int,
+        rank: int,
+        group_name: str,
+        *,
+        coordinator: Optional[str] = None,
+    ):
+        super().__init__(world_size, rank, group_name)
+        import jax
+
+        # The is_initialized() check must come before ANY backend-touching JAX
+        # call (process_count() would initialize XLA and make
+        # distributed.initialize() unconstructible).
+        if world_size > 1 and not jax.distributed.is_initialized():
+            # Join (or start) the shared distributed runtime. Rank 0 publishes
+            # the coordinator endpoint through the controller KV.
+            coord_key = f"{group_name}:coordinator"
+            if coordinator is None:
+                if rank == 0:
+                    import socket
+
+                    sock = socket.socket()
+                    sock.bind(("", 0))
+                    port = sock.getsockname()[1]
+                    sock.close()
+                    coordinator = f"{_node_ip()}:{port}"
+                    _kv().kv_put(coord_key, coordinator, ns=_KV_NS)
+                else:
+                    deadline = time.monotonic() + 30
+                    while coordinator is None:
+                        coordinator = _kv().kv_get(coord_key, ns=_KV_NS)
+                        if coordinator is None:
+                            if time.monotonic() > deadline:
+                                raise TimeoutError("no coordinator published")
+                            time.sleep(0.05)
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=world_size,
+                process_id=rank,
+            )
+        if jax.process_count() != world_size:
+            raise RuntimeError(
+                f"xla backend: jax.process_count()={jax.process_count()} but "
+                f"world_size={world_size}; start one process per rank"
+            )
+        self._jax = jax
+        # one device per process, ordered by rank
+        per_proc: Dict[int, Any] = {}
+        for d in jax.devices():
+            per_proc.setdefault(d.process_index, d)
+        devs = [per_proc[i] for i in range(world_size)]
+        from jax.sharding import Mesh
+
+        self._mesh = Mesh(np.array(devs), ("ranks",))
+        self._local_device = per_proc[jax.process_index()]
+        # KV side-channel for p2p
+        self._host = HostGroup(world_size, rank, group_name + ":p2p") if world_size > 1 else None
+        # One jitted program per op kind, reused across calls (jax.jit caches
+        # by function identity — fresh lambdas per call would recompile).
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        replicated = NamedSharding(self._mesh, P())
+        self._programs = {
+            ReduceOp.SUM: jax.jit(lambda a: jnp.sum(a, axis=0), out_shardings=replicated),
+            ReduceOp.MEAN: jax.jit(lambda a: jnp.mean(a, axis=0), out_shardings=replicated),
+            ReduceOp.PRODUCT: jax.jit(lambda a: jnp.prod(a, axis=0), out_shardings=replicated),
+            ReduceOp.MAX: jax.jit(lambda a: jnp.max(a, axis=0), out_shardings=replicated),
+            ReduceOp.MIN: jax.jit(lambda a: jnp.min(a, axis=0), out_shardings=replicated),
+            "identity": jax.jit(lambda a: a, out_shardings=replicated),
+            "take": jax.jit(
+                lambda a, i: jax.lax.dynamic_index_in_dim(a, i, keepdims=False),
+                out_shardings=replicated,
+            ),
+        }
+
+    def _global(self, x: np.ndarray):
+        jax = self._jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        x = np.asarray(x)
+        shard = jax.device_put(x[None], self._local_device)
+        return jax.make_array_from_single_device_arrays(
+            (self.world_size,) + x.shape,
+            NamedSharding(self._mesh, P("ranks")),
+            [shard],
+        )
+
+    def allreduce(self, arr, op: ReduceOp, timeout_ms: int) -> np.ndarray:
+        out = self._programs[op](self._global(arr))
+        return np.asarray(out.addressable_data(0))
+
+    def reduce(self, arr, op: ReduceOp, root_rank: int, timeout_ms: int):
+        out = self.allreduce(arr, op, timeout_ms)
+        return out if self.rank == root_rank else np.asarray(arr)
+
+    def broadcast(self, arr, root_rank: int, timeout_ms: int):
+        out = self._programs["take"](self._global(arr), root_rank)
+        return np.asarray(out.addressable_data(0))
+
+    def allgather(self, arr, timeout_ms: int) -> List[np.ndarray]:
+        out = self._programs["identity"](self._global(arr))
+        return list(np.asarray(out.addressable_data(0)))
+
+    def reducescatter(self, arr, op: ReduceOp, timeout_ms: int) -> np.ndarray:
+        full = self.allreduce(arr, op, timeout_ms)
+        return np.array_split(full, self.world_size, axis=0)[self.rank]
+
+    def barrier(self, timeout_ms: int) -> None:
+        self.allreduce(np.zeros((1,), np.float32), ReduceOp.SUM, timeout_ms)
+
+    def send(self, arr, dst_rank: int, timeout_ms: int) -> None:
+        if self._host is None:
+            raise RuntimeError("send/recv needs world_size > 1")
+        self._host.send(arr, dst_rank, timeout_ms)
+
+    def recv(self, src_rank: int, timeout_ms: int) -> np.ndarray:
+        if self._host is None:
+            raise RuntimeError("send/recv needs world_size > 1")
+        return self._host.recv(src_rank, timeout_ms)
+
+    def destroy(self) -> None:
+        if self._host is not None:
+            self._host.destroy()
+        try:
+            _kv().kv_del(f"{self.group_name}:coordinator", ns=_KV_NS)
+        except Exception:
+            pass
+
+
+_BACKENDS = {Backend.HOST: HostGroup, Backend.XLA: XlaGroup}
+
+
+# ------------------------------------------------------------- group manager
+
+
+class GroupManager:
+    def __init__(self):
+        self._groups: Dict[str, BaseGroup] = {}
+        self._lock = threading.Lock()
+
+    def create(
+        self,
+        backend: Backend,
+        world_size: int,
+        rank: int,
+        name: str,
+        *,
+        public_name: Optional[str] = None,
+    ) -> BaseGroup:
+        """`name` keys the wire protocol (KV keys); `public_name` (default:
+        same) keys the local registry callers look groups up by."""
+        with self._lock:
+            key = public_name or name
+            if key in self._groups:
+                raise RuntimeError(f"collective group {key!r} already initialized")
+            group = _BACKENDS[backend](world_size, rank, name)
+            self._groups[key] = group
+            return group
+
+    def get(self, name: str) -> Optional[BaseGroup]:
+        with self._lock:
+            return self._groups.get(name)
+
+    def destroy(self, name: str) -> None:
+        with self._lock:
+            group = self._groups.pop(name, None)
+        if group is not None:
+            group.destroy()
+
+
+_manager = GroupManager()
+
+
+def _resolve_group(group_name: str) -> BaseGroup:
+    group = _manager.get(group_name)
+    if group is not None:
+        if getattr(group, "_decl_gen", None) is not None:
+            # Declaratively-created: guard against the driver having destroyed
+            # and re-created a same-named group with different membership.
+            meta = _kv().kv_get(f"decl:{group_name}", ns=_KV_NS)
+            if meta is None or meta["gen"] != group._decl_gen:
+                _manager.destroy(group_name)
+                group = None
+        if group is not None:
+            return group
+    # Declarative path (≈ collective.py:151): the driver stored group metadata
+    # in the controller KV keyed by group name; resolve our rank by actor id.
+    meta = _kv().kv_get(f"decl:{group_name}", ns=_KV_NS)
+    if meta is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} is not initialized in this "
+            "process; call init_collective_group or create_collective_group"
+        )
+    from ray_tpu._private.api import get_runtime_context
+
+    my_actor = get_runtime_context().actor_id
+    if my_actor is None or my_actor not in meta["actor_ids"]:
+        raise RuntimeError(
+            f"this process is not a member of collective group {group_name!r}"
+        )
+    rank = meta["ranks"][meta["actor_ids"].index(my_actor)]
+    group = _manager.create(
+        Backend.parse(meta["backend"]),
+        meta["world_size"],
+        rank,
+        # Key the wire protocol by generation so a stale member erring out is
+        # a timeout, never a silent cross-generation mix.
+        f"{group_name}@{meta['gen']}",
+        public_name=group_name,
+    )
+    group._decl_gen = meta["gen"]
+    return group
+
+
+# ------------------------------------------------------------- public API
+
+
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend: str = "host",
+    group_name: str = "default",
+) -> None:
+    """Imperative init, called inside each participating task/actor
+    (≈ collective.py:120)."""
+    _manager.create(Backend.parse(backend), world_size, rank, group_name)
+
+
+def create_collective_group(
+    actors: Sequence[Any],
+    world_size: int,
+    ranks: Sequence[int],
+    backend: str = "host",
+    group_name: str = "default",
+) -> None:
+    """Declarative init from the driver over actor handles
+    (≈ collective.py:151): stores membership in the controller KV; each actor
+    resolves its rank lazily on its first collective call."""
+    if len(actors) != len(ranks) or len(actors) != world_size:
+        raise ValueError("need exactly world_size actors and ranks")
+    if sorted(ranks) != list(range(world_size)):
+        raise ValueError(f"ranks must be a permutation of 0..{world_size - 1}")
+    actor_ids = [a._actor_id.hex() for a in actors]
+    prev = _kv().kv_get(f"decl:{group_name}", ns=_KV_NS)
+    _kv().kv_put(
+        f"decl:{group_name}",
+        {
+            "world_size": world_size,
+            "ranks": list(ranks),
+            "backend": str(Backend.parse(backend).value),
+            "actor_ids": actor_ids,
+            "gen": (prev["gen"] + 1) if prev else 0,
+        },
+        ns=_KV_NS,
+    )
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    return _manager.get(group_name) is not None
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    _manager.destroy(group_name)
+    try:
+        _kv().kv_del(f"decl:{group_name}", ns=_KV_NS)
+    except Exception:
+        pass
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _resolve_group(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _resolve_group(group_name).world_size
+
+
+DEFAULT_TIMEOUT_MS = 30000
+
+
+def allreduce(
+    tensor,
+    group_name: str = "default",
+    op: ReduceOp = ReduceOp.SUM,
+    timeout_ms: int = DEFAULT_TIMEOUT_MS,
+):
+    """Allreduce across the group (returns the reduced array; ≈ collective.py:258)."""
+    return _resolve_group(group_name).allreduce(tensor, op, timeout_ms)
+
+
+def reduce(
+    tensor,
+    dst_rank: int = 0,
+    group_name: str = "default",
+    op: ReduceOp = ReduceOp.SUM,
+    timeout_ms: int = DEFAULT_TIMEOUT_MS,
+):
+    return _resolve_group(group_name).reduce(tensor, op, dst_rank, timeout_ms)
+
+
+def broadcast(
+    tensor,
+    src_rank: int = 0,
+    group_name: str = "default",
+    timeout_ms: int = DEFAULT_TIMEOUT_MS,
+):
+    return _resolve_group(group_name).broadcast(tensor, src_rank, timeout_ms)
+
+
+def allgather(
+    tensor, group_name: str = "default", timeout_ms: int = DEFAULT_TIMEOUT_MS
+) -> List[np.ndarray]:
+    return _resolve_group(group_name).allgather(tensor, timeout_ms)
+
+
+def reducescatter(
+    tensor,
+    group_name: str = "default",
+    op: ReduceOp = ReduceOp.SUM,
+    timeout_ms: int = DEFAULT_TIMEOUT_MS,
+):
+    return _resolve_group(group_name).reducescatter(tensor, op, timeout_ms)
+
+
+def send(
+    tensor, dst_rank: int, group_name: str = "default", timeout_ms: int = DEFAULT_TIMEOUT_MS
+) -> None:
+    _resolve_group(group_name).send(tensor, dst_rank, timeout_ms)
+
+
+def recv(
+    src_rank: int, group_name: str = "default", timeout_ms: int = DEFAULT_TIMEOUT_MS
+) -> np.ndarray:
+    """Receive from src_rank. (The reference mutates a passed-in tensor; we
+    return the received array — functional style, consistent with JAX.)"""
+    return _resolve_group(group_name).recv(src_rank, timeout_ms)
+
+
+def barrier(group_name: str = "default", timeout_ms: int = DEFAULT_TIMEOUT_MS) -> None:
+    _resolve_group(group_name).barrier(timeout_ms)
+
+
+def synchronize(group_name: str = "default") -> None:
+    """Block until all queued device work is done (≈ cuda synchronize)."""
+    try:
+        import jax
+
+        (jax.device_put(0.0) + 0).block_until_ready()
+    except Exception:
+        pass

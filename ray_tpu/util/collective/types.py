@@ -1,0 +1,39 @@
+"""Shared types for the collective API.
+
+Analog of the reference's `python/ray/util/collective/types.py` (ReduceOp,
+backend enums, option dataclasses) — re-based for TPU: the fast backend is
+XLA collectives over ICI ("xla"), not NCCL; the slow/control backend is the
+controller-KV rendezvous ("host"), not Gloo.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class Backend(str, enum.Enum):
+    #: XLA collectives over ICI/DCN — jax.distributed runtime + mesh psum.
+    XLA = "xla"
+    #: Controller-KV rendezvous over the control plane (gloo analog): works
+    #: anywhere, sized for control-plane payloads (weights broadcast, metrics),
+    #: not the tensor hot path.
+    HOST = "host"
+
+    @classmethod
+    def parse(cls, v) -> "Backend":
+        if isinstance(v, Backend):
+            return v
+        v = str(v).lower()
+        if v in ("xla", "ici", "tpu"):
+            return cls.XLA
+        if v in ("host", "cpu", "gloo", "kv"):
+            return cls.HOST
+        raise ValueError(f"unknown collective backend {v!r}; use 'xla' or 'host'")
+
+
+class ReduceOp(enum.Enum):
+    SUM = "sum"
+    PRODUCT = "prod"
+    MAX = "max"
+    MIN = "min"
+    MEAN = "mean"

@@ -1,0 +1,165 @@
+"""Collective API tests (≈ reference python/ray/util/collective/tests/):
+imperative + declarative group setup across real actor processes, host
+backend; single-rank xla backend smoke."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util.collective import ReduceOp
+
+
+@ray_tpu.remote
+class Worker:
+    def __init__(self):
+        self.rank = None
+
+    def init_group(self, world_size, rank, backend="host", name="default"):
+        from ray_tpu.util import collective as col
+
+        col.init_collective_group(world_size, rank, backend=backend, group_name=name)
+        self.rank = rank
+        return rank
+
+    def allreduce(self, value, name="default", op=ReduceOp.SUM):
+        from ray_tpu.util import collective as col
+
+        return col.allreduce(np.asarray(value, np.float32), group_name=name, op=op)
+
+    def broadcast(self, value, src, name="default"):
+        from ray_tpu.util import collective as col
+
+        return col.broadcast(np.asarray(value, np.float32), src_rank=src, group_name=name)
+
+    def allgather(self, value, name="default"):
+        from ray_tpu.util import collective as col
+
+        return col.allgather(np.asarray(value, np.float32), group_name=name)
+
+    def reducescatter(self, value, name="default"):
+        from ray_tpu.util import collective as col
+
+        return col.reducescatter(np.asarray(value, np.float32), group_name=name)
+
+    def rank_info(self, name="default"):
+        from ray_tpu.util import collective as col
+
+        return col.get_rank(name), col.get_collective_group_size(name)
+
+    def send(self, value, dst, name="default"):
+        from ray_tpu.util import collective as col
+
+        col.send(np.asarray(value, np.float32), dst, group_name=name)
+        return True
+
+    def recv(self, src, name="default"):
+        from ray_tpu.util import collective as col
+
+        return col.recv(src, group_name=name)
+
+
+@pytest.fixture(scope="module")
+def pair(ray_init):
+    workers = [Worker.remote() for _ in range(2)]
+    ray_tpu.get(
+        [w.init_group.remote(2, i, "host", "pair") for i, w in enumerate(workers)]
+    )
+    yield workers
+    for w in workers:
+        ray_tpu.kill(w)
+
+
+class TestHostBackend:
+    def test_allreduce_sum(self, pair):
+        out = ray_tpu.get(
+            [w.allreduce.remote([1.0, 2.0], "pair") for w in pair]
+        )
+        for o in out:
+            np.testing.assert_allclose(o, [2.0, 4.0])
+
+    def test_allreduce_max(self, pair):
+        outs = ray_tpu.get(
+            [
+                pair[0].allreduce.remote([5.0], "pair", ReduceOp.MAX),
+                pair[1].allreduce.remote([7.0], "pair", ReduceOp.MAX),
+            ]
+        )
+        for o in outs:
+            np.testing.assert_allclose(o, [7.0])
+
+    def test_broadcast(self, pair):
+        outs = ray_tpu.get(
+            [
+                pair[0].broadcast.remote([42.0], 0, "pair"),
+                pair[1].broadcast.remote([0.0], 0, "pair"),
+            ]
+        )
+        for o in outs:
+            np.testing.assert_allclose(o, [42.0])
+
+    def test_allgather(self, pair):
+        outs = ray_tpu.get(
+            [
+                pair[0].allgather.remote([1.0], "pair"),
+                pair[1].allgather.remote([2.0], "pair"),
+            ]
+        )
+        for o in outs:
+            np.testing.assert_allclose(np.stack(o), [[1.0], [2.0]])
+
+    def test_reducescatter(self, pair):
+        outs = ray_tpu.get(
+            [
+                pair[0].reducescatter.remote([1.0, 2.0], "pair"),
+                pair[1].reducescatter.remote([10.0, 20.0], "pair"),
+            ]
+        )
+        np.testing.assert_allclose(outs[0], [11.0])
+        np.testing.assert_allclose(outs[1], [22.0])
+
+    def test_rank_info(self, pair):
+        infos = ray_tpu.get([w.rank_info.remote("pair") for w in pair])
+        assert sorted(infos) == [(0, 2), (1, 2)]
+
+    def test_send_recv(self, pair):
+        r = pair[1].recv.remote(0, "pair")
+        ray_tpu.get(pair[0].send.remote([3.5], 1, "pair"))
+        np.testing.assert_allclose(ray_tpu.get(r), [3.5])
+
+    def test_repeated_rounds(self, pair):
+        for i in range(3):
+            out = ray_tpu.get(
+                [w.allreduce.remote([float(i)], "pair") for w in pair]
+            )
+            for o in out:
+                np.testing.assert_allclose(o, [2.0 * i])
+
+
+class TestDeclarative:
+    def test_create_collective_group(self, ray_init):
+        from ray_tpu.util import collective as col
+
+        workers = [Worker.remote() for _ in range(2)]
+        col.create_collective_group(workers, 2, [0, 1], backend="host", group_name="decl")
+        out = ray_tpu.get([w.allreduce.remote([1.0], "decl") for w in workers])
+        for o in out:
+            np.testing.assert_allclose(o, [2.0])
+        infos = ray_tpu.get([w.rank_info.remote("decl") for w in workers])
+        assert sorted(infos) == [(0, 2), (1, 2)]
+        col.destroy_collective_group("decl")
+        for w in workers:
+            ray_tpu.kill(w)
+
+
+class TestXlaBackend:
+    def test_single_process_group(self, ray_init):
+        # world_size 1: collectives become local XLA programs
+        from ray_tpu.util import collective as col
+
+        col.init_collective_group(1, 0, backend="xla", group_name="solo")
+        out = col.allreduce(np.array([1.0, 2.0], np.float32), group_name="solo")
+        np.testing.assert_allclose(out, [1.0, 2.0])
+        gathered = col.allgather(np.array([3.0], np.float32), group_name="solo")
+        assert len(gathered) == 1
+        col.barrier(group_name="solo")
+        col.destroy_collective_group("solo")
