@@ -356,6 +356,20 @@ class RuntimeContext:
         raw = os.environ.get("TPU_VISIBLE_CHIPS", "")
         return [int(c) for c in raw.split(",") if c.strip()]
 
+    # getter-style aliases matching the reference's RuntimeContext
+    # (`python/ray/runtime_context.py` get_node_id/get_job_id/...)
+    def get_node_id(self) -> str:
+        return self.node_id
+
+    def get_job_id(self) -> str:
+        return self.job_id
+
+    def get_worker_id(self) -> str:
+        return self.worker_id
+
+    def get_actor_id(self) -> Optional[str]:
+        return self.actor_id
+
 
 def get_runtime_context() -> RuntimeContext:
     return RuntimeContext(_require_core())

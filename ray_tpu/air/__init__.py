@@ -1,0 +1,6 @@
+from ray_tpu.air.config import (  # noqa: F401
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)

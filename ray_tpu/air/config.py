@@ -1,0 +1,108 @@
+"""Shared run/scaling/failure/checkpoint configs.
+
+Analog of the reference's ``ray.air.config`` dataclasses
+(`python/ray/air/config.py`: ScalingConfig/RunConfig/FailureConfig/
+CheckpointConfig), reshaped for TPU: ``use_tpu`` + an optional slice
+``topology`` (e.g. ``"v5p-64"``) replace ``use_gpu``/``accelerator_type``,
+and worker resources are expressed in chips.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many training workers and what each one holds.
+
+    A worker is one *process* (one controller of a set of TPU chips). On a
+    multi-host slice there is one worker per host, each seeing its local
+    chips; ``num_workers`` therefore is the process count of the
+    ``jax.distributed`` runtime the backend assembles.
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    #: Chips each worker drives (0 = share whatever is visible).
+    tpus_per_worker: Optional[float] = None
+    topology: Optional[str] = None  # e.g. "v4-8", "v5p-64" — gang label
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    trainer_resources: Optional[Dict[str, float]] = None
+
+    def __post_init__(self):
+        if self.num_workers < 1:
+            raise ValueError("num_workers must be >= 1")
+
+    @property
+    def _worker_bundle(self) -> Dict[str, float]:
+        bundle: Dict[str, float] = {"CPU": 1.0}
+        if self.resources_per_worker:
+            bundle.update(
+                {k: float(v) for k, v in self.resources_per_worker.items()}
+            )
+        if self.use_tpu and "TPU" not in bundle:
+            bundle["TPU"] = float(self.tpus_per_worker or 1.0)
+        return bundle
+
+    def as_placement_group_bundles(self) -> List[Dict[str, float]]:
+        return [dict(self._worker_bundle) for _ in range(self.num_workers)]
+
+    @property
+    def total_workers(self) -> int:
+        return self.num_workers
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """Retry budget for a whole run (`air/config.py` FailureConfig).
+
+    ``max_failures``: 0 = no retries, n = retry up to n times, -1 = retry
+    forever. A failure means the worker group died; recovery restarts the
+    gang and resumes from the latest persisted checkpoint (mesh-level
+    recovery per SURVEY §5 — a lost host invalidates the whole mesh, so
+    per-object lineage does not apply to training state).
+    """
+
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    """Retention policy (`air/config.py` CheckpointConfig)."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+    def __post_init__(self):
+        if self.num_to_keep is not None and self.num_to_keep <= 0:
+            raise ValueError("num_to_keep must be positive or None")
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be 'max' or 'min'")
+
+
+@dataclasses.dataclass
+class RunConfig:
+    """Experiment-level settings (`air/config.py` RunConfig)."""
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: Optional[FailureConfig] = None
+    checkpoint_config: Optional[CheckpointConfig] = None
+    verbose: int = 1
+    log_to_file: bool = False
+    callbacks: Optional[List[Any]] = None
+
+    def __post_init__(self):
+        if self.storage_path is None:
+            self.storage_path = os.path.join(
+                os.path.expanduser("~"), "ray_tpu_results"
+            )
+        if self.failure_config is None:
+            self.failure_config = FailureConfig()
+        if self.checkpoint_config is None:
+            self.checkpoint_config = CheckpointConfig()

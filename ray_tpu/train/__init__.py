@@ -1,0 +1,28 @@
+"""ray_tpu.train — distributed training orchestration (Ray Train analog).
+
+Public surface mirrors `ray.train` (`python/ray/train/__init__.py`):
+Checkpoint, ScalingConfig/RunConfig/FailureConfig/CheckpointConfig,
+report/get_checkpoint/get_context/get_dataset_shard, trainers.
+"""
+
+from ray_tpu.air.config import (  # noqa: F401
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train._checkpoint import Checkpoint  # noqa: F401
+from ray_tpu.train._internal.session import (  # noqa: F401
+    TrainContext,
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
+from ray_tpu.train.backend import Backend, BackendConfig, JaxConfig  # noqa: F401
+from ray_tpu.train.trainer import (  # noqa: F401
+    BaseTrainer,
+    DataParallelTrainer,
+    JaxTrainer,
+    Result,
+)

@@ -1,0 +1,150 @@
+"""Drives a worker group through one training run.
+
+Analog of `ray.train._internal.backend_executor.BackendExecutor`
+(`python/ray/train/_internal/backend_executor.py:124` start, `:436`
+start_training): starts the gang, runs backend setup, ships the session to
+every worker, then pumps reports until all ranks finish. Worker death
+raises TrainingWorkerError; the trainer layer decides whether to restart
+(FailureConfig).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.air.config import ScalingConfig
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train._internal.session import TrainingReport
+from ray_tpu.train._internal.storage import StorageContext
+from ray_tpu.train._internal.worker_group import WorkerGroup
+from ray_tpu.train.backend import BackendConfig
+
+logger = logging.getLogger(__name__)
+
+
+class TrainingWorkerError(RuntimeError):
+    """A worker failed (actor death or user-code exception)."""
+
+
+class TrainingFinished(Exception):
+    """All ranks returned from the user loop."""
+
+    def __init__(self, finals: List[Any]):
+        self.finals = finals
+        super().__init__("training finished")
+
+
+class BackendExecutor:
+    def __init__(
+        self,
+        backend_config: BackendConfig,
+        scaling_config: ScalingConfig,
+        storage: StorageContext,
+        experiment_name: str,
+        trial_name: str,
+    ):
+        self._backend_config = backend_config
+        self._backend = backend_config.backend_cls()
+        self._scaling = scaling_config
+        self._storage = storage
+        self._experiment_name = experiment_name
+        self._trial_name = trial_name
+        self.worker_group: Optional[WorkerGroup] = None
+
+    def start(self) -> None:
+        self.worker_group = WorkerGroup(
+            num_workers=self._scaling.num_workers,
+            resources_per_worker=self._scaling._worker_bundle,
+            placement_strategy=self._scaling.placement_strategy,
+        )
+        self.worker_group.start()
+        self._backend.on_start(self.worker_group, self._backend_config)
+
+    def start_training(
+        self,
+        train_fn: Callable[[Optional[Dict]], Any],
+        train_fn_config: Optional[Dict[str, Any]],
+        checkpoint: Optional[Checkpoint],
+        dataset_shards_per_worker: Optional[List[Dict[str, Any]]] = None,
+        checkpoint_index: int = 0,
+    ) -> None:
+        assert self.worker_group is not None, "call start() first"
+        self._backend.on_training_start(self.worker_group,
+                                        self._backend_config)
+        import functools
+
+        refs = []
+        for w in self.worker_group.workers:
+            storage = StorageContext(
+                self._storage.storage_path,
+                self._storage.experiment_dir_name,
+                self._storage.trial_dir_name,
+            )
+            storage.current_checkpoint_index = checkpoint_index
+            storage.make_dirs()
+            shards = (dataset_shards_per_worker[w.world_rank]
+                      if dataset_shards_per_worker else {})
+            kwargs = dict(
+                train_fn=functools.partial(train_fn, train_fn_config)
+                if train_fn_config is not None else train_fn,
+                world_rank=w.world_rank,
+                local_rank=w.local_rank,
+                world_size=len(self.worker_group),
+                local_world_size=w.local_world_size,
+                node_rank=w.node_rank,
+                storage=storage,
+                experiment_name=self._experiment_name,
+                trial_name=self._trial_name,
+                loaded_checkpoint=checkpoint,
+                dataset_shards=shards,
+            )
+            refs.append(w.actor.start_session.remote(kwargs))
+        ray_tpu.get(refs)
+
+    def get_next_results(self,
+                         timeout: float = 600.0) -> List[TrainingReport]:
+        """One synchronized round: one report per rank.
+
+        Raises TrainingFinished when every rank's loop returned, and
+        TrainingWorkerError on any rank error/death.
+        """
+        assert self.worker_group is not None
+        refs = [
+            w.actor.next_report.remote(timeout)
+            for w in self.worker_group.workers
+        ]
+        try:
+            reports: List[TrainingReport] = ray_tpu.get(refs)
+        except Exception as e:
+            raise TrainingWorkerError(f"training worker died: {e}") from e
+        errors = [r for r in reports if r.kind == "error"]
+        if errors:
+            raise TrainingWorkerError(
+                f"{len(errors)}/{len(reports)} ranks failed: "
+                + "; ".join(r.error for r in errors[:3]))
+        done = [r for r in reports if r.kind == "done"]
+        if done:
+            if len(done) != len(reports):
+                # some ranks returned while others reported — drain mismatch
+                raise TrainingWorkerError(
+                    "ranks desynchronized: some finished while others "
+                    "are still reporting (uneven report() counts)")
+            raise TrainingFinished([r.final_return for r in reports])
+        return reports
+
+    def shutdown(self) -> None:
+        if self.worker_group is None:
+            return
+        try:
+            self._backend.on_shutdown(self.worker_group, self._backend_config)
+        except Exception:
+            pass
+        try:
+            for w in self.worker_group.workers:
+                w.actor.end_session.remote()
+        except Exception:
+            pass
+        self.worker_group.shutdown()
+        self.worker_group = None

@@ -1,0 +1,161 @@
+"""Actor-based worker group.
+
+Analog of `ray.train._internal.worker_group.WorkerGroup`
+(`python/ray/train/_internal/worker_group.py:102`): N long-lived actors,
+gang-placed under one placement group, each able to run arbitrary
+functions. Ranks are assigned by grouping workers on the same node
+(node_rank / local_rank), matching the reference's rank assignment in
+`backend_executor.py`.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.util.placement_group import (PlacementGroup, placement_group,
+                                          remove_placement_group)
+
+logger = logging.getLogger(__name__)
+
+
+class RayTrainWorker:
+    """The actor body. Hosts a session and executes shipped functions."""
+
+    def __init__(self):
+        self._session = None
+
+    def ping(self) -> bool:
+        return True
+
+    def node_info(self) -> Dict[str, Any]:
+        ctx = ray_tpu.get_runtime_context()
+        return {"node_id": ctx.get_node_id(), "pid": __import__("os").getpid()}
+
+    def set_env_vars(self, env: Dict[str, str]) -> bool:
+        import os
+
+        os.environ.update(env)
+        return True
+
+    def execute_fn(self, fn: Callable, *args, **kwargs):
+        return fn(*args, **kwargs)
+
+    # -------------------------------------------------------------- session
+
+    def start_session(self, session_kwargs: Dict[str, Any]) -> bool:
+        from ray_tpu.train._internal import session as session_mod
+
+        self._session = session_mod.init_session(**session_kwargs)
+        self._session.start()
+        return True
+
+    def next_report(self, timeout: Optional[float] = None):
+        assert self._session is not None, "session not started"
+        return self._session.next_report(timeout=timeout)
+
+    def end_session(self) -> None:
+        from ray_tpu.train._internal import session as session_mod
+
+        session_mod.shutdown_session()
+        self._session = None
+
+
+class WorkerMetadata:
+    def __init__(self, actor, node_id: str, pid: int):
+        self.actor = actor
+        self.node_id = node_id
+        self.pid = pid
+        self.world_rank = -1
+        self.local_rank = -1
+        self.node_rank = -1
+        self.local_world_size = 1
+
+
+class WorkerGroup:
+    def __init__(
+        self,
+        num_workers: int,
+        resources_per_worker: Optional[Dict[str, float]] = None,
+        placement_strategy: str = "PACK",
+    ):
+        self._num_workers = num_workers
+        self._resources = dict(resources_per_worker or {"CPU": 1.0})
+        self._pg: Optional[PlacementGroup] = None
+        self.workers: List[WorkerMetadata] = []
+        self._placement_strategy = placement_strategy
+
+    def start(self, timeout: float = 60.0) -> None:
+        bundles = [dict(self._resources) for _ in range(self._num_workers)]
+        self._pg = placement_group(bundles, strategy=self._placement_strategy)
+        if not self._pg.wait(timeout=timeout):
+            remove_placement_group(self._pg)
+            raise TimeoutError(
+                f"placement group for {self._num_workers} workers "
+                f"({self._resources}) not ready in {timeout}s")
+
+        worker_cls = ray_tpu.remote(RayTrainWorker)
+        opts: Dict[str, Any] = {"placement_group": self._pg}
+        num_cpus = self._resources.get("CPU", 1.0)
+        res = {k: v for k, v in self._resources.items() if k != "CPU"}
+        actors = [
+            worker_cls.options(
+                num_cpus=num_cpus,
+                resources=res or None,
+                placement_group=self._pg,
+                placement_group_bundle_index=i,
+            ).remote()
+            for i in range(self._num_workers)
+        ]
+        infos = ray_tpu.get([a.node_info.remote() for a in actors])
+        self.workers = [
+            WorkerMetadata(a, info["node_id"], info["pid"])
+            for a, info in zip(actors, infos)
+        ]
+        self._assign_ranks()
+
+    def _assign_ranks(self) -> None:
+        """Stable sort by node so co-located workers get contiguous world
+        ranks (ICI-adjacent ranks on one host), then rank within node."""
+        by_node: Dict[str, List[WorkerMetadata]] = {}
+        for w in self.workers:
+            by_node.setdefault(w.node_id, []).append(w)
+        self.workers = [w for node in by_node.values() for w in node]
+        for node_rank, node in enumerate(by_node.values()):
+            for local_rank, w in enumerate(node):
+                w.node_rank = node_rank
+                w.local_rank = local_rank
+                w.local_world_size = len(node)
+        for world_rank, w in enumerate(self.workers):
+            w.world_rank = world_rank
+
+    def __len__(self) -> int:
+        return len(self.workers)
+
+    def execute(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        return ray_tpu.get(self.execute_async(fn, *args, **kwargs))
+
+    def execute_async(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        return [
+            w.actor.execute_fn.remote(fn, *args, **kwargs)
+            for w in self.workers
+        ]
+
+    def execute_single(self, rank: int, fn: Callable, *args, **kwargs) -> Any:
+        return ray_tpu.get(
+            self.workers[rank].actor.execute_fn.remote(fn, *args, **kwargs))
+
+    def shutdown(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w.actor)
+            except Exception:
+                pass
+        self.workers = []
+        if self._pg is not None:
+            try:
+                remove_placement_group(self._pg)
+            except Exception:
+                pass
+            self._pg = None

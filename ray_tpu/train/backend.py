@@ -1,0 +1,128 @@
+"""Training backends.
+
+Analog of `ray.train.backend.Backend/BackendConfig` plus the torch backend
+(`python/ray/train/torch/config.py:150` `_TorchBackend.on_start`, which runs
+`dist.init_process_group` on every worker) and the torch-XLA TPU backend
+(`python/ray/train/torch/xla/config.py:20`).
+
+TPU-first replacement: the process group IS a `jax.distributed` runtime.
+Worker 0 picks a coordinator port; every worker calls
+`jax.distributed.initialize(coordinator, num_processes, process_id)` before
+the user loop runs, after which `jax.devices()` spans the whole slice and
+pjit/GSPMD emit ICI collectives — there is no NCCL layer to bootstrap.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class BackendConfig:
+    @property
+    def backend_cls(self):
+        return Backend
+
+
+class Backend:
+    """Hooks called by the BackendExecutor around the worker group."""
+
+    def on_start(self, worker_group, backend_config: BackendConfig) -> None:
+        pass
+
+    def on_training_start(self, worker_group,
+                          backend_config: BackendConfig) -> None:
+        pass
+
+    def on_shutdown(self, worker_group, backend_config: BackendConfig) -> None:
+        pass
+
+
+# ----------------------------------------------------------------- jax
+
+
+@dataclasses.dataclass
+class JaxConfig(BackendConfig):
+    """Backend config for JAX SPMD training.
+
+    ``distributed``: form a multi-process `jax.distributed` runtime across
+    the workers. ``None`` (default) auto-enables when there is more than one
+    worker AND TPU chips are attached — the multi-host case. Single-worker
+    runs (one process driving all local chips) skip it: `jax.devices()`
+    already sees everything.
+    """
+
+    distributed: Optional[bool] = None
+    use_tpu: bool = False
+    coordinator_port: int = 0  # 0 = pick a free port
+
+    @property
+    def backend_cls(self):
+        return _JaxBackend
+
+
+def _find_coordinator(port_hint: int):
+    import socket
+
+    host = socket.gethostbyname(socket.gethostname())
+    if port_hint:
+        return f"{host}:{port_hint}"
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return f"{host}:{port}"
+
+
+def _init_jax_distributed(coordinator: str, num_processes: int,
+                          process_id: int) -> bool:
+    import jax
+
+    if not jax.distributed.is_initialized():
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    return True
+
+
+class _JaxBackend(Backend):
+    def on_start(self, worker_group, backend_config: JaxConfig) -> None:
+        n = len(worker_group)
+        distributed = backend_config.distributed
+        if distributed is None:
+            distributed = n > 1 and backend_config.use_tpu
+        if not distributed:
+            return
+        coordinator = worker_group.execute_single(
+            0, _find_coordinator, backend_config.coordinator_port)
+        logger.info("jax.distributed coordinator at %s (%d processes)",
+                    coordinator, n)
+        import ray_tpu
+
+        ray_tpu.get([
+            w.actor.execute_fn.remote(
+                _init_jax_distributed, coordinator, n, w.world_rank)
+            for w in worker_group.workers
+        ])
+
+    def on_shutdown(self, worker_group, backend_config: JaxConfig) -> None:
+        def _shutdown():
+            try:
+                import jax
+
+                if jax.distributed.is_initialized():
+                    jax.distributed.shutdown()
+            except Exception:
+                pass
+            return True
+
+        try:
+            worker_group.execute(_shutdown)
+        except Exception:
+            pass

@@ -144,9 +144,11 @@ class HostGroup(BaseGroup):
         """One gather-to-root + broadcast round; returns the combined result."""
         kv = _kv()
         seq, self._seq = self._seq, self._seq + 1
-        kv.kv_put(self._key(seq, "c", self.rank), payload, ns=_KV_NS)
         if self.rank == 0:
+            # rank 0 is the reducer: its own contribution never needs to
+            # transit the controller — use the local payload in place.
             parts = [
+                payload if r == 0 else
                 self._poll(self._key(seq, "c", r), timeout_ms, delete=True)
                 for r in range(self.world_size)
             ]
@@ -155,6 +157,7 @@ class HostGroup(BaseGroup):
                 kv.kv_del(self._key(seq - 1, "r"), ns=_KV_NS)
             kv.kv_put(self._key(seq, "r"), result, ns=_KV_NS)
             return result
+        kv.kv_put(self._key(seq, "c", self.rank), payload, ns=_KV_NS)
         return self._poll(self._key(seq, "r"), timeout_ms)
 
     # ----- ops
@@ -270,6 +273,14 @@ class XlaGroup(BaseGroup):
             raise RuntimeError(
                 f"xla backend: jax.process_count()={jax.process_count()} but "
                 f"world_size={world_size}; start one process per rank"
+            )
+        if world_size > 1 and jax.process_index() != rank:
+            # The mesh below places each process's shard at its
+            # process_index; a pre-initialized runtime whose rank assignment
+            # differs would silently reorder broadcast/allgather results.
+            raise RuntimeError(
+                f"xla backend: jax.process_index()={jax.process_index()} "
+                f"must equal the collective rank ({rank})"
             )
         self._jax = jax
         # one device per process, ordered by rank
@@ -470,7 +481,13 @@ def create_collective_group(
     if sorted(ranks) != list(range(world_size)):
         raise ValueError(f"ranks must be a permutation of 0..{world_size - 1}")
     actor_ids = [a._actor_id.hex() for a in actors]
-    prev = _kv().kv_get(f"decl:{group_name}", ns=_KV_NS)
+    # The generation counter lives under its own key that destroy_* never
+    # deletes: re-creating a destroyed group must still advance the gen, or
+    # stale members (and their leftover wire keys from the old generation)
+    # would silently mix into the new group.
+    prev_gen = _kv().kv_get(f"declgen:{group_name}", ns=_KV_NS)
+    gen = (prev_gen + 1) if prev_gen is not None else 0
+    _kv().kv_put(f"declgen:{group_name}", gen, ns=_KV_NS)
     _kv().kv_put(
         f"decl:{group_name}",
         {
@@ -478,7 +495,7 @@ def create_collective_group(
             "ranks": list(ranks),
             "backend": str(Backend.parse(backend).value),
             "actor_ids": actor_ids,
-            "gen": (prev["gen"] + 1) if prev else 0,
+            "gen": gen,
         },
         ns=_KV_NS,
     )
