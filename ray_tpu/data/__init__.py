@@ -1,0 +1,21 @@
+"""ray_tpu.data — lazy streaming distributed datasets (Ray Data analog,
+`python/ray/data/`)."""
+
+from ray_tpu.data.block import Block  # noqa: F401
+from ray_tpu.data.dataset import (  # noqa: F401
+    Dataset,
+    GroupedData,
+    MaterializedDataset,
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    range_tensor,
+    read_binary_files,
+    read_csv,
+    read_json,
+    read_numpy,
+    read_parquet,
+)
+from ray_tpu.data.iterator import DataIterator  # noqa: F401

@@ -1,0 +1,131 @@
+"""Blocks — the unit of distributed data.
+
+Analog of the reference's `python/ray/data/block.py` +
+`_internal/arrow_block.py`: a block is one pyarrow.Table living in the
+object store; metadata (row count, byte size) travels as a second, inlined
+task return so planners never fetch payloads to learn sizes. Batches
+convert between arrow / pandas / numpy-dict at the boundary only.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Iterator, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+Block = pa.Table
+
+
+def even_cuts(n: int, parts: int) -> List[int]:
+    """parts+1 cut points splitting n items as evenly as possible."""
+    parts = max(1, min(parts, n or 1))
+    return [round(i * n / parts) for i in range(parts + 1)]
+
+
+def block_meta(block: Block) -> Dict[str, Any]:
+    return {"num_rows": block.num_rows, "size_bytes": block.nbytes}
+
+
+def batch_to_block(batch: Any) -> Block:
+    """Accepts pyarrow.Table, pandas.DataFrame, dict of arrays/lists, or a
+    list of row-dicts."""
+    if isinstance(batch, pa.Table):
+        return batch
+    try:
+        import pandas as pd
+
+        if isinstance(batch, pd.DataFrame):
+            return pa.Table.from_pandas(batch, preserve_index=False)
+    except ImportError:
+        pass
+    if isinstance(batch, dict):
+        cols = {}
+        for k, v in batch.items():
+            v = np.asarray(v) if not isinstance(v, np.ndarray) else v
+            if v.ndim > 1:
+                # tensor column: nested arrow lists (ndarray → pylist, since
+                # arrow can't infer nesting from an array of ndarrays)
+                cols[k] = pa.array(v.tolist())
+            else:
+                cols[k] = pa.array(v)
+        return pa.table(cols)
+    if isinstance(batch, list):
+        if batch and isinstance(batch[0], dict):
+            keys = batch[0].keys()
+            return batch_to_block({k: [r[k] for r in batch] for k in keys})
+        return pa.table({"item": pa.array(batch)})
+    raise TypeError(f"cannot convert {type(batch).__name__} to a block")
+
+
+def _column_to_numpy(col: pa.ChunkedArray) -> np.ndarray:
+    if pa.types.is_list(col.type) or pa.types.is_large_list(col.type):
+        pylist = col.to_pylist()
+        try:
+            return np.asarray(pylist)
+        except ValueError:  # ragged
+            return np.asarray(pylist, dtype=object)
+    try:
+        return col.to_numpy(zero_copy_only=False)
+    except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+        return np.asarray(col.to_pylist(), dtype=object)
+
+
+def block_to_batch(block: Block, batch_format: str = "numpy") -> Any:
+    if batch_format in ("pyarrow", "arrow"):
+        return block
+    if batch_format == "pandas":
+        return block.to_pandas()
+    if batch_format in ("numpy", "default"):
+        return {name: _column_to_numpy(block.column(name))
+                for name in block.column_names}
+    raise ValueError(f"unknown batch_format {batch_format!r}")
+
+
+def block_rows(block: Block) -> Iterator[Dict[str, Any]]:
+    for row in block.to_pylist():
+        yield row
+
+
+def slice_block(block: Block, start: int, end: int) -> Block:
+    return block.slice(start, end - start)
+
+
+def concat_blocks(blocks: List[Block]) -> Block:
+    blocks = [b for b in blocks if b is not None and b.num_rows > 0]
+    if not blocks:
+        return pa.table({})
+    if len(blocks) == 1:
+        return blocks[0]
+    return pa.concat_tables(blocks, promote_options="permissive")
+
+
+def batches_from_blocks(
+    blocks: Iterable[Block],
+    batch_size: Optional[int],
+    batch_format: str = "numpy",
+    drop_last: bool = False,
+) -> Iterator[Any]:
+    """Re-chunk a block stream into fixed-size batches (the reference's
+    `_internal/block_batching/`)."""
+    if batch_size is None:
+        for b in blocks:
+            if b.num_rows > 0:
+                yield block_to_batch(b, batch_format)
+        return
+    carry: List[Block] = []
+    carry_rows = 0
+    for b in blocks:
+        carry.append(b)
+        carry_rows += b.num_rows
+        while carry_rows >= batch_size:
+            merged = concat_blocks(carry)
+            yield block_to_batch(slice_block(merged, 0, batch_size),
+                                 batch_format)
+            merged = slice_block(merged, batch_size, merged.num_rows)
+            carry = [merged]
+            carry_rows = merged.num_rows
+    if carry_rows > 0 and not drop_last:
+        merged = concat_blocks(carry)
+        if merged.num_rows:
+            yield block_to_batch(merged, batch_format)

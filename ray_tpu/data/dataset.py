@@ -1,0 +1,460 @@
+"""The Dataset API.
+
+Analog of `ray.data.Dataset` (`python/ray/data/dataset.py:137`;
+map_batches `:371`, iter_batches `:3641`, materialize `:4521`): a lazy
+logical plan over distributed Arrow blocks, executed by the streaming
+executor on the task layer. TPU angle: `iter_batches` composes with
+`DataIterator.iter_jax_batches` (double-buffered `jax.device_put`) so
+ingest overlaps with device compute — the plasma-zero-copy role is played
+by host Arrow blocks + async device transfer (SURVEY §5 backend note).
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Union as TUnion
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data._internal import logical as L
+from ray_tpu.data._internal.executor import (DEFAULT_CONCURRENCY,
+                                             execute_plan, resolve_meta)
+from ray_tpu.data.block import (Block, batch_to_block, block_meta,
+                                block_rows, block_to_batch, even_cuts)
+from ray_tpu.data.iterator import DataIterator, _BlockStreamIterator
+
+
+class Dataset:
+    def __init__(self, ops: List[L.LogicalOp],
+                 concurrency: int = DEFAULT_CONCURRENCY):
+        self._ops = ops
+        self._concurrency = concurrency
+
+    # ------------------------------------------------------------ plumbing
+
+    def _with(self, op: L.LogicalOp) -> "Dataset":
+        return Dataset(self._ops + [op], self._concurrency)
+
+    def _stream(self):
+        return execute_plan(self._ops, self._concurrency)
+
+    def iter_internal_ref_bundles(self):
+        """Public-ish escape hatch (reference: Dataset.iter_internal_ref_bundles)."""
+        return self._stream()
+
+    # ---------------------------------------------------------- transforms
+
+    def map_batches(
+        self,
+        fn: Callable,
+        *,
+        batch_size: Optional[int] = None,
+        batch_format: str = "numpy",
+        fn_args: Tuple = (),
+        fn_kwargs: Optional[Dict] = None,
+        **_ignored,
+    ) -> "Dataset":
+        return self._with(L.OneToOne(
+            L.make_map_batches_transform(fn, batch_size, batch_format,
+                                         fn_args, fn_kwargs),
+            label=getattr(fn, "__name__", "map_batches")))
+
+    def map(self, fn: Callable) -> "Dataset":
+        return self._with(L.OneToOne(L.make_map_rows_transform(fn),
+                                     label="map"))
+
+    def flat_map(self, fn: Callable) -> "Dataset":
+        return self._with(L.OneToOne(L.make_flat_map_transform(fn),
+                                     label="flat_map"))
+
+    def filter(self, fn: Callable) -> "Dataset":
+        return self._with(L.OneToOne(L.make_filter_transform(fn),
+                                     label="filter"))
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        return self._with(L.OneToOne(L.make_add_column_transform(name, fn),
+                                     label=f"add_column({name})"))
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        return self.map_batches(
+            lambda b: b.drop_columns(cols), batch_format="pyarrow")
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        return self.map_batches(
+            lambda b: b.select(cols), batch_format="pyarrow")
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        def rename(b):
+            return b.rename_columns(
+                [mapping.get(c, c) for c in b.column_names])
+
+        return self.map_batches(rename, batch_format="pyarrow")
+
+    def limit(self, n: int) -> "Dataset":
+        return self._with(L.Limit(n))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._with(L.AllToAll("repartition",
+                                     {"num_blocks": num_blocks}))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        return self._with(L.AllToAll("shuffle", {"seed": seed}))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        return self._with(L.AllToAll(
+            "sort", {"key": key, "descending": descending}))
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        return self._with(L.Union(others=[o._ops for o in others]))
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        return self._with(L.Zip(other=other._ops))
+
+    # --------------------------------------------------------- consumption
+
+    def iter_blocks(self) -> Iterator[Block]:
+        for ref, _meta in self._stream():
+            yield ray_tpu.get(ref)
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: str = "numpy", drop_last: bool = False,
+                     prefetch_batches: int = 1,
+                     local_shuffle_buffer_size: Optional[int] = None,
+                     local_shuffle_seed: Optional[int] = None):
+        return self.iterator().iter_batches(
+            batch_size=batch_size, batch_format=batch_format,
+            drop_last=drop_last, prefetch_batches=prefetch_batches,
+            local_shuffle_buffer_size=local_shuffle_buffer_size,
+            local_shuffle_seed=local_shuffle_seed)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for block in self.iter_blocks():
+            yield from block_rows(block)
+
+    def iterator(self) -> DataIterator:
+        return _BlockStreamIterator(self)
+
+    def iter_torch_batches(self, **kw):
+        return self.iterator().iter_torch_batches(**kw)
+
+    def iter_jax_batches(self, **kw):
+        return self.iterator().iter_jax_batches(**kw)
+
+    def take(self, n: int = 20) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for row in self.limit(n).iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Dict[str, Any]]:
+        return list(self.iter_rows())
+
+    def take_batch(self, batch_size: int = 20,
+                   batch_format: str = "numpy") -> Any:
+        for batch in self.limit(batch_size).iter_batches(
+                batch_size=batch_size, batch_format=batch_format):
+            return batch
+        raise ValueError("dataset is empty")
+
+    def count(self) -> int:
+        return sum(resolve_meta(m)["num_rows"] for _, m in self._stream())
+
+    def schema(self):
+        for ref, _ in self._stream():
+            return ray_tpu.get(ref).schema
+        return None
+
+    def columns(self) -> List[str]:
+        s = self.schema()
+        return list(s.names) if s is not None else []
+
+    def size_bytes(self) -> int:
+        return sum(resolve_meta(m)["size_bytes"] for _, m in self._stream())
+
+    def num_blocks(self) -> int:
+        return sum(1 for _ in self._stream())
+
+    def stats(self) -> str:
+        n, rows, size = 0, 0, 0
+        for _, m in self._stream():
+            m = resolve_meta(m)
+            n += 1
+            rows += m["num_rows"]
+            size += m["size_bytes"]
+        return (f"Dataset: {n} blocks, {rows} rows, {size} bytes; "
+                f"plan={[type(o).__name__ for o in self._ops]}")
+
+    # aggregates
+    def sum(self, col: str):
+        return self._agg(col, np.sum)
+
+    def min(self, col: str):
+        return self._agg(col, np.min)
+
+    def max(self, col: str):
+        return self._agg(col, np.max)
+
+    def mean(self, col: str):
+        total, count = 0.0, 0
+        for b in self.iter_blocks():
+            if b.num_rows:
+                a = b.column(col).to_numpy(zero_copy_only=False)
+                total += float(a.sum())
+                count += len(a)
+        return total / count if count else float("nan")
+
+    def _agg(self, col: str, fn):
+        vals = [fn(b.column(col).to_numpy(zero_copy_only=False))
+                for b in self.iter_blocks() if b.num_rows]
+        return fn(np.array(vals)).item() if vals else None
+
+    # ------------------------------------------------------ materialization
+
+    def materialize(self) -> "MaterializedDataset":
+        refs, metas = [], []
+        for ref, m in self._stream():
+            refs.append(ref)
+            metas.append(resolve_meta(m))
+        return MaterializedDataset(
+            [L.InputData(block_refs=refs, metas=metas)], self._concurrency)
+
+    def split(self, n: int) -> List["MaterializedDataset"]:
+        """Materialize and split into n contiguous sub-datasets
+        (reference: Dataset.split)."""
+        mat = self.materialize()
+        src: L.InputData = mat._ops[0]
+        cuts = even_cuts(len(src.block_refs), n)
+        n = len(cuts) - 1
+        return [
+            MaterializedDataset(
+                [L.InputData(block_refs=src.block_refs[cuts[i]:cuts[i + 1]],
+                             metas=src.metas[cuts[i]:cuts[i + 1]])],
+                self._concurrency)
+            for i in builtins.range(n)
+        ]
+
+    def streaming_split(self, n: int, *, equal: bool = False,
+                        locality_hints=None) -> List[DataIterator]:
+        """n iterators fed by one shared streaming execution
+        (reference: Dataset.streaming_split / _StreamSplitDataIterator).
+        Blocks are handed out first-come-first-served by a coordinator
+        actor, so faster consumers do more work."""
+        from ray_tpu.data.iterator import (_SplitCoordinator,
+                                           _StreamSplitIterator)
+
+        coord = ray_tpu.remote(_SplitCoordinator).options(
+            num_cpus=0.1).remote(self._ops, self._concurrency, n, equal)
+        return [_StreamSplitIterator(coord, rank=i) for i in builtins.range(n)]
+
+    # ------------------------------------------------------------- writes
+
+    def write_parquet(self, path: str) -> List[str]:
+        return self._write(path, "parquet")
+
+    def write_csv(self, path: str) -> List[str]:
+        return self._write(path, "csv")
+
+    def write_json(self, path: str) -> List[str]:
+        return self._write(path, "json")
+
+    def _write(self, path: str, fmt: str) -> List[str]:
+        from ray_tpu.data.datasource import write_block
+
+        w = ray_tpu.remote(write_block)
+        refs = [w.remote(ref, path, i, fmt)
+                for i, (ref, _m) in enumerate(self._stream())]
+        return ray_tpu.get(refs)
+
+    # ------------------------------------------------------------- interop
+
+    def to_pandas(self, limit: Optional[int] = None):
+        import pandas as pd
+
+        ds = self.limit(limit) if limit else self
+        frames = [b.to_pandas() for b in ds.iter_blocks()]
+        if not frames:
+            return pd.DataFrame()
+        return pd.concat(frames, ignore_index=True)
+
+    def to_arrow_refs(self) -> List[Any]:
+        return [ref for ref, _ in self._stream()]
+
+    def __repr__(self) -> str:
+        return (f"Dataset(ops={[type(o).__name__ for o in self._ops]})")
+
+
+class MaterializedDataset(Dataset):
+    pass
+
+
+# ------------------------------------------------------------- groupby
+
+
+class GroupedData:
+    """Analog of `ray.data.grouped_data.GroupedData`."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _agg(self, agg_fn) -> Dataset:
+        return self._ds._with(L.AllToAll(
+            "groupby", {"key": self._key, "agg_fn": agg_fn}))
+
+    def count(self) -> Dataset:
+        key = self._key
+
+        def fn(df):
+            out = df.groupby(key, sort=True).size().reset_index(name="count()")
+            return out
+
+        return self._agg(fn)
+
+    def sum(self, col: str) -> Dataset:
+        return self._named_agg(col, "sum")
+
+    def mean(self, col: str) -> Dataset:
+        return self._named_agg(col, "mean")
+
+    def min(self, col: str) -> Dataset:
+        return self._named_agg(col, "min")
+
+    def max(self, col: str) -> Dataset:
+        return self._named_agg(col, "max")
+
+    def std(self, col: str) -> Dataset:
+        return self._named_agg(col, "std")
+
+    def _named_agg(self, col: str, how: str) -> Dataset:
+        key = self._key
+
+        def fn(df):
+            out = (df.groupby(key, sort=True)[col].agg(how)
+                   .reset_index(name=f"{how}({col})"))
+            return out
+
+        return self._agg(fn)
+
+    def map_groups(self, fn: Callable, *,
+                   batch_format: str = "pandas") -> Dataset:
+        key = self._key
+
+        def apply(df):
+            import pandas as pd
+
+            outs = []
+            for _, g in df.groupby(key, sort=True):
+                if batch_format == "numpy":
+                    res = fn({c: g[c].to_numpy() for c in g.columns})
+                    outs.append(block_to_batch(batch_to_block(res), "pandas"))
+                else:
+                    outs.append(fn(g))
+            return pd.concat(outs, ignore_index=True)
+
+        return self._agg(apply)
+
+
+# --------------------------------------------------------------- sources
+
+
+def _input_dataset(blocks: List[Block], concurrency=DEFAULT_CONCURRENCY,
+                   target_rows_per_block: Optional[int] = None) -> Dataset:
+    refs, metas = [], []
+    for b in blocks:
+        refs.append(ray_tpu.put(b))
+        metas.append(block_meta(b))
+    return Dataset([L.InputData(block_refs=refs, metas=metas)], concurrency)
+
+
+def _chunk(n_items: int, parallelism: int) -> List[Tuple[int, int]]:
+    cuts = even_cuts(n_items, parallelism)
+    return [(cuts[i], cuts[i + 1]) for i in builtins.range(len(cuts) - 1)
+            if cuts[i] < cuts[i + 1]]
+
+
+def from_items(items: List[Any], *, parallelism: int = 16) -> Dataset:
+    if items and not isinstance(items[0], dict):
+        items = [{"item": x} for x in items]
+    blocks = [batch_to_block(items[lo:hi])
+              for lo, hi in _chunk(len(items), parallelism)] or [
+                  batch_to_block([])]
+    return _input_dataset(blocks)
+
+
+def range(n: int, *, parallelism: int = 16) -> Dataset:
+    from ray_tpu.data.datasource import range_tasks
+
+    return Dataset([L.Read(read_tasks=range_tasks(n, parallelism),
+                           datasource_name="range")])
+
+
+def range_tensor(n: int, *, shape: Tuple[int, ...] = (1,),
+                 parallelism: int = 16) -> Dataset:
+    from ray_tpu.data.datasource import range_tensor_tasks
+
+    return Dataset([L.Read(read_tasks=range_tensor_tasks(n, shape,
+                                                         parallelism),
+                           datasource_name="range_tensor")])
+
+
+def from_pandas(dfs) -> Dataset:
+    import pandas as pd
+
+    if isinstance(dfs, pd.DataFrame):
+        dfs = [dfs]
+    return _input_dataset([batch_to_block(df) for df in dfs])
+
+
+def from_arrow(tables) -> Dataset:
+    import pyarrow as pa
+
+    if isinstance(tables, pa.Table):
+        tables = [tables]
+    return _input_dataset(list(tables))
+
+
+def from_numpy(arrays, column: str = "data") -> Dataset:
+    if isinstance(arrays, np.ndarray):
+        arrays = [arrays]
+    return _input_dataset([batch_to_block({column: a}) for a in arrays])
+
+
+def read_parquet(paths, *, columns=None, **_kw) -> Dataset:
+    from ray_tpu.data.datasource import parquet_tasks
+
+    return Dataset([L.Read(read_tasks=parquet_tasks(paths, columns),
+                           datasource_name="parquet")])
+
+
+def read_csv(paths, **_kw) -> Dataset:
+    from ray_tpu.data.datasource import csv_tasks
+
+    return Dataset([L.Read(read_tasks=csv_tasks(paths),
+                           datasource_name="csv")])
+
+
+def read_json(paths, **_kw) -> Dataset:
+    from ray_tpu.data.datasource import json_tasks
+
+    return Dataset([L.Read(read_tasks=json_tasks(paths),
+                           datasource_name="json")])
+
+
+def read_numpy(paths, **_kw) -> Dataset:
+    from ray_tpu.data.datasource import numpy_tasks
+
+    return Dataset([L.Read(read_tasks=numpy_tasks(paths),
+                           datasource_name="numpy")])
+
+
+def read_binary_files(paths, **_kw) -> Dataset:
+    from ray_tpu.data.datasource import binary_tasks
+
+    return Dataset([L.Read(read_tasks=binary_tasks(paths),
+                           datasource_name="binary")])

@@ -1,0 +1,157 @@
+"""Read tasks and write functions for the built-in formats.
+
+Analog of the reference's `python/ray/data/datasource/` (parquet, csv,
+json, numpy, range, binary sources and the corresponding datasinks). A
+read task is a zero-arg callable returning one Block, executed remotely by
+the streaming executor's ReadStage.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Callable, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from ray_tpu.data.block import Block, batch_to_block, even_cuts
+
+
+def _expand_paths(paths, suffix: Optional[str] = None) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    files: List[str] = []
+    for p in paths:
+        p = os.path.expanduser(p)
+        if os.path.isdir(p):
+            pattern = os.path.join(p, "**", f"*{suffix or ''}")
+            files.extend(f for f in glob.glob(pattern, recursive=True)
+                         if os.path.isfile(f))
+        elif any(ch in p for ch in "*?["):
+            files.extend(f for f in glob.glob(p) if os.path.isfile(f))
+        elif os.path.isfile(p):
+            files.append(p)
+        else:
+            raise FileNotFoundError(p)
+    if not files:
+        raise FileNotFoundError(f"no files matched {paths!r}")
+    return sorted(files)
+
+
+# ------------------------------------------------------------- read tasks
+
+
+def range_tasks(n: int, parallelism: int) -> List[Callable[[], Block]]:
+    cuts = even_cuts(n, parallelism)
+
+    def make(lo: int, hi: int):
+        return lambda: pa.table({"id": np.arange(lo, hi, dtype=np.int64)})
+
+    return [make(cuts[i], cuts[i + 1]) for i in range(len(cuts) - 1)]
+
+
+def range_tensor_tasks(n: int, shape, parallelism: int):
+    cuts = even_cuts(n, parallelism)
+
+    def make(lo: int, hi: int):
+        def task():
+            count = hi - lo
+            base = np.arange(lo, hi, dtype=np.int64).reshape(
+                (count,) + (1,) * len(shape))
+            data = np.broadcast_to(base, (count,) + tuple(shape)).copy()
+            return batch_to_block({"data": data})
+
+        return task
+
+    return [make(cuts[i], cuts[i + 1]) for i in range(len(cuts) - 1)]
+
+
+def parquet_tasks(paths, columns=None) -> List[Callable[[], Block]]:
+    files = _expand_paths(paths, ".parquet")
+
+    def make(f):
+        def task():
+            import pyarrow.parquet as pq
+
+            return pq.read_table(f, columns=columns)
+
+        return task
+
+    return [make(f) for f in files]
+
+
+def csv_tasks(paths) -> List[Callable[[], Block]]:
+    files = _expand_paths(paths, ".csv")
+
+    def make(f):
+        def task():
+            import pyarrow.csv as pacsv
+
+            return pacsv.read_csv(f)
+
+        return task
+
+    return [make(f) for f in files]
+
+
+def json_tasks(paths) -> List[Callable[[], Block]]:
+    files = _expand_paths(paths)
+
+    def make(f):
+        def task():
+            import pyarrow.json as pajson
+
+            return pajson.read_json(f)
+
+        return task
+
+    return [make(f) for f in files]
+
+
+def numpy_tasks(paths) -> List[Callable[[], Block]]:
+    files = _expand_paths(paths, ".npy")
+
+    def make(f):
+        def task():
+            return batch_to_block({"data": np.load(f)})
+
+        return task
+
+    return [make(f) for f in files]
+
+
+def binary_tasks(paths) -> List[Callable[[], Block]]:
+    files = _expand_paths(paths)
+
+    def make(f):
+        def task():
+            with open(f, "rb") as fh:
+                payload = fh.read()
+            return pa.table({"path": [f], "bytes": pa.array([payload],
+                                                            pa.binary())})
+
+        return task
+
+    return [make(f) for f in files]
+
+
+# ------------------------------------------------------------ write tasks
+
+
+def write_block(block: Block, path: str, index: int, fmt: str) -> str:
+    os.makedirs(path, exist_ok=True)
+    f = os.path.join(path, f"{index:06d}.{fmt}")
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+
+        pq.write_table(block, f)
+    elif fmt == "csv":
+        import pyarrow.csv as pacsv
+
+        pacsv.write_csv(block, f)
+    elif fmt == "json":
+        block.to_pandas().to_json(f, orient="records", lines=True)
+    else:
+        raise ValueError(f"unknown write format {fmt}")
+    return f

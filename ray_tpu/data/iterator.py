@@ -1,0 +1,253 @@
+"""Data iterators — the consumption boundary, including device ingest.
+
+Analog of `ray.data.DataIterator` (`python/ray/data/iterator.py`) and the
+stream-split iterator behind `streaming_split`
+(`python/ray/data/_internal/iterator/stream_split_iterator.py`). The TPU
+path is `iter_jax_batches`: numpy batches are pushed to device with
+`jax.device_put` one batch AHEAD of the consumer (double buffering), so
+host→HBM DMA for batch k+1 overlaps with the step computing on batch k —
+the framework-level replacement for plasma zero-copy into device memory.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import Block, batches_from_blocks, block_rows
+
+
+class DataIterator:
+    """Abstract: subclasses provide _block_iter()."""
+
+    def _block_iter(self) -> Iterator[Block]:
+        raise NotImplementedError
+
+    def iter_batches(
+        self,
+        *,
+        batch_size: Optional[int] = 256,
+        batch_format: str = "numpy",
+        drop_last: bool = False,
+        prefetch_batches: int = 1,
+        local_shuffle_buffer_size: Optional[int] = None,
+        local_shuffle_seed: Optional[int] = None,
+    ) -> Iterator[Any]:
+        blocks = self._block_iter()
+        if local_shuffle_buffer_size:
+            blocks = _shuffle_blocks(blocks, local_shuffle_buffer_size,
+                                     local_shuffle_seed)
+        batches = batches_from_blocks(blocks, batch_size, batch_format,
+                                      drop_last)
+        if prefetch_batches and prefetch_batches > 0:
+            batches = _prefetch(batches, prefetch_batches)
+        return batches
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for block in self._block_iter():
+            yield from block_rows(block)
+
+    def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
+                           dtypes=None, device: str = "cpu",
+                           **kw) -> Iterator[Dict[str, Any]]:
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy", **kw):
+            yield {
+                k: torch.as_tensor(
+                    v, dtype=(dtypes.get(k) if isinstance(dtypes, dict)
+                              else dtypes), device=device)
+                for k, v in batch.items()
+            }
+
+    def iter_jax_batches(
+        self,
+        *,
+        batch_size: Optional[int] = 256,
+        sharding=None,
+        prefetch: int = 2,
+        **kw,
+    ) -> Iterator[Dict[str, Any]]:
+        """Numpy batches → device arrays, `prefetch` batches ahead.
+
+        ``sharding`` may be a `jax.sharding.Sharding` (applied to every
+        array) or a dict column→Sharding. With a NamedSharding over a dp/sp
+        mesh this is the data-ingest edge of an SPMD step: each host puts
+        its shard, XLA assembles the global array.
+        """
+        import jax
+
+        def put(batch):
+            if sharding is None:
+                return jax.tree.map(jax.numpy.asarray, batch)
+            if isinstance(sharding, dict):
+                return {k: jax.device_put(v, sharding.get(k)) for k, v in
+                        batch.items()}
+            return {k: jax.device_put(v, sharding) for k, v in batch.items()}
+
+        host = self.iter_batches(batch_size=batch_size,
+                                 batch_format="numpy",
+                                 prefetch_batches=0, **kw)
+        window: List[Any] = []
+        for batch in host:
+            window.append(put(batch))  # async dispatch: returns immediately
+            if len(window) > max(1, prefetch):
+                yield window.pop(0)
+        yield from window
+
+    def materialize(self):
+        from ray_tpu.data.dataset import _input_dataset
+
+        return _input_dataset(list(self._block_iter())).materialize()
+
+
+def _prefetch(it: Iterator[Any], depth: int) -> Iterator[Any]:
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    _SENTINEL = object()
+    err: List[BaseException] = []
+    stop = threading.Event()
+
+    def _put(x) -> bool:
+        # bounded put that notices consumer abandonment — otherwise an
+        # early-exiting consumer (take_batch, break in a train loop) leaks
+        # this thread plus the upstream generator's in-flight window
+        while not stop.is_set():
+            try:
+                q.put(x, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def fill():
+        try:
+            for x in it:
+                if not _put(x):
+                    return
+        except BaseException as e:
+            err.append(e)
+        finally:
+            _put(_SENTINEL)
+
+    t = threading.Thread(target=fill, daemon=True)
+    t.start()
+    try:
+        while True:
+            x = q.get()
+            if x is _SENTINEL:
+                if err:
+                    raise err[0]
+                return
+            yield x
+    finally:
+        stop.set()
+
+
+def _shuffle_blocks(blocks: Iterator[Block], buffer_rows: int,
+                    seed: Optional[int]) -> Iterator[Block]:
+    """Windowed local shuffle (reference: local_shuffle_buffer_size)."""
+    from ray_tpu.data.block import concat_blocks, slice_block
+
+    rng = np.random.default_rng(seed)
+    buf: List[Block] = []
+    rows = 0
+    for b in blocks:
+        buf.append(b)
+        rows += b.num_rows
+        if rows >= buffer_rows:
+            merged = concat_blocks(buf)
+            merged = merged.take(rng.permutation(merged.num_rows))
+            emit = slice_block(merged, 0, merged.num_rows // 2)
+            keep = slice_block(merged, merged.num_rows // 2, merged.num_rows)
+            yield emit
+            buf, rows = [keep], keep.num_rows
+    if buf:
+        merged = concat_blocks(buf)
+        if merged.num_rows:
+            yield merged.take(rng.permutation(merged.num_rows))
+
+
+class _BlockStreamIterator(DataIterator):
+    """Iterates a Dataset's own streaming execution (driver-side)."""
+
+    def __init__(self, ds):
+        self._ds = ds
+
+    def _block_iter(self) -> Iterator[Block]:
+        for ref, _meta in self._ds._stream():
+            yield ray_tpu.get(ref)
+
+
+class _SplitCoordinator:
+    """Actor: runs ONE streaming execution, hands blocks to n consumers
+    first-come-first-served (reference: SplitCoordinator in
+    `stream_split_iterator.py`)."""
+
+    def __init__(self, ops, concurrency, n: int = 1, equal: bool = False):
+        from ray_tpu.data._internal.executor import execute_plan
+
+        self._gen = execute_plan(ops, concurrency)
+        self._done = False
+        self._equal = equal
+        self._n = n
+        # equal mode: blocks are dealt round-robin by arrival index so every
+        # consumer sees the same block count (±1) — lockstep SPMD loops with
+        # per-batch collectives need matching iteration counts.
+        self._buffers: Dict[int, List[Any]] = {i: [] for i in range(n)}
+        # Handed-out refs are pinned here until the consumer acks having
+        # read the block — returning a ref from an actor method drops the
+        # actor's local reference, and without the pin the owner could GC
+        # the block before the consumer's get lands.
+        self._pinned = {}
+        self._deal_idx = 0  # arrival index for equal-mode round-robin
+        self._next_token = 0
+
+    def next_block_ref(self, rank: int = 0):
+        ref = None
+        if self._equal:
+            buf = self._buffers[rank % self._n]
+            while not buf and not self._done:
+                try:
+                    r, _meta = next(self._gen)
+                    self._buffers[self._deal_idx % self._n].append(r)
+                    self._deal_idx += 1
+                except StopIteration:
+                    self._done = True
+            if buf:
+                ref = buf.pop(0)
+        else:
+            if not self._done:
+                try:
+                    ref, _meta = next(self._gen)
+                except StopIteration:
+                    self._done = True
+        if ref is None:
+            return None
+        token = self._next_token
+        self._next_token += 1
+        self._pinned[token] = ref
+        return token, ref
+
+    def release(self, token: int) -> None:
+        self._pinned.pop(token, None)
+
+
+class _StreamSplitIterator(DataIterator):
+    def __init__(self, coordinator, rank: int):
+        self._coord = coordinator
+        self._rank = rank
+
+    def _block_iter(self) -> Iterator[Block]:
+        while True:
+            out = ray_tpu.get(self._coord.next_block_ref.remote(self._rank))
+            if out is None:
+                return
+            token, ref = out
+            block = ray_tpu.get(ref)
+            self._coord.release.remote(token)  # fire-and-forget unpin
+            yield block
