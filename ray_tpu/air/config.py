@@ -93,6 +93,9 @@ class RunConfig:
     storage_path: Optional[str] = None
     failure_config: Optional[FailureConfig] = None
     checkpoint_config: Optional[CheckpointConfig] = None
+    #: tune stop criteria: {"metric": threshold} — a trial stops once any
+    #: reported metric reaches its threshold (reference RunConfig.stop)
+    stop: Optional[Dict[str, Any]] = None
     verbose: int = 1
     log_to_file: bool = False
     callbacks: Optional[List[Any]] = None

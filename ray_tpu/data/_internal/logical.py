@@ -102,7 +102,10 @@ def make_map_batches_transform(
 
 def make_map_rows_transform(fn: Callable) -> BlockTransform:
     def transform(block: Block) -> Block:
-        return batch_to_block([fn(row) for row in block.to_pylist()])
+        rows = [fn(row) for row in block.to_pylist()]
+        # empty input: keep an empty block rather than letting the list
+        # fallback invent an 'item' schema
+        return batch_to_block(rows) if rows else block.slice(0, 0)
 
     return transform
 

@@ -230,7 +230,9 @@ class Dataset:
         mat = self.materialize()
         src: L.InputData = mat._ops[0]
         cuts = even_cuts(len(src.block_refs), n)
-        n = len(cuts) - 1
+        # pad so exactly n datasets come back (gang consumers index by rank)
+        while len(cuts) - 1 < n:
+            cuts.append(cuts[-1])
         return [
             MaterializedDataset(
                 [L.InputData(block_refs=src.block_refs[cuts[i]:cuts[i + 1]],

@@ -191,6 +191,14 @@ class _SplitCoordinator:
     def __init__(self, ops, concurrency, n: int = 1, equal: bool = False):
         from ray_tpu.data._internal.executor import execute_plan
 
+        self._ops = ops
+        self._concurrency = concurrency
+        # generation = which pass over the dataset the current stream
+        # serves; consumers name the pass they want, and a restart happens
+        # only when EVERY rank has moved past the drained generation (so a
+        # late-starting rank's first pass still reads the original stream).
+        self._generation = 1
+        self._rank_epochs: Dict[int, int] = {}
         self._gen = execute_plan(ops, concurrency)
         self._done = False
         self._equal = equal
@@ -207,7 +215,24 @@ class _SplitCoordinator:
         self._deal_idx = 0  # arrival index for equal-mode round-robin
         self._next_token = 0
 
-    def next_block_ref(self, rank: int = 0):
+    def next_block_ref(self, rank: int = 0, epoch: int = 1):
+        # Re-iterable shards (reference: StreamSplitDataIterator re-executes
+        # per epoch): restart the execution for epoch e only once the
+        # current stream is drained and all n ranks have asked for >= e.
+        self._rank_epochs[rank % self._n] = epoch
+        if (epoch > self._generation and self._done
+                and not any(self._buffers.values())
+                and len(self._rank_epochs) == self._n
+                and all(e >= epoch for e in self._rank_epochs.values())):
+            from ray_tpu.data._internal.executor import execute_plan
+
+            self._generation = epoch
+            self._gen = execute_plan(self._ops, self._concurrency)
+            self._done = False
+        if epoch > self._generation:
+            # stream for this epoch not open yet (other ranks still on the
+            # previous pass) — caller polls again
+            return "PENDING"
         ref = None
         if self._equal:
             buf = self._buffers[rank % self._n]
@@ -241,12 +266,21 @@ class _StreamSplitIterator(DataIterator):
     def __init__(self, coordinator, rank: int):
         self._coord = coordinator
         self._rank = rank
+        self._epoch = 0
 
     def _block_iter(self) -> Iterator[Block]:
+        import time as _time
+
+        self._epoch += 1
+        epoch = self._epoch
         while True:
-            out = ray_tpu.get(self._coord.next_block_ref.remote(self._rank))
+            out = ray_tpu.get(
+                self._coord.next_block_ref.remote(self._rank, epoch))
             if out is None:
                 return
+            if out == "PENDING":
+                _time.sleep(0.02)
+                continue
             token, ref = out
             block = ray_tpu.get(ref)
             self._coord.release.remote(token)  # fire-and-forget unpin

@@ -44,6 +44,7 @@ class Result:
     checkpoint: Optional[Checkpoint]
     path: str
     error: Optional[Exception] = None
+    config: Optional[Dict[str, Any]] = None  # set by tune trials
     metrics_history: List[Dict[str, Any]] = dataclasses.field(
         default_factory=list)
     best_checkpoints: List[Tuple[Checkpoint, Dict[str, Any]]] = (

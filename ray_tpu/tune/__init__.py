@@ -1,0 +1,34 @@
+"""ray_tpu.tune — distributed hyperparameter search (Ray Tune analog,
+`python/ray/tune/`). `tune.report` is the same session report used by
+train (the reference unified them the same way)."""
+
+from ray_tpu.train._internal.session import (  # noqa: F401
+    get_checkpoint,
+    get_context,
+    report,
+)
+from ray_tpu.tune.schedulers import (  # noqa: F401
+    ASHAScheduler,
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import (  # noqa: F401
+    BasicVariantGenerator,
+    choice,
+    grid_search,
+    loguniform,
+    quniform,
+    randint,
+    randn,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.tuner import (  # noqa: F401
+    ResultGrid,
+    TuneConfig,
+    Tuner,
+    with_resources,
+)

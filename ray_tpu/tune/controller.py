@@ -1,0 +1,255 @@
+"""The trial-driving event loop.
+
+Analog of `ray.tune.execution.tune_controller.TuneController`
+(`python/ray/tune/execution/tune_controller.py:68`, step `:666`,
+_schedule_trial_actor `:964`): trials run as single-worker actor gangs
+(WorkerGroup under a placement group); the controller pumps one
+outstanding next_report per running trial through `ray_tpu.wait`, feeds
+the scheduler, executes early stops / PBT exploits as actor restarts, and
+persists experiment state after every transition for Tuner.restore.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import logging
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.air.config import RunConfig
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train._internal.session import TrainingReport
+from ray_tpu.train._internal.storage import StorageContext
+from ray_tpu.train._internal.worker_group import WorkerGroup
+from ray_tpu.tune import schedulers as sched_mod
+from ray_tpu.tune.trial import (ERROR, PENDING, RUNNING, TERMINATED, Trial)
+
+logger = logging.getLogger(__name__)
+
+
+class _RunningTrial:
+    def __init__(self, trial: Trial, group: WorkerGroup):
+        self.trial = trial
+        self.group = group
+        self.pending_ref = None
+
+    @property
+    def actor(self):
+        return self.group.workers[0].actor
+
+    def arm(self):
+        self.pending_ref = self.actor.next_report.remote(None)
+
+    def shutdown(self):
+        try:
+            self.actor.end_session.remote()
+        except Exception:
+            pass
+        self.group.shutdown()
+
+
+class TuneController:
+    def __init__(
+        self,
+        trainable_fn: Callable[[Dict[str, Any]], Any],
+        trials: List[Trial],
+        run_config: RunConfig,
+        scheduler: Optional[sched_mod.TrialScheduler] = None,
+        metric: Optional[str] = None,
+        mode: str = "max",
+        max_concurrent_trials: Optional[int] = None,
+        stop: Optional[Dict[str, Any]] = None,
+    ):
+        self._fn = trainable_fn
+        self.trials = trials
+        self._run_config = run_config
+        self._scheduler = scheduler or sched_mod.FIFOScheduler()
+        self._scheduler.set_objective(metric or "_none_", mode)
+        self._max_concurrent = max_concurrent_trials or 8
+        self._stop_criteria = stop or {}
+        self._experiment_name = run_config.name
+        self._running: Dict[str, _RunningTrial] = {}
+        self._max_failures = (run_config.failure_config.max_failures
+                              if run_config.failure_config else 0)
+        self._metric = metric
+        self._mode = mode
+        self._last_save = 0.0
+
+    # ---------------------------------------------------------------- state
+
+    @property
+    def experiment_path(self) -> str:
+        return os.path.join(self._run_config.storage_path,
+                            self._experiment_name)
+
+    def save_state(self, force: bool = True) -> None:
+        """Persist experiment state; non-forced saves (per-report) are
+        throttled — rewriting every trial's full history on every report
+        would be O(reports²) I/O (reference throttles with
+        checkpoint_period)."""
+        now = time.monotonic()
+        if not force and now - self._last_save < 5.0:
+            return
+        self._last_save = now
+        os.makedirs(self.experiment_path, exist_ok=True)
+        state = {"trials": [t.to_json() for t in self.trials],
+                 "metric": self._metric, "mode": self._mode}
+        tmp = os.path.join(self.experiment_path, ".tuner_state.tmp")
+        with open(tmp, "w") as f:
+            json.dump(state, f, default=str)
+        os.replace(tmp, os.path.join(self.experiment_path, "tuner_state.json"))
+
+    # ----------------------------------------------------------------- run
+
+    def run(self) -> List[Trial]:
+        try:
+            while True:
+                self._start_pending()
+                if not self._running:
+                    if all(t.is_finished() for t in self.trials):
+                        break
+                    if not any(t.status == PENDING for t in self.trials):
+                        break
+                    continue
+                self._poll_once()
+        finally:
+            for rt in list(self._running.values()):
+                rt.shutdown()
+            self._running.clear()
+            self.save_state()
+        return self.trials
+
+    def _start_pending(self) -> None:
+        for trial in self.trials:
+            if len(self._running) >= self._max_concurrent:
+                return
+            if trial.status == PENDING:
+                self._start_trial(trial)
+
+    def _start_trial(self, trial: Trial,
+                     checkpoint: Optional[Checkpoint] = None) -> None:
+        group = WorkerGroup(num_workers=1,
+                            resources_per_worker=trial.resources)
+        group.start()
+        storage = StorageContext(self._run_config.storage_path,
+                                 self._experiment_name,
+                                 trial_dir_name=f"trial_{trial.trial_id}")
+        storage.current_checkpoint_index = trial.checkpoint_index
+        storage.make_dirs()
+        ckpt = checkpoint or trial.latest_checkpoint
+        kwargs = dict(
+            train_fn=functools.partial(self._fn, trial.config),
+            world_rank=0, local_rank=0, world_size=1, local_world_size=1,
+            node_rank=0, storage=storage,
+            experiment_name=self._experiment_name,
+            trial_name=f"trial_{trial.trial_id}",
+            loaded_checkpoint=ckpt,
+            trial_info={"trial_id": trial.trial_id, "config": trial.config},
+        )
+        rt = _RunningTrial(trial, group)
+        try:
+            ray_tpu.get(rt.actor.start_session.remote(kwargs))
+        except Exception as e:
+            group.shutdown()
+            trial.status = ERROR
+            trial.error = f"failed to start: {e}"
+            return
+        trial.status = RUNNING
+        rt.arm()
+        self._running[trial.trial_id] = rt
+        self.save_state()
+
+    def _poll_once(self) -> None:
+        refs = {rt.pending_ref: rt for rt in self._running.values()}
+        ready, _ = ray_tpu.wait(list(refs.keys()), num_returns=1,
+                                timeout=5.0)
+        for ref in ready:
+            rt = refs[ref]
+            try:
+                report: TrainingReport = ray_tpu.get(ref)
+            except Exception as e:
+                self._on_trial_failed(rt, f"actor died: {e}")
+                continue
+            if report.kind == "error":
+                self._on_trial_failed(rt, report.error)
+            elif report.kind == "done":
+                self._finish_trial(rt, TERMINATED)
+            else:
+                self._on_result(rt, report)
+
+    # -------------------------------------------------------------- events
+
+    def _on_result(self, rt: _RunningTrial, report: TrainingReport) -> None:
+        trial = rt.trial
+        trial.iteration += 1
+        trial.checkpoint_index += 1
+        result = dict(report.metrics or {})
+        result.setdefault("training_iteration", trial.iteration)
+        result["trial_id"] = trial.trial_id
+        trial.last_result = result
+        trial.metrics_history.append(result)
+        if report.checkpoint_path:
+            trial.latest_checkpoint_path = report.checkpoint_path
+        decision = self._scheduler.on_trial_result(trial, result)
+        if self._should_stop(result):
+            decision = sched_mod.STOP
+        exploit = None
+        if isinstance(self._scheduler, sched_mod.PopulationBasedTraining):
+            exploit = self._scheduler.pending_exploits.pop(
+                trial.trial_id, None)
+        if exploit is not None:
+            self._exploit(rt, exploit)
+        elif decision == sched_mod.STOP:
+            self._finish_trial(rt, TERMINATED)
+        else:
+            rt.arm()
+        self.save_state(force=False)
+
+    def _exploit(self, rt: _RunningTrial, exploit) -> None:
+        """PBT: restart this trial from the source trial's checkpoint with
+        the mutated config."""
+        trial = rt.trial
+        src = next((t for t in self.trials
+                    if t.trial_id == exploit.source_trial_id), None)
+        src_ckpt = src.latest_checkpoint if src else None
+        logger.info("PBT exploit: trial %s <- %s, config %s",
+                    trial.trial_id, exploit.source_trial_id,
+                    exploit.new_config)
+        rt.shutdown()
+        self._running.pop(trial.trial_id, None)
+        trial.config = exploit.new_config
+        trial.status = PENDING
+        self._start_trial(trial, checkpoint=src_ckpt)
+
+    def _should_stop(self, result: Dict[str, Any]) -> bool:
+        for k, v in self._stop_criteria.items():
+            if k in result and result[k] >= v:
+                return True
+        return False
+
+    def _finish_trial(self, rt: _RunningTrial, status: str,
+                      error: Optional[str] = None) -> None:
+        rt.trial.status = status
+        rt.trial.error = error
+        self._scheduler.on_trial_complete(rt.trial, rt.trial.last_result)
+        rt.shutdown()
+        self._running.pop(rt.trial.trial_id, None)
+        self.save_state()
+
+    def _on_trial_failed(self, rt: _RunningTrial, error: str) -> None:
+        trial = rt.trial
+        trial.num_failures += 1
+        logger.warning("trial %s failed (%d): %s", trial.trial_id,
+                       trial.num_failures, error)
+        rt.shutdown()
+        self._running.pop(trial.trial_id, None)
+        if self._max_failures < 0 or trial.num_failures <= self._max_failures:
+            trial.status = PENDING  # restart from its latest checkpoint
+        else:
+            trial.status = ERROR
+            trial.error = error
+            self._scheduler.on_trial_complete(trial, trial.last_result)
+        self.save_state()

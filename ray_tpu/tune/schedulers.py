@@ -1,0 +1,198 @@
+"""Trial schedulers.
+
+Analog of `ray.tune.schedulers` — FIFO, ASHA
+(`python/ray/tune/schedulers/async_hyperband.py`), median stopping
+(`median_stopping_rule.py`), PBT (`pbt.py`). Schedulers see every report
+and decide CONTINUE / STOP; PBT additionally requests exploit-and-explore
+(clone a top trial's checkpoint with mutated hyperparams), executed by the
+controller as an actor restart.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def set_objective(self, metric: str, mode: str) -> None:
+        self._metric = metric
+        self._mode = mode
+
+    def _score(self, result: Dict[str, Any]) -> Optional[float]:
+        v = result.get(self._metric)
+        if v is None:
+            return None
+        return float(v) if self._mode == "max" else -float(v)
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial, result: Optional[Dict]) -> None:
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA: rungs at grace_period·rf^k; at each rung a trial continues only
+    if its metric is in the top 1/rf of scores recorded at that rung."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 grace_period: int = 1, reduction_factor: int = 4,
+                 max_t: int = 100):
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        self.max_t = max_t
+        # rung milestone -> {trial_id: score recorded when it got there}
+        self._rungs: Dict[int, Dict[str, float]] = {}
+        milestones = []
+        t = grace_period
+        while t < max_t:
+            milestones.append(t)
+            t *= reduction_factor
+        self._milestones = milestones
+
+    def on_trial_result(self, trial, result) -> str:
+        t = result.get(self.time_attr, 0)
+        score = self._score(result)
+        if score is None:
+            return CONTINUE
+        passed = [m for m in self._milestones if m <= t]
+        if not passed:
+            return CONTINUE
+        top = passed[-1]
+        rung = self._rungs.setdefault(top, {})
+        rung.setdefault(trial.trial_id, score)
+        # Re-evaluate the trial's standing at its top rung on EVERY report:
+        # with near-lockstep trials the rung is part-filled when a trial
+        # first arrives, so a one-shot check at the milestone would let
+        # early-arriving weak trials through.
+        if len(rung) >= self.rf:
+            cutoff = float(np.percentile(
+                list(rung.values()), 100 * (1 - 1.0 / self.rf)))
+            if rung[trial.trial_id] < cutoff:
+                return STOP
+        if t >= self.max_t:
+            return STOP
+        return CONTINUE
+
+
+ASHAScheduler = AsyncHyperBandScheduler
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose running-average score falls below the median of
+    other trials' averages at the same step."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self._scores: Dict[str, List[float]] = {}
+
+    def on_trial_result(self, trial, result) -> str:
+        score = self._score(result)
+        t = result.get(self.time_attr, 0)
+        if score is None:
+            return CONTINUE
+        self._scores.setdefault(trial.trial_id, []).append(score)
+        if t <= self.grace_period:
+            return CONTINUE
+        means = [np.mean(v) for k, v in self._scores.items()
+                 if k != trial.trial_id and v]
+        if len(means) < self.min_samples:
+            return CONTINUE
+        my_mean = np.mean(self._scores[trial.trial_id])
+        if my_mean < np.median(means):
+            return STOP
+        return CONTINUE
+
+
+@dataclasses.dataclass
+class _Exploit:
+    source_trial_id: str
+    new_config: Dict[str, Any]
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (`python/ray/tune/schedulers/pbt.py:PopulationBasedTraining`):
+    every `perturbation_interval` iterations, bottom-quantile trials copy a
+    top-quantile trial's checkpoint and mutate hyperparams (×0.8/×1.2 or
+    resample)."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 perturbation_interval: int = 5,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: Optional[int] = None):
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_p = resample_probability
+        self._rng = np.random.default_rng(seed)
+        self._latest: Dict[str, float] = {}
+        self._configs: Dict[str, Dict[str, Any]] = {}
+        self.pending_exploits: Dict[str, _Exploit] = {}
+
+    def on_trial_result(self, trial, result) -> str:
+        score = self._score(result)
+        if score is not None:
+            self._latest[trial.trial_id] = score
+        self._configs[trial.trial_id] = dict(trial.config)
+        t = result.get(self.time_attr, 0)
+        if t == 0 or t % self.interval != 0 or len(self._latest) < 2:
+            return CONTINUE
+        scores = sorted(self._latest.items(), key=lambda kv: kv[1])
+        k = max(1, int(len(scores) * self.quantile))
+        bottom = {tid for tid, _ in scores[:k]}
+        top = [tid for tid, _ in scores[-k:]]
+        if trial.trial_id in bottom:
+            src = top[int(self._rng.integers(0, len(top)))]
+            if src != trial.trial_id:
+                # explore = perturb the SOURCE's hyperparams (the cloned
+                # weights were trained under them), not this trial's own —
+                # otherwise good hyperparams never propagate.
+                src_config = self._configs.get(src, trial.config)
+                self.pending_exploits[trial.trial_id] = _Exploit(
+                    source_trial_id=src,
+                    new_config=self._mutate(src_config))
+        return CONTINUE
+
+    def _mutate(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        from ray_tpu.tune.search import Domain
+
+        new = dict(config)
+        for key, spec in self.mutations.items():
+            if key not in new:
+                continue
+            if self._rng.random() < self.resample_p or not isinstance(
+                    new[key], (int, float)):
+                if isinstance(spec, Domain):
+                    new[key] = spec.sample(self._rng)
+                elif isinstance(spec, list):
+                    new[key] = spec[int(self._rng.integers(0, len(spec)))]
+                elif callable(spec):
+                    new[key] = spec()
+            else:
+                factor = 1.2 if self._rng.random() < 0.5 else 0.8
+                new[key] = type(new[key])(new[key] * factor)
+        return new
+
+    def on_trial_complete(self, trial, result) -> None:
+        self._latest.pop(trial.trial_id, None)
+        self.pending_exploits.pop(trial.trial_id, None)

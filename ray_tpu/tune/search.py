@@ -1,0 +1,167 @@
+"""Search spaces + variant generation.
+
+Analog of `ray.tune.search` (`python/ray/tune/search/variant_generator.py`,
+sample domains `python/ray/tune/search/sample.py`, basic variant generator
+`python/ray/tune/search/basic_variant.py`): grid_search entries form a
+cross product; Domain entries are sampled per variant; `num_samples`
+repeats the whole expansion.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+class Domain:
+    def sample(self, rng: np.random.Generator) -> Any:
+        raise NotImplementedError
+
+
+class Uniform(Domain):
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return float(rng.uniform(self.low, self.high))
+
+
+class QUniform(Domain):
+    def __init__(self, low, high, q):
+        self.low, self.high, self.q = low, high, q
+
+    def sample(self, rng):
+        v = rng.uniform(self.low, self.high)
+        return float(np.round(v / self.q) * self.q)
+
+
+class LogUniform(Domain):
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return float(np.exp(rng.uniform(np.log(self.low),
+                                        np.log(self.high))))
+
+
+class RandInt(Domain):
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return int(rng.integers(self.low, self.high))
+
+
+class Choice(Domain):
+    def __init__(self, categories):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return self.categories[int(rng.integers(0, len(self.categories)))]
+
+
+class Normal(Domain):
+    def __init__(self, mean, sd):
+        self.mean, self.sd = mean, sd
+
+    def sample(self, rng):
+        return float(rng.normal(self.mean, self.sd))
+
+
+class SampleFrom(Domain):
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def sample(self, rng):
+        return self.fn(None)
+
+
+class GridSearch:
+    def __init__(self, values):
+        self.values = list(values)
+
+
+# public constructors (tune.uniform etc., `python/ray/tune/search/sample.py`)
+def uniform(low, high):
+    return Uniform(low, high)
+
+
+def quniform(low, high, q):
+    return QUniform(low, high, q)
+
+
+def loguniform(low, high):
+    return LogUniform(low, high)
+
+
+def randint(low, high):
+    return RandInt(low, high)
+
+
+def choice(categories):
+    return Choice(categories)
+
+
+def randn(mean=0.0, sd=1.0):
+    return Normal(mean, sd)
+
+
+def sample_from(fn):
+    return SampleFrom(fn)
+
+
+def grid_search(values):
+    return GridSearch(values)
+
+
+# --------------------------------------------------------------- expansion
+
+
+def _walk(space: Any, path=()):
+    """Yield (path, spec) for every GridSearch/Domain leaf."""
+    if isinstance(space, dict):
+        for k, v in space.items():
+            yield from _walk(v, path + (k,))
+    elif isinstance(space, (GridSearch, Domain)):
+        yield path, space
+
+
+def _set(cfg: Dict, path, value):
+    d = cfg
+    for k in path[:-1]:
+        d = d[k]
+    d[path[-1]] = value
+
+
+def _deepcopy_plain(space):
+    if isinstance(space, dict):
+        return {k: _deepcopy_plain(v) for k, v in space.items()}
+    return space
+
+
+class BasicVariantGenerator:
+    """Grid cross-product × random samples
+    (`python/ray/tune/search/basic_variant.py`)."""
+
+    def __init__(self, seed: Optional[int] = None):
+        self._rng = np.random.default_rng(seed)
+
+    def generate(self, param_space: Dict[str, Any],
+                 num_samples: int = 1) -> List[Dict[str, Any]]:
+        leaves = list(_walk(param_space))
+        grid_leaves = [(p, s) for p, s in leaves if isinstance(s, GridSearch)]
+        domain_leaves = [(p, s) for p, s in leaves if isinstance(s, Domain)]
+        grid_axes = [s.values for _, s in grid_leaves] or [[None]]
+        variants = []
+        for _ in range(num_samples):
+            for combo in itertools.product(*grid_axes):
+                cfg = _deepcopy_plain(param_space)
+                if grid_leaves:
+                    for (path, _), v in zip(grid_leaves, combo):
+                        _set(cfg, path, v)
+                for path, dom in domain_leaves:
+                    _set(cfg, path, dom.sample(self._rng))
+                variants.append(cfg)
+        return variants

@@ -221,6 +221,20 @@ class TestSplits:
         parts = rd.range(100, parallelism=4).split(2)
         assert sum(p.count() for p in parts) == 100
 
+    def test_split_pads_to_n(self, ray_init):
+        parts = rd.range(100, parallelism=2).split(4)
+        assert len(parts) == 4
+        assert sum(p.count() for p in parts) == 100
+
+    def test_streaming_split_multi_epoch(self, ray_init):
+        shards = rd.range(20, parallelism=4).streaming_split(1)
+        for _epoch in range(2):
+            seen = []
+            for b in shards[0].iter_batches(batch_size=None,
+                                            prefetch_batches=0):
+                seen.extend(b["id"].tolist())
+            assert sorted(seen) == list(range(20))
+
     def test_streaming_split(self, ray_init):
         shards = rd.range(100, parallelism=10).streaming_split(2)
         seen = []
