@@ -76,20 +76,27 @@ class ObjectRef:
     def __reduce__(self):
         return (_deserialize_ref, (self._object_id.binary(), self._owner_addr))
 
+    def __await__(self):
+        """Awaitable inside async actors / asyncio code (ray parity:
+        ObjectRefs are awaitable)."""
+        import asyncio
+
+        return asyncio.wrap_future(self.future()).__await__()
+
     def future(self):
-        """concurrent.futures.Future resolving to the value."""
-        import concurrent.futures
+        """concurrent.futures.Future resolving to the value.
 
-        fut: concurrent.futures.Future = concurrent.futures.Future()
+        Driven by the core worker's own event loop (no per-ref helper
+        thread: N awaited refs cost zero extra threads, and cancelling the
+        future cancels the underlying coroutine instead of stranding a
+        blocked thread)."""
+        import asyncio
 
-        def run():
-            try:
-                fut.set_result(get(self))
-            except Exception as e:  # noqa: BLE001
-                fut.set_exception(e)
-
-        threading.Thread(target=run, daemon=True).start()
-        return fut
+        core = _require_core()
+        return asyncio.run_coroutine_threadsafe(
+            core._async_get_one(self._object_id, self._owner_addr, None),
+            core.loop,
+        )
 
 
 def _deserialize_ref(raw: bytes, owner) -> ObjectRef:

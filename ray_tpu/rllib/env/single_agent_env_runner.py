@@ -1,0 +1,178 @@
+"""EnvRunner — sampling actors.
+
+Analog of `rllib/env/single_agent_env_runner.py` + `env_runner_group.py`:
+each runner holds a gymnasium vector env and the current module weights;
+`sample(num_steps)` steps all sub-envs with jitted batched inference and
+returns a columnar rollout batch plus finished-episode returns. Weights
+arrive by broadcast from the learner group each iteration (reference:
+weights broadcast after update, `algorithm.py` training_step pattern).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.core.rl_module import RLModule, RLModuleSpec
+
+
+class SingleAgentEnvRunner:
+    def __init__(self, env_name: str, spec: RLModuleSpec,
+                 num_envs: int = 4, seed: int = 0,
+                 explore: bool = True,
+                 env_config: Optional[Dict[str, Any]] = None):
+        import gymnasium as gym
+        import jax
+
+        self._spec = spec
+        self.module = RLModule(spec)
+        kwargs = env_config or {}
+        self.envs = gym.vector.SyncVectorEnv(
+            [lambda: gym.make(env_name, **kwargs)
+             for _ in range(num_envs)])
+        self.num_envs = num_envs
+        self._obs, _ = self.envs.reset(seed=seed)
+        self._key = jax.random.PRNGKey(seed)
+        self.params = self.module.init_params(jax.random.PRNGKey(seed))
+        self._explore_fn = jax.jit(self.module.forward_exploration)
+        self._episode_returns = np.zeros(num_envs)
+        self._episode_lens = np.zeros(num_envs, dtype=np.int64)
+        self._finished_returns: List[float] = []
+        self._finished_lens: List[int] = []
+        self._explore = explore
+
+    def set_weights(self, weights) -> bool:
+        import jax
+        import jax.numpy as jnp
+
+        self.params = jax.tree.map(jnp.asarray, weights)
+        return True
+
+    def sample(self, num_steps: int,
+               epsilon: Optional[float] = None) -> Dict[str, np.ndarray]:
+        """Collect `num_steps` per sub-env. Returns a columnar batch with
+        shape [T, B, ...] flattened to [T*B, ...] in time-major order so
+        GAE can be computed per column downstream."""
+        import jax
+
+        T, B = num_steps, self.num_envs
+        obs_buf = np.empty((T, B, self._spec.obs_dim), np.float32)
+        act_buf = np.empty((T, B), np.int64)
+        logp_buf = np.empty((T, B), np.float32)
+        val_buf = np.empty((T, B), np.float32)
+        rew_buf = np.empty((T, B), np.float32)
+        term_buf = np.empty((T, B), np.bool_)
+        trunc_buf = np.empty((T, B), np.bool_)
+        next_obs_buf = np.empty((T, B, self._spec.obs_dim), np.float32)
+
+        for t in range(T):
+            self._key, sub = jax.random.split(self._key)
+            action, logp, value = self._explore_fn(
+                self.params, self._obs.astype(np.float32), sub)
+            action = np.asarray(action)
+            if epsilon is not None and epsilon > 0:
+                rand_mask = np.random.random(B) < epsilon
+                rand_actions = np.random.randint(
+                    0, self._spec.num_actions, B)
+                action = np.where(rand_mask, rand_actions, action)
+            next_obs, reward, term, trunc, _info = self.envs.step(action)
+            obs_buf[t] = self._obs
+            act_buf[t] = action
+            logp_buf[t] = np.asarray(logp)
+            val_buf[t] = np.asarray(value)
+            rew_buf[t] = reward
+            term_buf[t] = term
+            trunc_buf[t] = trunc
+            next_obs_buf[t] = next_obs
+            self._episode_returns += reward
+            self._episode_lens += 1
+            done = term | trunc
+            for i in np.nonzero(done)[0]:
+                self._finished_returns.append(float(
+                    self._episode_returns[i]))
+                self._finished_lens.append(int(self._episode_lens[i]))
+                self._episode_returns[i] = 0.0
+                self._episode_lens[i] = 0
+            self._obs = next_obs
+
+        # bootstrap value for the final observation of every column
+        import jax.numpy as jnp
+
+        _, last_val = self.module.forward_train(
+            self.params, jnp.asarray(self._obs, jnp.float32))
+        return {
+            "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
+            "values": val_buf, "rewards": rew_buf,
+            "terminateds": term_buf, "truncateds": trunc_buf,
+            "next_obs": next_obs_buf,
+            "bootstrap_value": np.asarray(last_val),
+        }
+
+    def get_metrics(self) -> Dict[str, Any]:
+        out = {
+            "episode_return_mean": (float(np.mean(self._finished_returns))
+                                    if self._finished_returns else None),
+            "episode_len_mean": (float(np.mean(self._finished_lens))
+                                 if self._finished_lens else None),
+            "num_episodes": len(self._finished_returns),
+        }
+        self._finished_returns = []
+        self._finished_lens = []
+        return out
+
+    def stop(self) -> None:
+        self.envs.close()
+
+
+class EnvRunnerGroup:
+    """Fan-out over runner actors (`rllib/env/env_runner_group.py`)."""
+
+    def __init__(self, env_name: str, spec: RLModuleSpec,
+                 num_env_runners: int = 0, num_envs_per_runner: int = 4,
+                 seed: int = 0,
+                 env_config: Optional[Dict[str, Any]] = None):
+        self._local: Optional[SingleAgentEnvRunner] = None
+        self._actors: List[Any] = []
+        if num_env_runners <= 0:
+            self._local = SingleAgentEnvRunner(
+                env_name, spec, num_envs_per_runner, seed,
+                env_config=env_config)
+        else:
+            cls = ray_tpu.remote(SingleAgentEnvRunner)
+            self._actors = [
+                cls.options(num_cpus=1).remote(
+                    env_name, spec, num_envs_per_runner, seed + 1000 * i,
+                    env_config=env_config)
+                for i in range(num_env_runners)
+            ]
+
+    def set_weights(self, weights) -> None:
+        if self._local is not None:
+            self._local.set_weights(weights)
+        else:
+            ray_tpu.get([a.set_weights.remote(weights)
+                         for a in self._actors])
+
+    def sample(self, num_steps: int,
+               epsilon: Optional[float] = None
+               ) -> List[Dict[str, np.ndarray]]:
+        if self._local is not None:
+            return [self._local.sample(num_steps, epsilon)]
+        return ray_tpu.get([a.sample.remote(num_steps, epsilon)
+                            for a in self._actors])
+
+    def get_metrics(self) -> List[Dict[str, Any]]:
+        if self._local is not None:
+            return [self._local.get_metrics()]
+        return ray_tpu.get([a.get_metrics.remote() for a in self._actors])
+
+    def stop(self) -> None:
+        if self._local is not None:
+            self._local.stop()
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass

@@ -1,0 +1,222 @@
+"""Serve controller — the reconciling control plane.
+
+Analog of `ray.serve._private.controller.ServeController`
+(`python/ray/serve/_private/controller.py:86`, deploy_application `:719`)
++ `DeploymentStateManager` (`deployment_state.py:2309`) + the autoscaling
+loop (`autoscaling_state.py`): a detached async actor that drives target
+replica counts to spec, health-checks replicas, replaces dead ones, and
+autoscales on in-flight request counts.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+logger = logging.getLogger(__name__)
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+
+class _DeploymentState:
+    def __init__(self, app_name: str, spec: Dict[str, Any]):
+        self.app_name = app_name
+        self.spec = spec
+        self.replicas: List[Any] = []  # actor handles
+        self.version = 0
+        self.target = spec["num_replicas"]
+        self.status = "UPDATING"
+        self.deleted = False
+        # serializes scale operations: delete (scale→0) racing the
+        # reconcile loop (scale→target) would otherwise livelock,
+        # alternately killing and recreating the same replica
+        self.lock = asyncio.Lock()
+
+    @property
+    def name(self) -> str:
+        return self.spec["name"]
+
+
+class ServeController:
+    """Async actor; all methods run on one asyncio loop (max_concurrency
+    set high by the deployer) so state mutations are single-threaded."""
+
+    def __init__(self):
+        self._apps: Dict[str, Dict[str, _DeploymentState]] = {}
+        self._routes: Dict[str, str] = {}  # route_prefix -> "app/ingress"
+        self._shutdown = False
+        self._loop_task = None
+
+    async def _ensure_loop(self):
+        if self._loop_task is None:
+            self._loop_task = asyncio.ensure_future(self._reconcile_loop())
+
+    # ------------------------------------------------------------ deploy
+
+    async def deploy_application(self, app_name: str,
+                                 deployment_specs: List[Dict[str, Any]],
+                                 route_prefix: Optional[str],
+                                 ingress_name: str) -> None:
+        await self._ensure_loop()
+        app = self._apps.setdefault(app_name, {})
+        new_names = {s["name"] for s in deployment_specs}
+        # remove deployments dropped from the app
+        for name in list(app):
+            if name not in new_names:
+                app[name].deleted = True
+                await self._scale_to(app[name], 0)
+                del app[name]
+        for spec in deployment_specs:
+            if name_state := app.get(spec["name"]):
+                name_state.spec = spec
+                name_state.target = spec["num_replicas"]
+                name_state.version += 1
+            else:
+                app[spec["name"]] = _DeploymentState(app_name, spec)
+        if route_prefix:
+            self._routes[route_prefix] = f"{app_name}/{ingress_name}"
+        await self._reconcile_once()
+
+    async def delete_application(self, app_name: str) -> None:
+        app = self._apps.pop(app_name, None)
+        if app:
+            for st in app.values():
+                st.deleted = True
+                await self._scale_to(st, 0)
+        self._routes = {r: t for r, t in self._routes.items()
+                        if not t.startswith(app_name + "/")}
+
+    # --------------------------------------------------------- reconcile
+
+    async def _reconcile_loop(self):
+        while not self._shutdown:
+            try:
+                await self._reconcile_once()
+                await self._autoscale()
+            except Exception:
+                logger.exception("reconcile error")
+            await asyncio.sleep(0.5)
+
+    async def _reconcile_once(self):
+        for app in list(self._apps.values()):
+            for st in list(app.values()):
+                if st.deleted:
+                    continue
+                await self._health_sweep(st)
+                await self._scale_to(st, st.target)
+                st.status = "RUNNING" if len(st.replicas) == st.target \
+                    else "UPDATING"
+
+    async def _health_sweep(self, st: _DeploymentState):
+        # Probe a snapshot, then REMOVE the dead under the lock. Never
+        # assign the snapshot back: a concurrent scale-down could have
+        # popped a replica mid-probe, and re-assigning would resurrect it.
+        snapshot = list(st.replicas)
+        dead = []
+        for r in snapshot:
+            try:
+                ok = await asyncio.wait_for(
+                    r.check_health.remote(), timeout=5)
+                if not ok:
+                    dead.append(r)
+            except Exception:
+                logger.warning("replica of %s failed health check; replacing",
+                               st.name)
+                dead.append(r)
+        if dead:
+            async with st.lock:
+                before = len(st.replicas)
+                st.replicas = [r for r in st.replicas if r not in dead]
+                if len(st.replicas) != before:
+                    st.version += 1
+
+    async def _scale_to(self, st: _DeploymentState, n: int):
+        from ray_tpu.serve._private.replica import ReplicaActor
+
+        async with st.lock:
+            await self._scale_to_locked(st, n, ReplicaActor)
+
+    async def _scale_to_locked(self, st, n, ReplicaActor):
+        while len(st.replicas) > n:
+            r = st.replicas.pop()
+            st.version += 1
+            try:
+                await r.prepare_for_shutdown.remote()
+            except Exception:
+                pass
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        spec = st.spec
+        while len(st.replicas) < n:
+            actor_opts = dict(spec.get("ray_actor_options") or {})
+            actor_opts.setdefault("num_cpus", 0.1)
+            handle = ray_tpu.remote(ReplicaActor).options(
+                max_concurrency=spec.get("max_ongoing_requests", 8),
+                **actor_opts,
+            ).remote(st.app_name, st.name, spec["callable_factory"],
+                     spec.get("init_args", ()), spec.get("init_kwargs", {}))
+            if spec.get("user_config") is not None:
+                await handle.reconfigure.remote(spec["user_config"])
+            st.replicas.append(handle)
+            st.version += 1
+
+    async def _autoscale(self):
+        for app in self._apps.values():
+            for st in app.values():
+                cfg = st.spec.get("autoscaling_config")
+                if not cfg:
+                    continue
+                stats = []
+                for r in st.replicas:
+                    try:
+                        stats.append(await asyncio.wait_for(
+                            r.stats.remote(), timeout=5))
+                    except Exception:
+                        pass
+                if not stats:
+                    continue
+                total_ongoing = sum(s["ongoing"] for s in stats)
+                target_per = cfg.get("target_ongoing_requests", 2)
+                desired = max(
+                    cfg.get("min_replicas", 1),
+                    min(cfg.get("max_replicas", 1),
+                        -(-total_ongoing // target_per) or
+                        cfg.get("min_replicas", 1)))
+                if desired != st.target:
+                    logger.info("autoscale %s: %d -> %d (ongoing=%d)",
+                                st.name, st.target, desired, total_ongoing)
+                    st.target = desired
+
+    # ------------------------------------------------------------- query
+
+    async def get_replicas(self, app_name: str, deployment_name: str):
+        st = self._apps.get(app_name, {}).get(deployment_name)
+        if st is None:
+            return {"version": -1, "replicas": []}
+        return {"version": st.version, "replicas": list(st.replicas),
+                "max_ongoing": st.spec.get("max_ongoing_requests", 8)}
+
+    async def get_routes(self) -> Dict[str, str]:
+        return dict(self._routes)
+
+    async def status(self) -> Dict[str, Any]:
+        out = {}
+        for app_name, app in self._apps.items():
+            out[app_name] = {
+                name: {"status": st.status, "replicas": len(st.replicas),
+                       "target": st.target, "version": st.version}
+                for name, st in app.items()
+            }
+        return out
+
+    async def graceful_shutdown(self) -> None:
+        self._shutdown = True
+        for app_name in list(self._apps):
+            await self.delete_application(app_name)

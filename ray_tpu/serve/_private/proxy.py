@@ -1,0 +1,104 @@
+"""HTTP ingress proxy.
+
+Analog of `ray.serve._private.proxy.ProxyActor/HTTPProxy`
+(`python/ray/serve/_private/proxy.py:1112,748`, proxy_request `:424`),
+with aiohttp in place of uvicorn (not in this image): an async actor runs
+the server on its actor event loop; requests route by longest matching
+route prefix to the app's ingress deployment handle and flow through the
+same power-of-two router as Python-side calls.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any, Dict
+
+logger = logging.getLogger(__name__)
+
+
+class ProxyActor:
+    def __init__(self, controller, port: int):
+        self._controller = controller
+        self._port = port
+        self._routes: Dict[str, Any] = {}
+        self._handles: Dict[str, Any] = {}
+        self._runner = None
+        self._started = asyncio.Event()
+
+    async def ready(self) -> int:
+        await self._start()
+        return self._port
+
+    async def _start(self):
+        if self._runner is not None:
+            return
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "0.0.0.0", self._port)
+        await site.start()
+        asyncio.ensure_future(self._route_refresher())
+        logger.info("serve proxy listening on :%d", self._port)
+
+    async def _route_refresher(self):
+        while True:
+            try:
+                self._routes = await self._controller.get_routes.remote()
+            except Exception:
+                pass
+            await asyncio.sleep(1.0)
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        path = "/" + request.match_info["tail"]
+        if path == "/-/healthz":
+            return web.Response(text="ok")
+        if path == "/-/routes":
+            if not self._routes:
+                self._routes = await self._controller.get_routes.remote()
+            return web.json_response(self._routes)
+        if not self._routes:
+            self._routes = await self._controller.get_routes.remote()
+        target = None
+        best = -1
+        for prefix, dest in self._routes.items():
+            if path.startswith(prefix) and len(prefix) > best:
+                target, best = dest, len(prefix)
+        if target is None:
+            return web.Response(status=404, text="no route")
+        handle = self._handles.get(target)
+        if handle is None:
+            from ray_tpu.serve.handle import DeploymentHandle
+
+            app_name, dep = target.split("/", 1)
+            handle = DeploymentHandle(app_name, dep, self._controller)
+            self._handles[target] = handle
+        try:
+            if request.can_read_body:
+                body = await request.read()
+                try:
+                    payload = json.loads(body) if body else None
+                except json.JSONDecodeError:
+                    payload = body.decode()
+            else:
+                payload = dict(request.query) or None
+            # assign_request does blocking controller lookups — keep them
+            # off the proxy's event loop
+            loop = asyncio.get_running_loop()
+            resp = await loop.run_in_executor(
+                None, lambda: handle.remote(payload))
+            out = await resp
+            if isinstance(out, (dict, list, int, float, bool)) or out is None:
+                return web.json_response(out)
+            if isinstance(out, bytes):
+                return web.Response(body=out)
+            return web.Response(text=str(out))
+        except Exception as e:
+            logger.exception("proxy error on %s", path)
+            return web.Response(status=500, text=f"{type(e).__name__}: {e}")

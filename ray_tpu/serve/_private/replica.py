@@ -1,0 +1,73 @@
+"""Replica actor — hosts one copy of the user's deployment callable.
+
+Analog of `ray.serve._private.replica.Replica`
+(`python/ray/serve/_private/replica.py`): an async actor
+(max_concurrency = max_ongoing_requests, the runtime's fiber-style queue)
+that tracks in-flight counts for autoscaling and health. On TPU serving
+(v5e decode loops) the callable owns the chips and the jitted decode
+program; concurrency>1 lets continuous batching aggregate requests via
+`serve.batch`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import time
+from typing import Any, Dict, Optional
+
+
+class ReplicaActor:
+    def __init__(self, app_name: str, deployment_name: str,
+                 callable_factory, init_args, init_kwargs):
+        self._app = app_name
+        self._deployment = deployment_name
+        user = callable_factory()
+        if inspect.isclass(user):
+            self._callable = user(*init_args, **(init_kwargs or {}))
+            self._is_function = False
+        else:
+            self._callable = user
+            self._is_function = True
+        self._ongoing = 0
+        self._total = 0
+        self._started = time.time()
+
+    async def handle_request(self, method_name: str, args, kwargs) -> Any:
+        self._ongoing += 1
+        self._total += 1
+        try:
+            if self._is_function:
+                fn = self._callable
+            else:
+                fn = getattr(self._callable, method_name or "__call__")
+            out = fn(*args, **(kwargs or {}))
+            if inspect.isawaitable(out):
+                out = await out
+            return out
+        finally:
+            self._ongoing -= 1
+
+    async def reconfigure(self, user_config: Any) -> None:
+        if hasattr(self._callable, "reconfigure"):
+            out = self._callable.reconfigure(user_config)
+            if inspect.isawaitable(out):
+                await out
+
+    async def stats(self) -> Dict[str, Any]:
+        return {"ongoing": self._ongoing, "total": self._total,
+                "uptime_s": time.time() - self._started}
+
+    async def check_health(self) -> bool:
+        if hasattr(self._callable, "check_health"):
+            out = self._callable.check_health()
+            if inspect.isawaitable(out):
+                out = await out
+            return bool(out) if out is not None else True
+        return True
+
+    async def prepare_for_shutdown(self) -> None:
+        # drain: wait for in-flight requests
+        deadline = time.monotonic() + 10
+        while self._ongoing > 0 and time.monotonic() < deadline:
+            await asyncio.sleep(0.02)

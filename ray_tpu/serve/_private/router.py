@@ -1,0 +1,84 @@
+"""Request router with power-of-two-choices replica scheduling.
+
+Analog of `ray.serve._private.router.Router.assign_request`
+(`python/ray/serve/_private/router.py:518`) +
+`PowerOfTwoChoicesReplicaScheduler`
+(`_private/replica_scheduler/pow_2_scheduler.py:49`): sample two replicas,
+send to the one with the lower locally-tracked in-flight count; refresh
+the replica set from the controller when its version bumps.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class Router:
+    REFRESH_INTERVAL_S = 1.0
+
+    def __init__(self, controller, app_name: str, deployment_name: str):
+        self._controller = controller
+        self._app = app_name
+        self._deployment = deployment_name
+        self._replicas: List[Any] = []
+        self._version = -2
+        self._inflight: Dict[int, int] = {}
+        self._lock = threading.Lock()
+        self._last_refresh = 0.0
+
+    def _refresh(self, force: bool = False):
+        now = time.monotonic()
+        if not force and now - self._last_refresh < self.REFRESH_INTERVAL_S:
+            return
+        self._last_refresh = now
+        info = ray_tpu.get(
+            self._controller.get_replicas.remote(self._app, self._deployment))
+        if info["version"] != self._version:
+            with self._lock:
+                self._replicas = info["replicas"]
+                self._version = info["version"]
+                self._inflight = {i: 0 for i in range(len(self._replicas))}
+
+    def assign_request(self, method_name: str, args, kwargs):
+        deadline = time.monotonic() + 30
+        while True:
+            self._refresh()
+            with self._lock:
+                n = len(self._replicas)
+                if n:
+                    break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no replicas for {self._app}/{self._deployment}")
+            self._refresh(force=True)
+            time.sleep(0.05)
+        with self._lock:
+            if n == 1:
+                idx = 0
+            else:
+                a, b = random.sample(range(n), 2)
+                idx = a if self._inflight.get(a, 0) <= self._inflight.get(
+                    b, 0) else b
+            self._inflight[idx] = self._inflight.get(idx, 0) + 1
+            replica = self._replicas[idx]
+        ref = replica.handle_request.remote(method_name, args, kwargs)
+        self._watch_completion(ref, idx)
+        return ref
+
+    def _watch_completion(self, ref, idx: int):
+        def done(_f):
+            with self._lock:
+                if idx in self._inflight and self._inflight[idx] > 0:
+                    self._inflight[idx] -= 1
+
+        try:
+            ref.future().add_done_callback(done)
+        except Exception:
+            with self._lock:
+                if idx in self._inflight and self._inflight[idx] > 0:
+                    self._inflight[idx] -= 1

@@ -1,0 +1,78 @@
+"""DeploymentHandle — composable client to a deployment.
+
+Analog of `ray.serve.handle.DeploymentHandle`: `handle.remote(...)`
+returns a `DeploymentResponse` (resolve with `.result()`, await it, or
+pass the underlying ref onward). Method access (`handle.other.remote()`)
+routes to that method of the callable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import ray_tpu
+from ray_tpu.serve._private.router import Router
+
+
+class DeploymentResponse:
+    def __init__(self, ref):
+        self._ref = ref
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        return ray_tpu.get(self._ref, timeout=timeout)
+
+    def __await__(self):
+        return self._ref.__await__()
+
+    @property
+    def ref(self):
+        return self._ref
+
+
+class _BoundMethod:
+    def __init__(self, handle: "DeploymentHandle", method_name: str):
+        self._handle = handle
+        self._method = method_name
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._handle._call(self._method, args, kwargs)
+
+
+class DeploymentHandle:
+    def __init__(self, app_name: str, deployment_name: str,
+                 controller=None):
+        self._app = app_name
+        self._deployment = deployment_name
+        self._controller = controller
+        self._router: Optional[Router] = None
+
+    def _get_router(self) -> Router:
+        if self._router is None:
+            controller = self._controller
+            if controller is None:
+                from ray_tpu.serve._private.controller import CONTROLLER_NAME
+
+                controller = ray_tpu.get_actor(CONTROLLER_NAME)
+                self._controller = controller
+            self._router = Router(controller, self._app, self._deployment)
+        return self._router
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._call("__call__", args, kwargs)
+
+    def _call(self, method: str, args, kwargs) -> DeploymentResponse:
+        # resolve nested responses so chained models compose
+        args = tuple(a._ref if isinstance(a, DeploymentResponse) else a
+                     for a in args)
+        kwargs = {k: (v._ref if isinstance(v, DeploymentResponse) else v)
+                  for k, v in kwargs.items()}
+        ref = self._get_router().assign_request(method, args, kwargs)
+        return DeploymentResponse(ref)
+
+    def __getattr__(self, name: str) -> _BoundMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _BoundMethod(self, name)
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self._app, self._deployment))
