@@ -36,6 +36,8 @@ class ScalingConfig:
     def __post_init__(self):
         if self.num_workers < 1:
             raise ValueError("num_workers must be >= 1")
+        if self.tpus_per_worker is not None and self.tpus_per_worker < 0:
+            raise ValueError("tpus_per_worker must be >= 0")
 
     @property
     def _worker_bundle(self) -> Dict[str, float]:
@@ -45,7 +47,11 @@ class ScalingConfig:
                 {k: float(v) for k, v in self.resources_per_worker.items()}
             )
         if self.use_tpu and "TPU" not in bundle:
-            bundle["TPU"] = float(self.tpus_per_worker or 1.0)
+            # explicit 0 means "share whatever is visible" — reserve nothing
+            per = (float(self.tpus_per_worker)
+                   if self.tpus_per_worker is not None else 1.0)
+            if per > 0:
+                bundle["TPU"] = per
         return bundle
 
     def as_placement_group_bundles(self) -> List[Dict[str, float]]:

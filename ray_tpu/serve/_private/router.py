@@ -48,24 +48,25 @@ class Router:
         deadline = time.monotonic() + 30
         while True:
             self._refresh()
+            # select under the same lock acquisition as the length check —
+            # a concurrent _refresh can otherwise shrink the list in between.
             with self._lock:
                 n = len(self._replicas)
                 if n:
+                    if n == 1:
+                        idx = 0
+                    else:
+                        a, b = random.sample(range(n), 2)
+                        idx = (a if self._inflight.get(a, 0)
+                               <= self._inflight.get(b, 0) else b)
+                    self._inflight[idx] = self._inflight.get(idx, 0) + 1
+                    replica = self._replicas[idx]
                     break
             if time.monotonic() > deadline:
                 raise RuntimeError(
                     f"no replicas for {self._app}/{self._deployment}")
             self._refresh(force=True)
             time.sleep(0.05)
-        with self._lock:
-            if n == 1:
-                idx = 0
-            else:
-                a, b = random.sample(range(n), 2)
-                idx = a if self._inflight.get(a, 0) <= self._inflight.get(
-                    b, 0) else b
-            self._inflight[idx] = self._inflight.get(idx, 0) + 1
-            replica = self._replicas[idx]
         ref = replica.handle_request.remote(method_name, args, kwargs)
         self._watch_completion(ref, idx)
         return ref

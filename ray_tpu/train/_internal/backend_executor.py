@@ -104,21 +104,41 @@ class BackendExecutor:
         ray_tpu.get(refs)
 
     def get_next_results(self,
-                         timeout: float = 600.0) -> List[TrainingReport]:
+                         poll_interval: float = 60.0) -> List[TrainingReport]:
         """One synchronized round: one report per rank.
 
-        Raises TrainingFinished when every rank's loop returned, and
-        TrainingWorkerError on any rank error/death.
+        Ranks are polled with a short RPC timeout; a rank whose step/ckpt
+        takes longer just returns ``kind="timeout"`` and is re-polled, so a
+        slow step is never misclassified as a death (only an actual actor
+        death raises TrainingWorkerError). Raises TrainingFinished when
+        every rank's loop returned.
         """
         assert self.worker_group is not None
-        refs = [
-            w.actor.next_report.remote(timeout)
-            for w in self.worker_group.workers
-        ]
-        try:
-            reports: List[TrainingReport] = ray_tpu.get(refs)
-        except Exception as e:
-            raise TrainingWorkerError(f"training worker died: {e}") from e
+        workers = self.worker_group.workers
+        reports: List[Optional[TrainingReport]] = [None] * len(workers)
+        pending = list(range(len(workers)))
+        while pending:
+            refs = [
+                workers[i].actor.next_report.remote(poll_interval)
+                for i in pending
+            ]
+            try:
+                got: List[TrainingReport] = ray_tpu.get(refs)
+            except Exception as e:
+                raise TrainingWorkerError(
+                    f"training worker died: {e}") from e
+            still = []
+            for i, rep in zip(pending, got):
+                if rep.kind == "timeout":
+                    still.append(i)
+                else:
+                    reports[i] = rep
+                    # Fail fast: one rank erroring can leave SPMD peers
+                    # blocked in a collective forever — don't wait for them.
+                    if rep.kind == "error":
+                        raise TrainingWorkerError(
+                            f"rank {i} failed: {rep.error}")
+            pending = still
         errors = [r for r in reports if r.kind == "error"]
         if errors:
             raise TrainingWorkerError(

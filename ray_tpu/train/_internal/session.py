@@ -29,7 +29,7 @@ _session: Optional["_TrainSession"] = None
 
 @dataclasses.dataclass
 class TrainingReport:
-    kind: str  # "report" | "done" | "error"
+    kind: str  # "report" | "done" | "error" | "timeout"
     metrics: Optional[Dict[str, Any]] = None
     checkpoint_path: Optional[str] = None  # persisted (storage) path
     error: Optional[str] = None
@@ -91,8 +91,17 @@ class _TrainSession:
         self._thread.start()
 
     def next_report(self, timeout: Optional[float] = None) -> TrainingReport:
-        """Driver-driven: block for the next report from the user loop."""
-        return self._queue.get(timeout=timeout)
+        """Driver-driven: block for the next report from the user loop.
+
+        A slow step is NOT a failure: on timeout this returns a
+        ``kind="timeout"`` report so the driver can simply re-poll instead
+        of misclassifying the rank as dead (ADVICE r1: queue.Empty was
+        consuming a FailureConfig retry).
+        """
+        try:
+            return self._queue.get(timeout=timeout)
+        except queue.Empty:
+            return TrainingReport(kind="timeout")
 
     def finished(self) -> bool:
         return self._finished.is_set()

@@ -118,7 +118,7 @@ class DataParallelTrainer(BaseTrainer):
                 executor.start()
                 executor.start_training(
                     self._wrapped_loop(),
-                    self._train_loop_config
+                    (self._train_loop_config or {})
                     if self._loop_takes_config else None,
                     latest_checkpoint,
                     dataset_shards_per_worker=self._shard_datasets(),
@@ -181,7 +181,10 @@ class DataParallelTrainer(BaseTrainer):
         per_worker: List[Dict[str, Any]] = [{} for _ in range(n)]
         for dsname, ds in self.datasets.items():
             if hasattr(ds, "streaming_split"):
-                shards = ds.streaming_split(n)
+                # equal=True: lockstep SPMD loops need identical batch counts
+                # per rank or the report barrier desynchronizes (reference
+                # train ingest: data_config.py uses equal=True).
+                shards = ds.streaming_split(n, equal=True)
             else:
                 shards = [ds] * n
             for rank in range(n):
