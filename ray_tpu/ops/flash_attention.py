@@ -1,13 +1,25 @@
-"""Pallas TPU flash attention (forward), online-softmax blockwise.
+"""Pallas TPU flash attention, forward + backward kernels.
 
-Layout [B, S, H, D] (seq-major, matches the models). GQA supported by mapping
-each query head to its kv head in the BlockSpec index map — kv heads are never
-materialized repeated in HBM. Off-TPU the kernel runs in interpreter mode so
-the same code path is exercised by the CPU test mesh.
+Layout [B, S, H, D] (seq-major, matches the models); kernels run head-major
+[B, H, S, D]. GQA supported by mapping each query head to its kv head in the
+BlockSpec index maps — kv heads are never materialized repeated in HBM.
+Off-TPU the kernels run in interpreter mode so the same code path is
+exercised by the CPU test mesh.
 
-Backward pass: custom_vjp whose bwd recomputes attention via the XLA reference
-implementation (flash-style memory savings forward, remat backward). A
-dedicated Pallas bwd kernel can replace it without touching callers.
+Forward: online-softmax blockwise (FlashAttention-2 schedule), saving the
+per-row logsumexp as residual. Matmul inputs stay in the model dtype
+(bf16 on TPU) with f32 MXU accumulation — softmax math is f32.
+
+Backward: two Pallas kernels sharing the recompute-from-(q,k,v,lse) trick:
+  - dQ:    grid (B, H, q_blocks, k_blocks), accumulates over k blocks.
+  - dK/dV: grid (B, Hkv, k_blocks, group*q_blocks), accumulates over all
+           query heads of the group and all q blocks, so GQA gradients sum
+           into the kv head without an HBM-repeated intermediate.
+D = rowsum(dO * O) is computed in XLA (cheap elementwise) and fed in.
+
+Reference parity surface: ray.util's attention has no TPU analog — the
+reference delegates to torch SDPA inside workers; this is the TPU-native
+equivalent of that compute path.
 """
 
 from __future__ import annotations
@@ -32,7 +44,10 @@ def _pick_block(seq: int, target: int) -> int:
     return b
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+# ---------------------------------------------------------------- forward
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
                 sm_scale, causal, block_q, block_k, num_kv):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
@@ -48,8 +63,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
 
     @pl.when(should_run)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        # model-dtype inputs on the MXU, f32 accumulate
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale
@@ -64,34 +80,31 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
-        v = v_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0]
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
-            p, v, preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
     @pl.when(ki == num_kv - 1)
     def _finalize():
         l = l_scr[:, :1]
-        l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_scr[:, :1] + jnp.log(l_safe)
 
 
 def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    batch, seq_q, num_heads, head_dim = q.shape
-    _, seq_k, num_kv_heads, _ = k.shape
+    """Head-major [B,H,S,D] inputs -> (o, lse[B,H,Sq,1])."""
+    batch, num_heads, seq_q, head_dim = q.shape
+    _, num_kv_heads, seq_k, _ = k.shape
     group = num_heads // num_kv_heads
-
-    # head-major for the kernel: [B, H, S, D]
-    qt = q.transpose(0, 2, 1, 3)
-    kt = k.transpose(0, 2, 1, 3)
-    vt = v.transpose(0, 2, 1, 3)
 
     block_q = _pick_block(seq_q, block_q)
     block_k = _pick_block(seq_k, block_k)
     grid = (batch, num_heads, seq_q // block_q, seq_k // block_k)
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(
             _fwd_kernel, sm_scale=sm_scale, causal=causal,
             block_q=block_q, block_k=block_k, num_kv=seq_k // block_k),
@@ -104,9 +117,19 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, 1, block_k, head_dim),
                          lambda b, h, qi, ki, g=group: (b, h // g, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, head_dim),
-                               lambda b, h, qi, ki: (b, h, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, head_dim),
+                         lambda b, h, qi, ki: (b, h, qi, 0)),
+            # lane-1 residual: [B, H, Sq, 1], the same layout the bwd
+            # kernels consume — not 128-lane-broadcast (128x HBM waste)
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b, h, qi, ki: (b, h, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((batch, num_heads, seq_q, 1),
+                                 jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, _LANES), jnp.float32),
@@ -117,8 +140,183 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
                                  "arbitrary"),
         ),
         interpret=interpret,
-    )(qt, kt, vt)
-    return out.transpose(0, 2, 1, 3)
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------- backward
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_scr, *, sm_scale, causal, block_q, block_k, num_kv):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    should_run = (qi * block_q + block_q > ki * block_k) if causal else (ki >= 0)
+
+    @pl.when(should_run)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]          # [block_q, 1] f32
+        delta = delta_ref[0, 0]      # [block_q, 1] f32
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * sm_scale
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        p = jnp.exp(s - lse)         # masked entries underflow to 0
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dq_scr[:] = dq_scr[:] + jax.lax.dot(
+            ds.astype(k.dtype), k, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_kv - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *,
+                sm_scale, causal, block_q, block_k, num_q, num_inner):
+    ki = pl.program_id(2)
+    j = pl.program_id(3)
+    qi = j % num_q
+
+    @pl.when(j == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    should_run = (qi * block_q + block_q > ki * block_k) if causal else (j >= 0)
+
+    @pl.when(should_run)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * sm_scale
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        p = jnp.exp(s - lse)                       # [bq, bk] f32
+        # dV += P^T dO   (contract over q rows)
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale           # [bq, bk] f32
+        # dK += dS^T Q
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == num_inner - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, block_q, block_k,
+               interpret):
+    """Head-major grads: q[B,H,Sq,D], k/v[B,Hkv,Sk,D] -> (dq, dk, dv)."""
+    batch, num_heads, seq_q, head_dim = q.shape
+    _, num_kv_heads, seq_k, _ = k.shape
+    group = num_heads // num_kv_heads
+
+    block_q = _pick_block(seq_q, block_q)
+    block_k = _pick_block(seq_k, block_k)
+    num_q = seq_q // block_q
+    num_k = seq_k // block_k
+
+    # D_i = rowsum(dO * O): cheap elementwise — XLA fuses it.
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)       # [B, H, Sq, 1]
+
+    lse_spec = pl.BlockSpec((1, 1, block_q, 1),
+                            lambda b, h, qi, ki: (b, h, qi, 0))
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_kv=num_k),
+        grid=(batch, num_heads, num_q, num_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, head_dim),
+                         lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, head_dim),
+                         lambda b, h, qi, ki, g=group: (b, h // g, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, head_dim),
+                         lambda b, h, qi, ki, g=group: (b, h // g, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, head_dim),
+                         lambda b, h, qi, ki: (b, h, qi, 0)),
+            lse_spec,
+            lse_spec,
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, head_dim),
+                               lambda b, h, qi, ki: (b, h, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    num_inner = group * num_q
+    qh_spec = pl.BlockSpec(
+        (1, 1, block_q, head_dim),
+        lambda b, hkv, ki, j, g=group, nq=num_q: (b, hkv * g + j // nq,
+                                                  j % nq, 0))
+    lse_kv_spec = pl.BlockSpec(
+        (1, 1, block_q, 1),
+        lambda b, hkv, ki, j, g=group, nq=num_q: (b, hkv * g + j // nq,
+                                                  j % nq, 0))
+    kv_spec = pl.BlockSpec((1, 1, block_k, head_dim),
+                           lambda b, hkv, ki, j: (b, hkv, ki, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_q=num_q,
+            num_inner=num_inner),
+        grid=(batch, num_kv_heads, num_k, num_inner),
+        in_specs=[qh_spec, kv_spec, kv_spec, qh_spec, lse_kv_spec,
+                  lse_kv_spec],
+        out_specs=[kv_spec, kv_spec],
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, head_dim), jnp.float32),
+                        pltpu.VMEM((block_k, head_dim), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------- reference
 
 
 def reference_attention(q, k, v, sm_scale=None, causal=True, bias=None):
@@ -142,25 +340,38 @@ def reference_attention(q, k, v, sm_scale=None, causal=True, bias=None):
     return out.reshape(batch, seq_q, num_heads, head_dim).astype(q.dtype)
 
 
+# ---------------------------------------------------------------- public op
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, sm_scale=None, causal=True,
                     block_q=512, block_k=512):
-    if sm_scale is None:
-        sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    interpret = jax.default_backend() != "tpu"
-    return _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    out, _ = _fwd_rule(q, k, v, sm_scale, causal, block_q, block_k)
+    return out
 
 
 def _fwd_rule(q, k, v, sm_scale, causal, block_q, block_k):
-    return flash_attention(q, k, v, sm_scale, causal, block_q, block_k), (q, k, v)
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    interpret = jax.default_backend() != "tpu"
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    ot, lse = _flash_fwd(qt, kt, vt, sm_scale, causal, block_q, block_k,
+                         interpret)
+    return ot.transpose(0, 2, 1, 3), (qt, kt, vt, ot, lse)
 
 
 def _bwd_rule(sm_scale, causal, block_q, block_k, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: reference_attention(q_, k_, v_, sm_scale, causal),
-        q, k, v)
-    return vjp(g)
+    qt, kt, vt, ot, lse = res
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(qt.shape[-1])
+    interpret = jax.default_backend() != "tpu"
+    dot = g.transpose(0, 2, 1, 3)
+    dq, dk, dv = _flash_bwd(qt, kt, vt, ot, lse, dot, sm_scale, causal,
+                            block_q, block_k, interpret)
+    return (dq.transpose(0, 2, 1, 3), dk.transpose(0, 2, 1, 3),
+            dv.transpose(0, 2, 1, 3))
 
 
 flash_attention.defvjp(_fwd_rule, _bwd_rule)

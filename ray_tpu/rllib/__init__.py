@@ -1,0 +1,32 @@
+"""ray_tpu.rllib — RL training on the new-API-stack split.
+
+RLModule (jitted nets) + Learner/LearnerGroup (SGD actors) +
+EnvRunner/EnvRunnerGroup (sampling actors) + Algorithm drivers
+(PPO / IMPALA / DQN). See `rllib/algorithms/algorithm.py` for the
+architecture mapping to the reference.
+"""
+
+from ray_tpu.rllib.algorithms import (DQN, IMPALA, PPO, Algorithm,
+                                      AlgorithmConfig, DQNConfig,
+                                      IMPALAConfig, PPOConfig)
+from ray_tpu.rllib.core.learner import Learner, LearnerGroup
+from ray_tpu.rllib.core.rl_module import RLModule, RLModuleSpec
+from ray_tpu.rllib.env.single_agent_env_runner import (EnvRunnerGroup,
+                                                       SingleAgentEnvRunner)
+
+__all__ = [
+    "Algorithm",
+    "AlgorithmConfig",
+    "PPO",
+    "PPOConfig",
+    "IMPALA",
+    "IMPALAConfig",
+    "DQN",
+    "DQNConfig",
+    "Learner",
+    "LearnerGroup",
+    "RLModule",
+    "RLModuleSpec",
+    "EnvRunnerGroup",
+    "SingleAgentEnvRunner",
+]

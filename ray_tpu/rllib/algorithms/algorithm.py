@@ -1,0 +1,167 @@
+"""Algorithm — the RL training driver.
+
+Analog of `rllib/algorithms/algorithm.py:210` (`.step :818`,
+`training_step :1589`): owns an EnvRunnerGroup (sampling actors) and a
+LearnerGroup (SGD actors), iterates `training_step()` per `train()` call,
+and checkpoints as a directory (pickled learner state + config), so it
+slots under the Tune controller via `AlgorithmConfig.to_trainable()`.
+
+The reference makes Algorithm literally a Tune `Trainable` subclass; here
+Tune runs function-trainables, so the adapter lives in
+`AlgorithmConfig.to_trainable`. Connector pipelines (ConnectorV2) are
+folded into the env-runner (obs casting) and each algorithm's
+`training_step` (advantage postprocessing) — the hook surface, not the
+class hierarchy, is the parity target.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner import LearnerGroup
+from ray_tpu.rllib.env.single_agent_env_runner import EnvRunnerGroup
+from ray_tpu.train._checkpoint import Checkpoint
+
+
+class Algorithm:
+    """Base driver; subclasses define `loss_fn` + `training_step`."""
+
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        self.iteration = 0
+        self._total_env_steps = 0
+        self._start = time.time()
+        self.spec = config.rl_module_spec()
+        self.env_runner_group = EnvRunnerGroup(
+            config.env, self.spec,
+            num_env_runners=config.num_env_runners,
+            num_envs_per_runner=config.num_envs_per_env_runner,
+            seed=config.seed, env_config=config.env_config)
+        self.learner_group = LearnerGroup(
+            self.spec, type(self).loss_fn,
+            optimizer_config={"lr": config.lr,
+                              "grad_clip": config.grad_clip},
+            num_learners=config.num_learners, seed=config.seed)
+        self._sync_weights()
+
+    # ------------------------------------------------------------ interface
+
+    @staticmethod
+    def loss_fn(module, params, batch, cfg):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------- train()
+
+    def train(self) -> Dict[str, Any]:
+        """One iteration: run `training_step`, fold in sampler metrics."""
+        result = self.training_step()
+        self.iteration += 1
+        metrics = self.env_runner_group.get_metrics()
+        returns = [m["episode_return_mean"] for m in metrics
+                   if m.get("episode_return_mean") is not None]
+        lens = [m["episode_len_mean"] for m in metrics
+                if m.get("episode_len_mean") is not None]
+        result.update({
+            "training_iteration": self.iteration,
+            "num_env_steps_sampled_lifetime": self._total_env_steps,
+            "episode_return_mean": (float(np.mean(returns))
+                                    if returns else None),
+            "episode_len_mean": float(np.mean(lens)) if lens else None,
+            "time_total_s": time.time() - self._start,
+        })
+        return result
+
+    def stop(self) -> None:
+        self.env_runner_group.stop()
+        self.learner_group.shutdown()
+
+    # ----------------------------------------------------------- weights
+
+    def _sync_weights(self) -> None:
+        self.env_runner_group.set_weights(self.learner_group.get_weights())
+
+    # -------------------------------------------------------- checkpointing
+
+    def _extra_state(self) -> Dict[str, Any]:
+        """Algorithm-specific mutable state (adaptive coefficients, target
+        nets, replay buffers). Subclasses extend both directions."""
+        return {}
+
+    def _set_extra_state(self, extra: Dict[str, Any]) -> None:
+        pass
+
+    def get_state(self) -> Dict[str, Any]:
+        return {
+            "learner": self.learner_group.get_state(),
+            "iteration": self.iteration,
+            "total_env_steps": self._total_env_steps,
+            "config": self.config.to_dict(),
+            "algo_class": type(self).__name__,
+            "extra": self._extra_state(),
+        }
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.learner_group.set_state(state["learner"])
+        self.iteration = state["iteration"]
+        self._total_env_steps = state["total_env_steps"]
+        self._set_extra_state(state.get("extra", {}))
+        self._sync_weights()
+
+    def save_to_checkpoint(self, path: Optional[str] = None) -> Checkpoint:
+        path = path or os.path.join(
+            tempfile.gettempdir(), f"algo_ckpt_{uuid.uuid4().hex[:12]}")
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "algorithm_state.pkl"), "wb") as f:
+            pickle.dump(self.get_state(), f)
+        return Checkpoint(path)
+
+    # alias matching the reference's Trainable surface
+    save = save_to_checkpoint
+
+    def restore_from_checkpoint(self, checkpoint: Checkpoint) -> None:
+        with checkpoint.as_directory() as d:
+            with open(os.path.join(d, "algorithm_state.pkl"), "rb") as f:
+                self.set_state(pickle.load(f))
+
+    restore = restore_from_checkpoint
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint) -> "Algorithm":
+        if isinstance(checkpoint, str):
+            checkpoint = Checkpoint(checkpoint)
+        with checkpoint.as_directory() as d:
+            with open(os.path.join(d, "algorithm_state.pkl"), "rb") as f:
+                state = pickle.load(f)
+        cfg_cls = cls.get_default_config()
+        config = cfg_cls.update_from_dict(state["config"])
+        algo = config.build()
+        algo.set_state(state)
+        return algo
+
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        raise NotImplementedError
+
+    # --------------------------------------------------------------- helpers
+
+    def _merge_time_major(
+            self, samples: List[Dict[str, np.ndarray]]
+    ) -> Dict[str, np.ndarray]:
+        """Concatenate per-runner [T, B, ...] batches along B."""
+        out: Dict[str, np.ndarray] = {}
+        for k in samples[0]:
+            axis = 0 if samples[0][k].ndim == 1 else 1  # bootstrap_value: [B]
+            out[k] = (np.concatenate([s[k] for s in samples], axis=axis)
+                      if len(samples) > 1 else samples[0][k])
+        return out

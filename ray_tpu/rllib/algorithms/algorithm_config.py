@@ -1,0 +1,137 @@
+"""AlgorithmConfig — fluent builder for RL algorithms.
+
+Analog of `rllib/algorithms/algorithm_config.py` (the new API stack
+surface): `.environment() .env_runners() .training() .learners()
+.debugging()` chained setters, `.build()` to get the Algorithm. Unknown
+kwargs raise — typos should not train.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, Optional, Type
+
+from ray_tpu.rllib.core.rl_module import RLModuleSpec
+
+
+class AlgorithmConfig:
+    algo_class: Optional[Type] = None  # set by subclasses
+
+    def __init__(self):
+        # environment
+        self.env: Optional[str] = None
+        self.env_config: Dict[str, Any] = {}
+        self.observation_dim: Optional[int] = None  # inferred if None
+        self.num_actions: Optional[int] = None
+        # env runners
+        self.num_env_runners: int = 0
+        self.num_envs_per_env_runner: int = 4
+        self.rollout_fragment_length: int = 64
+        # learners
+        self.num_learners: int = 0
+        # training
+        self.gamma: float = 0.99
+        self.lr: float = 5e-4
+        self.grad_clip: float = 0.5
+        self.train_batch_size: int = 256
+        self.model: Dict[str, Any] = {"hiddens": (64, 64)}
+        # debugging
+        self.seed: int = 0
+
+    # ------------------------------------------------------- fluent setters
+
+    def _apply(self, kwargs: Dict[str, Any]) -> "AlgorithmConfig":
+        for k, v in kwargs.items():
+            if v is None:
+                continue
+            if not hasattr(self, k):
+                raise AttributeError(
+                    f"{type(self).__name__} has no setting {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def environment(self, env: Optional[str] = None, *,
+                    env_config: Optional[Dict[str, Any]] = None,
+                    observation_dim: Optional[int] = None,
+                    num_actions: Optional[int] = None) -> "AlgorithmConfig":
+        return self._apply(dict(env=env, env_config=env_config,
+                                observation_dim=observation_dim,
+                                num_actions=num_actions))
+
+    def env_runners(self, *, num_env_runners: Optional[int] = None,
+                    num_envs_per_env_runner: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None
+                    ) -> "AlgorithmConfig":
+        return self._apply(dict(
+            num_env_runners=num_env_runners,
+            num_envs_per_env_runner=num_envs_per_env_runner,
+            rollout_fragment_length=rollout_fragment_length))
+
+    def learners(self, *, num_learners: Optional[int] = None
+                 ) -> "AlgorithmConfig":
+        return self._apply(dict(num_learners=num_learners))
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        return self._apply(kwargs)
+
+    def debugging(self, *, seed: Optional[int] = None) -> "AlgorithmConfig":
+        return self._apply(dict(seed=seed))
+
+    # ------------------------------------------------------------- building
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def rl_module_spec(self) -> RLModuleSpec:
+        obs_dim, num_actions = self.observation_dim, self.num_actions
+        if obs_dim is None or num_actions is None:
+            import gymnasium as gym
+
+            probe = gym.make(self.env, **self.env_config)
+            try:
+                obs_dim = obs_dim or int(probe.observation_space.shape[0])
+                num_actions = num_actions or int(probe.action_space.n)
+            finally:
+                probe.close()
+        return RLModuleSpec(obs_dim=obs_dim, num_actions=num_actions,
+                            hiddens=tuple(self.model.get("hiddens",
+                                                         (64, 64))))
+
+    def build(self):
+        assert self.algo_class is not None, "use a subclass (PPOConfig, …)"
+        assert self.env is not None, "call .environment(env=...) first"
+        return self.algo_class(self.copy())
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: v for k, v in self.__dict__.items()}
+
+    def update_from_dict(self, d: Dict[str, Any]) -> "AlgorithmConfig":
+        return self._apply(dict(d))
+
+    # ---------------------------------------------------------------- tune
+
+    def to_trainable(self, *, checkpoint_every: int = 0):
+        """A Tune function-trainable: builds the algo (with per-trial
+        config overrides), loops `train()` and reports each iteration
+        (reference: Algorithm IS-A Trainable; here Tune runs functions)."""
+        base = self.copy()
+
+        def trainable(config: Dict[str, Any]):
+            from ray_tpu.train._internal import session as session_mod
+
+            cfg = base.copy().update_from_dict(config or {})
+            algo = cfg.build()
+            sess = session_mod.get_session()
+            try:
+                i = 0
+                while True:
+                    result = algo.train()
+                    i += 1
+                    ckpt = None
+                    if checkpoint_every and i % checkpoint_every == 0:
+                        ckpt = algo.save_to_checkpoint()
+                    session_mod.report(result, checkpoint=ckpt)
+            finally:
+                algo.stop()
+
+        return trainable

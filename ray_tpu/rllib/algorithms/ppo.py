@@ -1,0 +1,142 @@
+"""PPO — Proximal Policy Optimization (clipped surrogate), new API stack.
+
+Analog of `rllib/algorithms/ppo/ppo.py:395` (training_step `:421`) +
+`ppo_learner.py` losses, TPU-first: GAE and the SGD update are each ONE
+jitted XLA program; minibatch epochs shuffle on host (numpy) and feed the
+jitted update. The adaptive-KL coefficient rides inside the batch (a
+scalar array) so changing it never retriggers compilation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.utils.advantages import compute_gae
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lam: float = 0.95
+        self.clip_param: float = 0.2
+        self.vf_clip_param: float = 10.0
+        self.vf_loss_coeff: float = 0.5
+        self.entropy_coeff: float = 0.0
+        self.kl_coeff: float = 0.2       # initial; adapted toward kl_target
+        self.kl_target: float = 0.01
+        self.num_epochs: int = 8
+        self.minibatch_size: int = 128
+        self.lr = 3e-4
+
+
+class PPO(Algorithm):
+    def __init__(self, config: PPOConfig):
+        super().__init__(config)
+        self._kl_coeff = float(config.kl_coeff)
+
+    @classmethod
+    def get_default_config(cls) -> PPOConfig:
+        return PPOConfig()
+
+    @staticmethod
+    def loss_fn(module, params, batch, cfg):
+        """Clipped-surrogate loss (`ppo_torch_learner.py` parity)."""
+        import jax
+        import jax.numpy as jnp
+
+        clip = cfg["clip_param"]
+        vf_clip = cfg["vf_clip_param"]
+        logits, value = module.forward_train(params, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][:, None], axis=-1)[:, 0]
+        ratio = jnp.exp(logp - batch["logp"])
+        adv = batch["advantages"]
+        adv = (adv - adv.mean()) / jnp.maximum(adv.std(), 1e-6)
+        surrogate = jnp.minimum(
+            ratio * adv, jnp.clip(ratio, 1.0 - clip, 1.0 + clip) * adv)
+        pi_loss = -jnp.mean(surrogate)
+
+        vf_err = (value - batch["value_targets"]) ** 2
+        vf_clipped = batch["values"] + jnp.clip(
+            value - batch["values"], -vf_clip, vf_clip)
+        vf_err_clipped = (vf_clipped - batch["value_targets"]) ** 2
+        vf_loss = 0.5 * jnp.mean(jnp.maximum(vf_err, vf_err_clipped))
+
+        probs = jax.nn.softmax(logits)
+        entropy = -jnp.mean(jnp.sum(probs * logp_all, axis=-1))
+        # K3 estimator (Schulman): non-negative, low-variance
+        kl = jnp.mean(jnp.exp(batch["logp"] - logp)
+                      - (batch["logp"] - logp) - 1.0)
+        kl_coeff = jnp.mean(batch["kl_coeff"])
+
+        total = (pi_loss + cfg["vf_loss_coeff"] * vf_loss
+                 - cfg["entropy_coeff"] * entropy + kl_coeff * kl)
+        return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                       "entropy": entropy, "mean_kl": kl}
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: PPOConfig = self.config
+        samples = self.env_runner_group.sample(cfg.rollout_fragment_length)
+        batch_tm = self._merge_time_major(samples)
+        T, B = batch_tm["rewards"].shape
+        self._total_env_steps += T * B
+
+        adv, targets = compute_gae(
+            batch_tm["rewards"], batch_tm["values"],
+            batch_tm["bootstrap_value"], batch_tm["terminateds"],
+            batch_tm["truncateds"], gamma=cfg.gamma, lam=cfg.lam)
+
+        flat = {
+            "obs": batch_tm["obs"].reshape(T * B, -1),
+            "actions": batch_tm["actions"].reshape(T * B),
+            "logp": batch_tm["logp"].reshape(T * B),
+            "values": batch_tm["values"].reshape(T * B),
+            "advantages": np.asarray(adv).reshape(T * B),
+            "value_targets": np.asarray(targets).reshape(T * B),
+        }
+        loss_cfg = {
+            "clip_param": cfg.clip_param,
+            "vf_clip_param": cfg.vf_clip_param,
+            "vf_loss_coeff": cfg.vf_loss_coeff,
+            "entropy_coeff": cfg.entropy_coeff,
+        }
+
+        n = T * B
+        mb = min(cfg.minibatch_size, n)
+        rng = np.random.default_rng(cfg.seed + self.iteration)
+        last_metrics: Dict[str, float] = {}
+        for _ in range(cfg.num_epochs):
+            perm = rng.permutation(n)
+            for lo in range(0, n - mb + 1, mb):
+                idx = perm[lo:lo + mb]
+                minibatch = {k: v[idx] for k, v in flat.items()}
+                # per-row (not length-1) so LearnerGroup row-sharding
+                # slices it like every other column
+                minibatch["kl_coeff"] = np.full(len(idx), self._kl_coeff,
+                                                np.float32)
+                last_metrics = self.learner_group.update_from_batch(
+                    minibatch, loss_cfg)
+        # adaptive KL (reference: PPO.update_kl)
+        kl = last_metrics.get("mean_kl", 0.0)
+        if kl > 2.0 * cfg.kl_target:
+            self._kl_coeff *= 1.5
+        elif kl < 0.5 * cfg.kl_target:
+            self._kl_coeff *= 0.5
+
+        self._sync_weights()
+        last_metrics["kl_coeff"] = self._kl_coeff
+        return last_metrics
+
+    def _extra_state(self):
+        return {"kl_coeff": self._kl_coeff}
+
+    def _set_extra_state(self, extra):
+        self._kl_coeff = float(extra.get("kl_coeff", self._kl_coeff))
+
+
+PPOConfig.algo_class = PPO

@@ -26,6 +26,9 @@ class RLModuleSpec:
     hiddens: Tuple[int, ...] = (64, 64)
     #: "categorical" (discrete) — continuous heads land with the SAC port
     dist_type: str = "categorical"
+    #: separate value-net trunk (reference default vf_share_layers=False —
+    #: shared trunks let large value errors swamp the policy gradient)
+    vf_share_layers: bool = False
 
 
 def _init_linear(key, fan_in: int, fan_out: int, scale: float = 1.0):
@@ -42,7 +45,8 @@ class RLModule:
         self.spec = spec
 
     def init_params(self, key) -> Dict[str, Any]:
-        keys = jax.random.split(key, len(self.spec.hiddens) + 2)
+        nh = len(self.spec.hiddens)
+        keys = jax.random.split(key, 2 * nh + 2)
         params: Dict[str, Any] = {"torso": []}
         fan_in = self.spec.obs_dim
         for i, h in enumerate(self.spec.hiddens):
@@ -52,11 +56,18 @@ class RLModule:
         params["pi"] = _init_linear(keys[-2], fan_in, self.spec.num_actions,
                                     scale=0.01)
         params["vf"] = _init_linear(keys[-1], fan_in, 1, scale=1.0)
+        if not self.spec.vf_share_layers:
+            params["vf_torso"] = []
+            fan_in = self.spec.obs_dim
+            for i, h in enumerate(self.spec.hiddens):
+                params["vf_torso"].append(_init_linear(
+                    keys[nh + i], fan_in, h, scale=float(np.sqrt(2))))
+                fan_in = h
         return params
 
-    def _torso(self, params, obs):
+    def _torso(self, params, obs, key="torso"):
         x = obs
-        for layer in params["torso"]:
+        for layer in params[key]:
             x = jnp.tanh(x @ layer["w"] + layer["b"])
         return x
 
@@ -64,7 +75,9 @@ class RLModule:
         """→ (logits, value). Used by losses; jit-safe."""
         x = self._torso(params, obs)
         logits = x @ params["pi"]["w"] + params["pi"]["b"]
-        value = (x @ params["vf"]["w"] + params["vf"]["b"]).squeeze(-1)
+        xv = (self._torso(params, obs, "vf_torso")
+              if "vf_torso" in params else x)
+        value = (xv @ params["vf"]["w"] + params["vf"]["b"]).squeeze(-1)
         return logits, value
 
     def forward_inference(self, params, obs):

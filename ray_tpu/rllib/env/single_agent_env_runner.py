@@ -37,6 +37,7 @@ class SingleAgentEnvRunner:
         self._key = jax.random.PRNGKey(seed)
         self.params = self.module.init_params(jax.random.PRNGKey(seed))
         self._explore_fn = jax.jit(self.module.forward_exploration)
+        self._infer_fn = jax.jit(self.module.forward_inference)
         self._episode_returns = np.zeros(num_envs)
         self._episode_lens = np.zeros(num_envs, dtype=np.int64)
         self._finished_returns: List[float] = []
@@ -51,10 +52,12 @@ class SingleAgentEnvRunner:
         return True
 
     def sample(self, num_steps: int,
-               epsilon: Optional[float] = None) -> Dict[str, np.ndarray]:
+               epsilon: Optional[float] = None,
+               greedy: bool = False) -> Dict[str, np.ndarray]:
         """Collect `num_steps` per sub-env. Returns a columnar batch with
-        shape [T, B, ...] flattened to [T*B, ...] in time-major order so
-        GAE can be computed per column downstream."""
+        shape [T, B, ...] in time-major order so GAE can be computed per
+        column downstream. ``greedy=True`` takes argmax actions (value-
+        based algorithms); combine with ``epsilon`` for eps-greedy."""
         import jax
 
         T, B = num_steps, self.num_envs
@@ -69,8 +72,15 @@ class SingleAgentEnvRunner:
 
         for t in range(T):
             self._key, sub = jax.random.split(self._key)
-            action, logp, value = self._explore_fn(
-                self.params, self._obs.astype(np.float32), sub)
+            if greedy:
+                logits = self._infer_fn(self.params,
+                                        self._obs.astype(np.float32))
+                action = np.asarray(logits).argmax(-1)
+                logp = np.zeros(B, np.float32)
+                value = np.zeros(B, np.float32)
+            else:
+                action, logp, value = self._explore_fn(
+                    self.params, self._obs.astype(np.float32), sub)
             action = np.asarray(action)
             if epsilon is not None and epsilon > 0:
                 rand_mask = np.random.random(B) < epsilon
@@ -156,11 +166,11 @@ class EnvRunnerGroup:
                          for a in self._actors])
 
     def sample(self, num_steps: int,
-               epsilon: Optional[float] = None
-               ) -> List[Dict[str, np.ndarray]]:
+               epsilon: Optional[float] = None,
+               greedy: bool = False) -> List[Dict[str, np.ndarray]]:
         if self._local is not None:
-            return [self._local.sample(num_steps, epsilon)]
-        return ray_tpu.get([a.sample.remote(num_steps, epsilon)
+            return [self._local.sample(num_steps, epsilon, greedy)]
+        return ray_tpu.get([a.sample.remote(num_steps, epsilon, greedy)
                             for a in self._actors])
 
     def get_metrics(self) -> List[Dict[str, Any]]:

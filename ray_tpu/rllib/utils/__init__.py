@@ -1,0 +1,10 @@
+from ray_tpu.rllib.utils.advantages import compute_gae, vtrace_returns
+from ray_tpu.rllib.utils.replay_buffers import (PrioritizedReplayBuffer,
+                                                ReplayBuffer)
+
+__all__ = [
+    "compute_gae",
+    "vtrace_returns",
+    "ReplayBuffer",
+    "PrioritizedReplayBuffer",
+]

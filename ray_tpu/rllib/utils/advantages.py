@@ -1,0 +1,103 @@
+"""Jitted advantage estimators: GAE and V-trace.
+
+TPU-first analogs of the reference's postprocessing:
+- GAE: `rllib/evaluation/postprocessing.py` (compute_advantages) and the
+  new-stack `connectors/learner/general_advantage_estimation.py` — here a
+  single `lax.scan` over reversed time, jitted, instead of a numpy loop.
+- V-trace: `rllib/algorithms/impala/vtrace_*.py` (torch/tf) — here pure
+  XLA so it fuses into the IMPALA learner update program.
+
+All estimators run time-major [T, B]: T timesteps, B parallel env columns.
+Episode boundaries inside a column are handled with per-step discounts
+(0 where terminated) and advantage-chain resets (at terminated OR
+truncated); truncated-but-not-terminated steps still bootstrap from the
+recorded value of the next state.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("gamma", "lam"))
+def compute_gae(rewards, values, bootstrap_value, terminateds, truncateds,
+                *, gamma: float = 0.99, lam: float = 0.95):
+    """Generalized Advantage Estimation over [T, B] rollout columns.
+
+    rewards/values/terminateds/truncateds: [T, B]; bootstrap_value: [B]
+    (value of the observation after the last step of each column).
+    Returns (advantages [T, B], value_targets [T, B]).
+
+    Episode-boundary semantics: vector envs auto-reset, so ``values[t+1]``
+    at a boundary belongs to the NEXT episode and must not be bootstrapped
+    from — both the delta bootstrap and the GAE chain cut at
+    terminated|truncated (the reference's `compute_advantages` default,
+    which likewise folds truncation into termination).
+    """
+    rewards = rewards.astype(jnp.float32)
+    values = values.astype(jnp.float32)
+    term = terminateds.astype(jnp.float32)
+    trunc = truncateds.astype(jnp.float32)
+    done = jnp.clip(term + trunc, 0.0, 1.0)
+
+    next_values = jnp.concatenate([values[1:], bootstrap_value[None]], axis=0)
+    deltas = rewards + gamma * next_values * (1.0 - done) - values
+
+    def scan_fn(carry, xs):
+        delta_t, done_t = xs
+        adv = delta_t + gamma * lam * (1.0 - done_t) * carry
+        return adv, adv
+
+    _, adv_rev = jax.lax.scan(
+        scan_fn, jnp.zeros_like(bootstrap_value, jnp.float32),
+        (deltas[::-1], done[::-1]))
+    advantages = adv_rev[::-1]
+    return advantages, advantages + values
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("gamma", "clip_rho", "clip_c"))
+def vtrace_returns(behaviour_logp, target_logp, rewards, values,
+                   bootstrap_value, terminateds, truncateds, *,
+                   gamma: float = 0.99, clip_rho: float = 1.0,
+                   clip_c: float = 1.0):
+    """V-trace corrected value targets + policy-gradient advantages.
+
+    Espeholt et al. 2018 (IMPALA), matching the reference's
+    `vtrace_torch.py` semantics. All inputs [T, B] except
+    bootstrap_value [B]. Returns (vs [T, B], pg_advantages [T, B]) —
+    callers must stop_gradient them (targets, not differentiated paths).
+    """
+    rhos = jnp.exp(target_logp - behaviour_logp)
+    clipped_rhos = jnp.minimum(clip_rho, rhos)
+    cs = jnp.minimum(clip_c, rhos)
+    term = terminateds.astype(jnp.float32)
+    trunc = truncateds.astype(jnp.float32)
+    done = jnp.clip(term + trunc, 0.0, 1.0)
+    # auto-resetting envs: the in-rollout next value at a boundary belongs
+    # to the next episode — cut the discount there (reference vtrace uses
+    # gamma*(1-dones) the same way)
+    discounts = gamma * (1.0 - done)
+
+    next_values = jnp.concatenate([values[1:], bootstrap_value[None]], axis=0)
+    deltas = clipped_rhos * (rewards + discounts * next_values - values)
+
+    def scan_fn(carry, xs):
+        delta_t, disc_t, c_t = xs
+        # vs_minus_v carries vs_{t+1} - V(x_{t+1}); disc_t is already 0
+        # across episode boundaries, so the recursion resets there.
+        acc = delta_t + disc_t * c_t * carry
+        return acc, acc
+
+    _, acc_rev = jax.lax.scan(
+        scan_fn, jnp.zeros_like(bootstrap_value, jnp.float32),
+        (deltas[::-1], discounts[::-1], cs[::-1]))
+    vs_minus_v = acc_rev[::-1]
+    vs = values + vs_minus_v
+
+    next_vs = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
+    pg_advantages = clipped_rhos * (rewards + discounts * next_vs - values)
+    return vs, pg_advantages

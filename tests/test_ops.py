@@ -49,6 +49,22 @@ class TestFlashAttention:
         for a, b in zip(g1, g2):
             np.testing.assert_allclose(a, b, atol=2e-5, rtol=2e-5)
 
+    def test_grad_matches_gqa(self):
+        """dK/dV accumulation over the query-head group (the
+        `hkv*g + j//nq` index maps in _dkv_kernel) vs the reference."""
+        q, k, v = _qkv(s=64, h=4, hkv=2)
+
+        def f_flash(q, k, v):
+            return flash_attention(q, k, v, None, True, 32, 32).sum()
+
+        def f_ref(q, k, v):
+            return reference_attention(q, k, v, causal=True).sum()
+
+        g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, atol=2e-5, rtol=2e-5)
+
     def test_dispatcher_on_cpu(self):
         q, k, v = _qkv(s=64)
         out = attention(q, k, v, causal=True)

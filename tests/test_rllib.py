@@ -1,0 +1,300 @@
+"""RLlib tests: advantage estimators, replay buffers, algorithms
+end-to-end (PPO solves CartPole — the VERDICT r1 acceptance bar), async
+IMPALA over runner actors, checkpoint save/restore, and Tune integration.
+Mirrors the reference's per-algorithm `tests/` dirs + `rllib/tests/`."""
+
+import numpy as np
+import pytest
+
+
+# ------------------------------------------------------------- estimators
+
+
+class TestAdvantages:
+    def test_gae_matches_numpy(self):
+        from ray_tpu.rllib.utils import compute_gae
+
+        rng = np.random.default_rng(0)
+        T, B = 12, 3
+        gamma, lam = 0.97, 0.9
+        rewards = rng.normal(size=(T, B)).astype(np.float32)
+        values = rng.normal(size=(T, B)).astype(np.float32)
+        boot = rng.normal(size=(B,)).astype(np.float32)
+        term = rng.random((T, B)) < 0.1
+        trunc = rng.random((T, B)) < 0.05
+
+        adv, tgt = compute_gae(rewards, values, boot, term, trunc,
+                               gamma=gamma, lam=lam)
+        adv, tgt = np.asarray(adv), np.asarray(tgt)
+
+        done = term | trunc
+        expect = np.zeros((T, B))
+        carry = np.zeros(B)
+        nv = np.concatenate([values[1:], boot[None]], axis=0)
+        for t in reversed(range(T)):
+            nd = 1.0 - done[t].astype(np.float64)
+            delta = rewards[t] + gamma * nv[t] * nd - values[t]
+            carry = delta + gamma * lam * nd * carry
+            expect[t] = carry
+        np.testing.assert_allclose(adv, expect, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(tgt, expect + values, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_vtrace_on_policy_is_td_lambda1(self):
+        """With target==behaviour policy and no episode ends, vs equals
+        the full discounted return bootstrap (rho=c=1)."""
+        from ray_tpu.rllib.utils import vtrace_returns
+
+        rng = np.random.default_rng(1)
+        T, B = 10, 2
+        gamma = 0.95
+        logp = rng.normal(size=(T, B)).astype(np.float32)
+        rewards = rng.normal(size=(T, B)).astype(np.float32)
+        values = rng.normal(size=(T, B)).astype(np.float32)
+        boot = rng.normal(size=(B,)).astype(np.float32)
+        zeros = np.zeros((T, B), bool)
+
+        vs, pg = vtrace_returns(logp, logp, rewards, values, boot, zeros,
+                                zeros, gamma=gamma)
+        vs = np.asarray(vs)
+
+        ret = boot.astype(np.float64)
+        expect = np.zeros((T, B))
+        for t in reversed(range(T)):
+            ret = rewards[t] + gamma * ret
+            expect[t] = ret
+        np.testing.assert_allclose(vs, expect, rtol=1e-3, atol=1e-3)
+
+    def test_vtrace_clips_offpolicy_ratios(self):
+        from ray_tpu.rllib.utils import vtrace_returns
+
+        T, B = 6, 1
+        behaviour = np.full((T, B), -5.0, np.float32)  # target >> behaviour
+        target = np.zeros((T, B), np.float32)
+        rewards = np.ones((T, B), np.float32)
+        values = np.zeros((T, B), np.float32)
+        boot = np.zeros((B,), np.float32)
+        zeros = np.zeros((T, B), bool)
+        vs, pg = vtrace_returns(behaviour, target, rewards, values, boot,
+                                zeros, zeros, gamma=0.9, clip_rho=1.0,
+                                clip_c=1.0)
+        # with clipping at 1, identical to on-policy result
+        vs2, _ = vtrace_returns(target, target, rewards, values, boot,
+                                zeros, zeros, gamma=0.9)
+        np.testing.assert_allclose(np.asarray(vs), np.asarray(vs2),
+                                   rtol=1e-4)
+
+
+# ----------------------------------------------------------- replay buffers
+
+
+class TestReplayBuffers:
+    def test_fifo_wraparound(self):
+        from ray_tpu.rllib.utils import ReplayBuffer
+
+        buf = ReplayBuffer(capacity=10, seed=0)
+        buf.add({"x": np.arange(8), "y": np.arange(8) * 2.0})
+        assert len(buf) == 8
+        buf.add({"x": np.arange(8, 14), "y": np.arange(8, 14) * 2.0})
+        assert len(buf) == 10
+        batch = buf.sample(64)
+        assert set(batch) == {"x", "y"}
+        # rows stay consistent across columns
+        np.testing.assert_allclose(batch["y"], batch["x"] * 2.0)
+        # oldest rows (0..3) were overwritten
+        assert batch["x"].min() >= 4
+
+    def test_prioritized_bias_and_weights(self):
+        from ray_tpu.rllib.utils import PrioritizedReplayBuffer
+
+        buf = PrioritizedReplayBuffer(capacity=100, alpha=1.0, seed=0)
+        buf.add({"x": np.arange(100)})
+        # push row 7's priority way up
+        buf.update_priorities(np.array([7]), np.array([1000.0]))
+        batch = buf.sample(500, beta=1.0)
+        counts = np.bincount(batch["x"], minlength=100)
+        assert counts[7] > 300  # dominates sampling
+        assert batch["weights"].min() > 0
+        assert batch["weights"].max() <= 1.0 + 1e-6
+        # high-priority rows get the smallest IS weights
+        assert (batch["weights"][batch["x"] == 7].mean()
+                < batch["weights"][batch["x"] != 7].mean())
+
+    def test_state_roundtrip(self):
+        from ray_tpu.rllib.utils import ReplayBuffer
+
+        buf = ReplayBuffer(capacity=16, seed=0)
+        buf.add({"x": np.arange(5)})
+        buf2 = ReplayBuffer(capacity=16, seed=1)
+        buf2.set_state(buf.get_state())
+        assert len(buf2) == 5
+        assert set(buf2.sample(10)["x"]) <= set(range(5))
+
+
+# -------------------------------------------------------------- algorithms
+
+
+def _ppo_config(**training):
+    from ray_tpu.rllib import PPOConfig
+
+    kw = dict(num_epochs=8, minibatch_size=256, lr=3e-4,
+              entropy_coeff=0.01)
+    kw.update(training)
+    return (PPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=0, num_envs_per_env_runner=8,
+                         rollout_fragment_length=128)
+            .training(**kw)
+            .debugging(seed=0))
+
+
+class TestPPO:
+    def test_solves_cartpole(self):
+        """The VERDICT r1 bar: reward >= 450 (local mode, pure JAX)."""
+        algo = _ppo_config().build()
+        try:
+            best = 0.0
+            for _ in range(120):
+                r = algo.train()
+                ret = r.get("episode_return_mean")
+                if ret is not None:
+                    best = max(best, ret)
+                if best >= 450:
+                    break
+            assert best >= 450, f"best return {best}"
+        finally:
+            algo.stop()
+
+    def test_checkpoint_roundtrip(self, tmp_path):
+        import jax
+
+        from ray_tpu.rllib import PPO
+
+        algo = _ppo_config(num_epochs=1).build()
+        try:
+            algo.train()
+            ckpt = algo.save_to_checkpoint(str(tmp_path / "ck"))
+            w0 = algo.learner_group.get_weights()
+            it0 = algo.iteration
+        finally:
+            algo.stop()
+
+        algo2 = PPO.from_checkpoint(ckpt)
+        try:
+            assert algo2.iteration == it0
+            w1 = algo2.learner_group.get_weights()
+            for a, b in zip(jax.tree.leaves(w0), jax.tree.leaves(w1)):
+                np.testing.assert_allclose(a, b)
+            algo2.train()  # still trains after restore
+        finally:
+            algo2.stop()
+
+    def test_under_tuner(self, ray_init, tmp_path):
+        from ray_tpu.air.config import RunConfig
+        from ray_tpu.tune import TuneConfig, Tuner
+
+        trainable = _ppo_config(num_epochs=1).to_trainable(
+            checkpoint_every=2)
+        tuner = Tuner(
+            trainable,
+            tune_config=TuneConfig(metric="episode_return_mean",
+                                   mode="max"),
+            run_config=RunConfig(
+                name="ppo_tune", storage_path=str(tmp_path),
+                stop={"training_iteration": 3}),
+        )
+        results = tuner.fit()
+        assert results.errors == []
+        best = results.get_best_result()
+        assert best.metrics["training_iteration"] >= 3
+        assert best.checkpoint is not None
+
+
+class TestIMPALA:
+    def test_learns_cartpole_local(self):
+        from ray_tpu.rllib import IMPALAConfig
+
+        algo = (IMPALAConfig()
+                .environment("CartPole-v1")
+                .env_runners(num_env_runners=0,
+                             num_envs_per_env_runner=8,
+                             rollout_fragment_length=64)
+                .training(num_batches_per_iteration=8,
+                          entropy_coeff=0.005)
+                .debugging(seed=0)
+                .build())
+        try:
+            best = 0.0
+            for _ in range(60):
+                r = algo.train()
+                ret = r.get("episode_return_mean")
+                if ret is not None:
+                    best = max(best, ret)
+                if best >= 150:
+                    break
+            # async off-policy on CPU: the bar is clear learning progress
+            assert best >= 150, f"best return {best}"
+        finally:
+            algo.stop()
+
+    def test_async_over_runner_actors(self, ray_init):
+        from ray_tpu.rllib import IMPALAConfig
+
+        algo = (IMPALAConfig()
+                .environment("CartPole-v1")
+                .env_runners(num_env_runners=2,
+                             num_envs_per_env_runner=2,
+                             rollout_fragment_length=16)
+                .training(num_batches_per_iteration=4)
+                .debugging(seed=0)
+                .build())
+        try:
+            r1 = algo.train()
+            r2 = algo.train()
+            assert r2["num_env_steps_sampled_lifetime"] > \
+                r1["num_env_steps_sampled_lifetime"] > 0
+            assert np.isfinite(r2["policy_loss"])
+            # in-flight pipeline keeps every runner saturated
+            assert len(algo._inflight) >= 2
+        finally:
+            algo.stop()
+
+
+class TestDQN:
+    def test_learns_cartpole(self):
+        from ray_tpu.rllib import DQNConfig
+
+        algo = (DQNConfig()
+                .environment("CartPole-v1")
+                .env_runners(num_env_runners=0,
+                             num_envs_per_env_runner=4,
+                             rollout_fragment_length=16)
+                .training(prioritized_replay=True)
+                .debugging(seed=0)
+                .build())
+        try:
+            best = 0.0
+            for _ in range(250):
+                r = algo.train()
+                ret = r.get("episode_return_mean")
+                if ret is not None:
+                    best = max(best, ret)
+                if best >= 130:
+                    break
+            assert best >= 130, f"best return {best}"
+        finally:
+            algo.stop()
+
+
+class TestConfigValidation:
+    def test_unknown_setting_raises(self):
+        from ray_tpu.rllib import PPOConfig
+
+        with pytest.raises(AttributeError):
+            PPOConfig().training(lr_schedule=[1, 2])
+
+    def test_build_requires_env(self):
+        from ray_tpu.rllib import PPOConfig
+
+        with pytest.raises(AssertionError):
+            PPOConfig().build()
