@@ -83,6 +83,7 @@ class _QueuedLease:
     future: asyncio.Future
     demand: ResourceSet
     pg_key: Optional[Tuple[str, int]]
+    hops: int = 0
 
 
 class Supervisor:
@@ -137,6 +138,11 @@ class Supervisor:
         self.leases: Dict[int, Lease] = {}
         self._next_lease_id = 0
         self._lease_queue: Deque[_QueuedLease] = deque()
+        # Leases no node in the current view can satisfy. Kept pending (the
+        # reference's infeasible queue, cluster_task_manager.h) and
+        # re-evaluated when the gossiped view changes — a joining node (or
+        # later, an autoscaled one) rescues them via spillback redirect.
+        self._infeasible_leases: List[_QueuedLease] = []
         # placement group bundles: (pg_hex, index) -> [reserved_total, bundle_available]
         self.bundles: Dict[Tuple[str, int], List[ResourceSet]] = {}
         # cluster view cache (synced from controller)
@@ -219,6 +225,13 @@ class Supervisor:
                         "node_id_hex": self.node_id.hex(),
                         "available": dict(self.available),
                         "store_stats": self.store.stats(),
+                        # pending demand feeds the autoscaler's bin-packing
+                        "pending_demand": [
+                            dict(q.demand)
+                            for q in list(self._lease_queue)
+                            + self._infeasible_leases
+                            if not q.future.done()
+                        ],
                     },
                     timeout=5,
                 )
@@ -234,9 +247,42 @@ class Supervisor:
                     )
                     for v in views
                 ]
+                self._reevaluate_infeasible()
             except Exception as e:
                 logger.debug("sync failed: %s", e)
             await asyncio.sleep(0.2)
+
+    def _reevaluate_infeasible(self) -> None:
+        """Rescue parked leases once the view offers a feasible node."""
+        if not self._infeasible_leases:
+            return
+        still: List[_QueuedLease] = []
+        for q in self._infeasible_leases:
+            if q.future.done():
+                continue
+            if self._feasible(q.demand, q.pg_key):
+                self._lease_queue.append(q)
+                self._pump_lease_queue()
+                continue
+            chosen = None
+            if q.hops < MAX_SPILLBACK_HOPS:
+                chosen = pick_node(
+                    self.cluster_view,
+                    dict(q.demand),
+                    q.spec.strategy,
+                    local_node_hex=self.node_id.hex(),
+                    spread_threshold=self.config.scheduler_spread_threshold,
+                )
+            if chosen is not None and \
+                    chosen.node_id_hex != self.node_id.hex():
+                q.future.set_result({
+                    "granted": False,
+                    "retry_at": chosen.address,
+                    "hops": q.hops + 1,
+                })
+            else:
+                still.append(q)
+        self._infeasible_leases = still
 
     # ------------------------------------------------------------- leases
 
@@ -276,13 +322,20 @@ class Supervisor:
                 }
 
         if not self._feasible(demand, pg_key):
-            return {
-                "granted": False,
-                "error": f"infeasible demand {dict(demand)} on node "
-                f"{self.node_id.hex()[:8]} (total={dict(self.total)})",
-            }
+            # No error: park it (reference keeps an infeasible queue and
+            # warns, cluster_task_manager). A node that can host it may
+            # join / sync in later; until then the demand is advertised to
+            # the controller for the autoscaler.
+            logger.warning(
+                "infeasible demand %s on node %s (total=%s) — queued until "
+                "the cluster view offers a feasible node",
+                dict(demand), self.node_id.hex()[:8], dict(self.total))
+            fut = asyncio.get_running_loop().create_future()
+            self._infeasible_leases.append(
+                _QueuedLease(spec, fut, demand, pg_key, hops))
+            return await fut
 
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        fut = asyncio.get_running_loop().create_future()
         self._lease_queue.append(_QueuedLease(spec, fut, demand, pg_key))
         self._pump_lease_queue()
         return await fut

@@ -1,0 +1,285 @@
+"""Multi-node integration + chaos tests over real process boundaries.
+
+The reference's core test pattern (SURVEY §4): a real controller + N real
+supervisor processes on one host via `Cluster` (`cluster_utils.py:135`
+analog), node death = hard-killing a supervisor (NodeKiller chaos actor,
+`python/ray/_private/test_utils.py:1497` analog). These exercise the
+paths VERDICT r1 flagged untested: lease spillback
+(`supervisor.py rpc_request_lease`), cross-node pull
+(`supervisor.py rpc_pull_object`), actor restart on node death
+(`controller.py _restart_actor`), and PG (re)scheduling.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util.placement_group import (placement_group,
+                                          placement_group_table,
+                                          remove_placement_group)
+
+
+def _wait_for(pred, timeout=30.0, interval=0.2, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise TimeoutError(f"timed out waiting for {msg}")
+
+
+@pytest.fixture
+def two_node_cluster(ray_cluster):
+    """Two 2-CPU nodes with distinguishing custom resources."""
+    ray_cluster.add_node(num_cpus=2, resources={"nodeA": 10})
+    ray_cluster.add_node(num_cpus=2, resources={"nodeB": 10})
+    ray_cluster.wait_for_nodes(2)
+    ray_tpu.init(address=ray_cluster.address)
+    yield ray_cluster
+
+
+@ray_tpu.remote
+def _whoami():
+    return ray_tpu.get_runtime_context().node_id
+
+
+@ray_tpu.remote
+def _make_array(n):
+    return np.arange(n, dtype=np.float64)
+
+
+@ray_tpu.remote
+def _double(x):
+    return x * 2
+
+
+class TestCrossNode:
+    def test_tasks_spread_across_nodes(self, two_node_cluster):
+        a = ray_tpu.get(_whoami.options(resources={"nodeA": 1}).remote())
+        b = ray_tpu.get(_whoami.options(resources={"nodeB": 1}).remote())
+        assert a != b
+
+    def test_cross_node_object_pull(self, two_node_cluster):
+        # SHARED-size object created on node A, consumed on node B —
+        # exercises owner lookup + chunked pull (supervisor.py
+        # rpc_pull_object / core_worker _get_remote)
+        ref = _make_array.options(resources={"nodeA": 1}).remote(300_000)
+        out = ray_tpu.get(
+            _double.options(resources={"nodeB": 1}).remote(ref))
+        assert out.shape == (300_000,)
+        np.testing.assert_allclose(out[:5], [0, 2, 4, 6, 8])
+
+    def test_lease_spillback(self, two_node_cluster):
+        # 8 concurrent 2s tasks on 2+2 CPUs: the preferred node fills,
+        # the supervisor answers leases with spillback redirects
+        @ray_tpu.remote
+        def hold():
+            time.sleep(1.0)
+            return ray_tpu.get_runtime_context().node_id
+
+        nodes = set(ray_tpu.get([hold.remote() for _ in range(8)]))
+        assert len(nodes) == 2, f"spillback never spread load: {nodes}"
+
+    def test_wait_across_nodes(self, two_node_cluster):
+        @ray_tpu.remote
+        def slow(t):
+            time.sleep(t)
+            return t
+
+        fast = slow.options(resources={"nodeA": 1}).remote(0.1)
+        slow_ref = slow.options(resources={"nodeB": 1}).remote(5.0)
+        ready, pending = ray_tpu.wait([fast, slow_ref], num_returns=1,
+                                      timeout=10)
+        assert ready == [fast] and pending == [slow_ref]
+
+
+class TestPlacementGroups:
+    def test_strict_spread_lands_on_distinct_nodes(self, two_node_cluster):
+        pg = placement_group([{"CPU": 1}, {"CPU": 1}],
+                             strategy="STRICT_SPREAD")
+        ray_tpu.get(pg.ready(), timeout=15)
+
+        @ray_tpu.remote
+        class Probe:
+            def node(self):
+                return ray_tpu.get_runtime_context().node_id
+
+        probes = [
+            Probe.options(placement_group=pg,
+                          placement_group_bundle_index=i,
+                          num_cpus=1).remote()
+            for i in range(2)
+        ]
+        nodes = ray_tpu.get([p.node.remote() for p in probes])
+        assert nodes[0] != nodes[1]
+        for p in probes:
+            ray_tpu.kill(p)
+        remove_placement_group(pg)
+
+    def test_strict_spread_unsatisfiable_pends(self, two_node_cluster):
+        pg = placement_group([{"CPU": 1}] * 3, strategy="STRICT_SPREAD")
+        with pytest.raises(Exception):
+            ray_tpu.get(pg.ready(), timeout=2)
+        remove_placement_group(pg)
+
+
+class TestNodeFailure:
+    def test_actor_restart_on_node_death(self, ray_cluster):
+        ray_cluster.add_node(num_cpus=2, resources={"stable": 10})
+        victim = ray_cluster.add_node(num_cpus=2, resources={"doomed": 10})
+        ray_cluster.wait_for_nodes(2)
+        ray_tpu.init(address=ray_cluster.address)
+
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def incr(self):
+                self.n += 1
+                return self.n
+
+            def node(self):
+                return ray_tpu.get_runtime_context().node_id
+
+        # pin to the doomed node but make the resource soft enough that a
+        # restart elsewhere works: restartable actors fall back to any
+        # node once their node is gone only if resources fit — use CPU
+        c = Counter.options(max_restarts=1, num_cpus=1,
+                            resources={"doomed": 1}).remote()
+        assert ray_tpu.get(c.incr.remote()) == 1
+        ray_cluster.remove_node(victim)
+        # a replacement node satisfying the resource comes up
+        ray_cluster.add_node(num_cpus=2, resources={"doomed": 10})
+        ray_cluster.wait_for_nodes(2)
+
+        def alive():
+            try:
+                return ray_tpu.get(c.incr.remote(), timeout=5) >= 1
+            except Exception:
+                return False
+
+        _wait_for(alive, timeout=30, msg="actor restart")
+        # restarted from scratch (state lost, fresh counter)
+        n = ray_tpu.get(c.incr.remote())
+        assert n >= 1
+
+    def test_actor_without_restarts_dies(self, ray_cluster):
+        ray_cluster.add_node(num_cpus=2)
+        victim = ray_cluster.add_node(num_cpus=2, resources={"doomed": 10})
+        ray_cluster.wait_for_nodes(2)
+        ray_tpu.init(address=ray_cluster.address)
+
+        @ray_tpu.remote
+        class A:
+            def ping(self):
+                return "pong"
+
+        a = A.options(resources={"doomed": 1}).remote()
+        assert ray_tpu.get(a.ping.remote()) == "pong"
+        ray_cluster.remove_node(victim)
+        with pytest.raises(Exception):
+            # dies and never comes back: calls must fail, not hang
+            ray_tpu.get(a.ping.remote(), timeout=30)
+
+    def test_task_retry_survives_node_death(self, ray_cluster):
+        ray_cluster.add_node(num_cpus=2)
+        victim = ray_cluster.add_node(num_cpus=2, resources={"doomed": 10})
+        ray_cluster.wait_for_nodes(2)
+        ray_tpu.init(address=ray_cluster.address)
+
+        @ray_tpu.remote
+        def slow_then_id():
+            time.sleep(3)
+            return ray_tpu.get_runtime_context().node_id
+
+        # prefers the doomed node; after it dies the retry must land on
+        # the surviving node (max_retries default)
+        ref = slow_then_id.options(
+            scheduling_strategy="SPREAD").remote()
+        refs = [slow_then_id.remote() for _ in range(4)]
+        time.sleep(0.5)  # let tasks start on both nodes
+        ray_cluster.remove_node(victim)
+        out = ray_tpu.get([ref] + refs, timeout=60)
+        assert len(out) == 5
+
+    def test_pg_reschedules_after_node_death(self, ray_cluster):
+        ray_cluster.add_node(num_cpus=2)
+        victim = ray_cluster.add_node(num_cpus=2)
+        ray_cluster.wait_for_nodes(2)
+        ray_tpu.init(address=ray_cluster.address)
+
+        pg = placement_group([{"CPU": 1}, {"CPU": 1}],
+                             strategy="STRICT_SPREAD")
+        ray_tpu.get(pg.ready(), timeout=15)
+        ray_cluster.remove_node(victim)
+        replacement = ray_cluster.add_node(num_cpus=2)
+        ray_cluster.wait_for_nodes(2)
+
+        def replaced():
+            for rec in placement_group_table():
+                if rec["placement_group_id"] == pg.id and \
+                        rec["state"] == "CREATED":
+                    return True
+            return False
+
+        _wait_for(replaced, timeout=30, msg="PG reschedule")
+
+
+class TestChaosTraining:
+    def test_train_survives_node_killer(self, ray_cluster):
+        """NodeKiller chaos during a DataParallelTrainer run with
+        FailureConfig retries — the reference's chaos-test pattern."""
+        ray_cluster.add_node(num_cpus=4)  # stable home for train workers
+        doomed = ray_cluster.add_node(num_cpus=2, name="victim")
+        ray_cluster.wait_for_nodes(2)
+        ray_tpu.init(address=ray_cluster.address)
+
+        from ray_tpu.air.config import (FailureConfig, RunConfig,
+                                        ScalingConfig)
+        from ray_tpu.train import DataParallelTrainer
+        from ray_tpu.train._internal.session import get_session
+
+        def loop():
+            sess = get_session()
+            start = 0
+            ckpt = sess.get_checkpoint()
+            if ckpt is not None:
+                start = int(ckpt.get_metadata().get("step", 0))
+            for step in range(start, 6):
+                time.sleep(0.3)
+                from ray_tpu.train._checkpoint import Checkpoint
+                import tempfile
+
+                d = tempfile.mkdtemp()
+                c = Checkpoint(d)
+                c.set_metadata({"step": step + 1})
+                sess.report({"step": step}, checkpoint=c)
+
+        import tempfile
+
+        trainer = DataParallelTrainer(
+            loop,
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(
+                name="chaos",
+                storage_path=tempfile.mkdtemp(),
+                failure_config=FailureConfig(max_failures=3),
+            ),
+        )
+        # kill the victim node mid-run from the driver side
+        import threading
+
+        def killer():
+            time.sleep(1.0)
+            ray_cluster.remove_node(doomed)
+
+        t = threading.Thread(target=killer, daemon=True)
+        t.start()
+        result = trainer.fit()
+        t.join()
+        assert result.error is None
+        assert result.metrics["step"] == 5
