@@ -370,12 +370,26 @@ class CoreWorker:
         try:
             target = await self._lease_target(spec)
             hops = 0
+            conn_failures = 0
             while True:
-                grant = await self.clients.get(target).call(
-                    "request_lease",
-                    {"spec": serialization.dumps(spec), "hops": hops},
-                    timeout=self.config.worker_lease_timeout_s + 3600,
-                )
+                try:
+                    grant = await self.clients.get(target).call(
+                        "request_lease",
+                        {"spec": serialization.dumps(spec), "hops": hops},
+                        timeout=self.config.worker_lease_timeout_s + 3600,
+                    )
+                except RpcConnectionError:
+                    # The target supervisor died mid-request. The lease never
+                    # granted, so retrying elsewhere is always safe — wait out
+                    # failure detection and re-resolve to an alive node
+                    # (≈ lease retry on raylet death, direct_task_transport).
+                    conn_failures += 1
+                    if conn_failures > 30:
+                        raise
+                    await asyncio.sleep(0.3)
+                    target = await self._alive_lease_target(spec, exclude=target)
+                    hops = 0
+                    continue
                 if grant.get("granted"):
                     lease = _Lease(
                         lease_id=grant["lease_id"],
@@ -408,13 +422,43 @@ class CoreWorker:
         if lease.in_flight == 0 and not self._task_queues.get(shape):
             asyncio.get_running_loop().create_task(self._maybe_release(lease))
 
+    async def _alive_lease_target(
+        self, spec: TaskSpec, exclude: Optional[Address] = None
+    ) -> Address:
+        """Re-resolve a lease target after a supervisor connection failure:
+        prefer the usual target if the controller still lists it alive,
+        else any alive node that isn't the one that just failed."""
+        usual = await self._lease_target(spec)
+        if isinstance(spec.strategy, PlacementGroupStrategy):
+            # Only the node holding the bundle can grant this lease; an
+            # arbitrary alive node would reject it terminally. _lease_target
+            # already waits out re-placement of the group.
+            return usual
+        views = await self.clients.get(self.controller_addr).call("node_views")
+        alive = {tuple(v["address"]) for v in views if v["alive"]}
+        if usual in alive and usual != tuple(exclude or ()):
+            return usual
+        for addr in alive:
+            if addr != tuple(exclude or ()):
+                return addr
+        return usual  # nothing better known; retry the usual target
+
     async def _lease_target(self, spec: TaskSpec) -> Address:
         if isinstance(spec.strategy, PlacementGroupStrategy):
-            pg = await self.clients.get(self.controller_addr).call(
-                "pg_get", {"pg_id_hex": spec.strategy.pg_id_hex}
-            )
-            if pg is None or pg["state"] != "CREATED":
-                raise RuntimeError("placement group not ready")
+            # A task on a PENDING group waits for placement rather than
+            # failing (reference semantics: tasks queue on the pg and run
+            # once bundles reserve). REMOVED is terminal.
+            delay = 0.05
+            while True:
+                pg = await self.clients.get(self.controller_addr).call(
+                    "pg_get", {"pg_id_hex": spec.strategy.pg_id_hex}
+                )
+                if pg is None or pg["state"] == "REMOVED":
+                    raise RuntimeError("placement group removed")
+                if pg["state"] == "CREATED":
+                    break
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, 0.2)
             index = spec.strategy.bundle_index
             if index < 0:
                 index = 0
@@ -936,12 +980,24 @@ class CoreWorker:
         try:
             target = await self._lease_target(spec)
             hops = 0
+            conn_failures = 0
             while True:
-                grant = await self.clients.get(target).call(
-                    "request_lease",
-                    {"spec": serialization.dumps(spec), "hops": hops},
-                    timeout=self.config.worker_lease_timeout_s + 3600,
-                )
+                try:
+                    grant = await self.clients.get(target).call(
+                        "request_lease",
+                        {"spec": serialization.dumps(spec), "hops": hops},
+                        timeout=self.config.worker_lease_timeout_s + 3600,
+                    )
+                except RpcConnectionError:
+                    # same reasoning as _request_lease: an ungranted lease is
+                    # always safe to retry on another (alive) supervisor
+                    conn_failures += 1
+                    if conn_failures > 30:
+                        raise
+                    await asyncio.sleep(0.3)
+                    target = await self._alive_lease_target(spec, exclude=target)
+                    hops = 0
+                    continue
                 if grant.get("granted"):
                     break
                 if grant.get("retry_at"):

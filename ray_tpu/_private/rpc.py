@@ -111,15 +111,19 @@ class RpcServer:
         return (self._host, self._port)
 
     async def stop(self) -> None:
+        # Close live connections BEFORE wait_closed(): since 3.12,
+        # Server.wait_closed() waits for every connection handler to finish,
+        # and our handlers sit in read loops until the peer (or we) close.
         if self._server is not None:
             self._server.close()
-            try:
-                await self._server.wait_closed()
-            except Exception:
-                pass
         for w in list(self._conns):
             try:
                 w.close()
+            except Exception:
+                pass
+        if self._server is not None:
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), timeout=2)
             except Exception:
                 pass
 
@@ -272,7 +276,20 @@ class RpcClient:
             self._reader = None
 
     async def call(self, method: str, body: Any = None, timeout: float | None = None) -> Any:
-        await self._ensure_connected()
+        # one deadline covers connect + request (a 2s call must not ride a
+        # 10s connect-retry window to a dead peer, nor get a fresh 2s after
+        # a 1.9s connect)
+        budget = timeout if timeout is not None else self._request_timeout
+        deadline = time.monotonic() + budget
+        if timeout is not None:
+            try:
+                await asyncio.wait_for(self._ensure_connected(), timeout=budget)
+            except asyncio.TimeoutError as e:
+                raise RpcConnectionError(
+                    f"cannot connect to {self._addr} within {timeout}s"
+                ) from e
+        else:
+            await self._ensure_connected()
         self._next_id += 1
         msg_id = self._next_id
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
@@ -281,7 +298,7 @@ class RpcClient:
         try:
             await self._writer.drain()
             return await asyncio.wait_for(
-                fut, timeout if timeout is not None else self._request_timeout
+                fut, max(0.05, deadline - time.monotonic())
             )
         except asyncio.TimeoutError as e:
             self._pending.pop(msg_id, None)

@@ -84,6 +84,7 @@ class _QueuedLease:
     demand: ResourceSet
     pg_key: Optional[Tuple[str, int]]
     hops: int = 0
+    no_spillback: bool = False  # controller-directed placement: never redirect
 
 
 class Supervisor:
@@ -248,9 +249,34 @@ class Supervisor:
                     for v in views
                 ]
                 self._reevaluate_infeasible()
+                self._reevaluate_queued()
             except Exception as e:
                 logger.debug("sync failed: %s", e)
             await asyncio.sleep(0.2)
+
+    def _try_spill(self, q: _QueuedLease, candidates: List[NodeView]) -> bool:
+        """Redirect a queued lease to a remote node if policy picks one.
+
+        Single site for the spillback decision shared by the infeasible and
+        queued re-evaluation paths. Returns True if the lease was answered
+        with a redirect.
+        """
+        if q.no_spillback or q.pg_key is not None or q.hops >= MAX_SPILLBACK_HOPS:
+            return False
+        chosen = pick_node(
+            candidates,
+            dict(q.demand),
+            q.spec.strategy,
+            local_node_hex=self.node_id.hex(),
+            spread_threshold=self.config.scheduler_spread_threshold,
+        )
+        if chosen is None or chosen.node_id_hex == self.node_id.hex():
+            return False
+        _trace(f"spill {q.spec.name} -> {chosen.node_id_hex[:6]} hops={q.hops + 1}")
+        q.future.set_result(
+            {"granted": False, "retry_at": chosen.address, "hops": q.hops + 1}
+        )
+        return True
 
     def _reevaluate_infeasible(self) -> None:
         """Rescue parked leases once the view offers a feasible node."""
@@ -264,25 +290,40 @@ class Supervisor:
                 self._lease_queue.append(q)
                 self._pump_lease_queue()
                 continue
-            chosen = None
-            if q.hops < MAX_SPILLBACK_HOPS:
-                chosen = pick_node(
-                    self.cluster_view,
-                    dict(q.demand),
-                    q.spec.strategy,
-                    local_node_hex=self.node_id.hex(),
-                    spread_threshold=self.config.scheduler_spread_threshold,
-                )
-            if chosen is not None and \
-                    chosen.node_id_hex != self.node_id.hex():
-                q.future.set_result({
-                    "granted": False,
-                    "retry_at": chosen.address,
-                    "hops": q.hops + 1,
-                })
-            else:
+            if not self._try_spill(q, list(self.cluster_view)):
                 still.append(q)
         self._infeasible_leases = still
+
+    def _reevaluate_queued(self) -> None:
+        """Spill queued-but-unserved leases to nodes that can run them now.
+
+        A lease that arrived while our cluster view was stale (e.g. a burst
+        right after a node joined) queues locally and would serialize behind
+        running tasks. The reference re-runs its scheduling policy over the
+        queued tasks on every cluster-state change and spills them
+        (ClusterTaskManager::ScheduleAndDispatchTasks); we do the same on
+        each 0.2s view sync: anything we cannot grant from local available
+        redirects to a remote node with capacity right now.
+        """
+        if not self._lease_queue:
+            return
+        keep: Deque[_QueuedLease] = deque()
+        for q in self._lease_queue:
+            if q.future.done():
+                continue
+            if q.pg_key is not None or self._available_for(None).fits(q.demand):
+                keep.append(q)  # grantable locally soon; stay put
+                continue
+            remote = [
+                v
+                for v in self.cluster_view
+                if v.node_id_hex != self.node_id.hex()
+                and v.schedulable_now(q.demand)
+            ]
+            if not (remote and self._try_spill(q, remote)):
+                keep.append(q)
+        self._lease_queue = keep
+        self._pump_lease_queue()
 
     # ------------------------------------------------------------- leases
 
@@ -314,6 +355,10 @@ class Supervisor:
                 local_node_hex=self.node_id.hex(),
                 spread_threshold=self.config.scheduler_spread_threshold,
             )
+            _trace(
+                f"lease {spec.name} hops={hops} "
+                f"chosen={chosen.node_id_hex[:6] if chosen else None}"
+            )
             if chosen is not None and chosen.node_id_hex != self.node_id.hex():
                 return {
                     "granted": False,
@@ -332,11 +377,14 @@ class Supervisor:
                 dict(demand), self.node_id.hex()[:8], dict(self.total))
             fut = asyncio.get_running_loop().create_future()
             self._infeasible_leases.append(
-                _QueuedLease(spec, fut, demand, pg_key, hops))
+                _QueuedLease(spec, fut, demand, pg_key, hops,
+                             no_spillback=no_spillback))
             return await fut
 
         fut = asyncio.get_running_loop().create_future()
-        self._lease_queue.append(_QueuedLease(spec, fut, demand, pg_key))
+        self._lease_queue.append(
+            _QueuedLease(spec, fut, demand, pg_key, hops,
+                         no_spillback=no_spillback))
         self._pump_lease_queue()
         return await fut
 

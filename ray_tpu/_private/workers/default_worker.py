@@ -287,6 +287,57 @@ class Executor:
             pass
 
 
+def _watch_supervisor_liveness(supervisor_pid: int) -> None:
+    """Die with the supervisor (≈ raylet-disconnect suicide,
+    node_manager.cc:1432 / core_worker exiting on raylet socket close).
+
+    The supervisor is our direct parent; when it dies we are reparented
+    (PPID changes). An orphaned worker must not keep serving tasks — the
+    cluster has already declared this node dead, and answering actor calls
+    from beyond the grave breaks node-death semantics.
+    """
+    import time as _time
+
+    while True:
+        if os.getppid() != supervisor_pid:
+            logger.warning("supervisor %d is gone; exiting", supervisor_pid)
+            os._exit(1)
+        _time.sleep(0.25)
+
+
+async def _liveness_bond(supervisor_addr) -> None:
+    """Hold an open socket to the supervisor; exit the moment it closes.
+
+    The PPID watch above is the backstop, but polling loses the race
+    against an in-flight task push — the reference's bond is a *socket*
+    (raylet <-> worker), where the kernel delivers EOF the instant the
+    raylet dies. Same here: a dedicated idle connection to the
+    supervisor's RPC server; EOF or error means the supervisor is gone.
+    """
+    import asyncio as _asyncio
+
+    # Transient connect errors (accept pressure during a worker burst) must
+    # not kill a healthy worker — retry the initial connect; only a
+    # post-connect EOF, or persistent refusal, means the supervisor is gone.
+    for _ in range(40):
+        try:
+            reader, _writer = await _asyncio.open_connection(
+                supervisor_addr[0], supervisor_addr[1]
+            )
+            break
+        except Exception:
+            await _asyncio.sleep(0.25)
+    else:
+        logger.warning("cannot reach supervisor; exiting")
+        os._exit(1)
+    try:
+        await reader.read()  # returns only at EOF
+    except Exception:
+        pass
+    logger.warning("supervisor connection closed; exiting")
+    os._exit(1)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--supervisor", required=True)
@@ -305,6 +356,13 @@ def main() -> None:
     def parse_addr(s):
         host, port = s.rsplit(":", 1)
         return (host, int(port))
+
+    threading.Thread(
+        target=_watch_supervisor_liveness,
+        args=(os.getppid(),),
+        name="supervisor-liveness",
+        daemon=True,
+    ).start()
 
     config = Config.from_env()
     core = CoreWorker(
@@ -335,6 +393,9 @@ def main() -> None:
                 "env_key": os.environ.get("RAY_TPU_WORKER_ENV_KEY", ""),
             },
         )
+    )
+    asyncio.run_coroutine_threadsafe(
+        _liveness_bond(parse_addr(args.supervisor)), core.loop
     )
     logger.info("worker %s registered, serving", core.worker_id.hex()[:8])
     threading.Event().wait()  # serve forever; supervisor kills us

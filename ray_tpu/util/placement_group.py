@@ -32,13 +32,18 @@ class PlacementGroup:
         return len(self.bundle_specs)
 
     def ready(self) -> "api.ObjectRef":
-        """An ObjectRef that resolves when the group is placed (≈ pg.ready())."""
+        """An ObjectRef that resolves when the group is placed (≈ pg.ready()).
+
+        Non-blocking: the probe task pends while the group is PENDING (the
+        lease path waits for placement) and runs once bundles reserve, so
+        ``get(pg.ready(), timeout=...)`` raises GetTimeoutError for an
+        unsatisfiable group instead of stalling here.
+        """
 
         @api.remote(num_cpus=0)
         def _pg_ready_probe():
             return True
 
-        self.wait(timeout=300)
         return _pg_ready_probe.options(
             scheduling_strategy=None,
             placement_group=self,

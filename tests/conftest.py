@@ -18,6 +18,14 @@ if "--xla_force_host_platform_device_count" not in _flags:
     ).strip()
 os.environ.setdefault("RAY_TPU_LOG_LEVEL", "WARNING")
 
+# Fast failure detection for hermetic single-host clusters: production
+# defaults (1s period x 3 misses x 3s timeout) make every node-death test
+# wait ~6-10s. Supervisors also passively refresh liveness via their 0.2s
+# sync, so short probe windows are safe here.
+os.environ.setdefault("RAY_TPU_HEALTH_CHECK_PERIOD_MS", "200")
+os.environ.setdefault("RAY_TPU_HEALTH_CHECK_TIMEOUT_MS", "1000")
+os.environ.setdefault("RAY_TPU_HEALTH_CHECK_FAILURE_THRESHOLD", "3")
+
 try:  # sitecustomize may have imported jax already; redirect it to CPU
     import jax
 

@@ -221,7 +221,7 @@ class TestNodeFailure:
 
         def replaced():
             for rec in placement_group_table():
-                if rec["placement_group_id"] == pg.id and \
+                if rec["pg_id_hex"] == pg.id.hex() and \
                         rec["state"] == "CREATED":
                     return True
             return False
