@@ -31,7 +31,7 @@ import logging
 import os
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu._private import serialization
@@ -50,6 +50,7 @@ from ray_tpu._private.rpc import (
     RpcClient,
     RpcConnectionError,
     RpcServer,
+    RpcTimeoutError,
     RemoteError,
 )
 from ray_tpu._private.task_spec import (
@@ -156,6 +157,13 @@ class CoreWorker:
         self._actor_events: Dict[str, asyncio.Event] = {}
         self._pub_handlers: Dict[str, List[Callable]] = {}
         self._task_events: deque = deque()
+        # lineage: specs of finished tasks whose returns live in node arenas,
+        # kept (bounded by lineage_max_bytes) so a lost SHARED object can be
+        # reconstructed by re-executing its creating task
+        # (≈ ObjectRecoveryManager, object_recovery_manager.h:90 + the
+        # lineage accounting in task_manager.h:215)
+        self._lineage: "OrderedDict[TaskID, Tuple[TaskSpec, int]]" = OrderedDict()
+        self._lineage_bytes = 0
 
         self.loop = asyncio.new_event_loop()
         self._loop_thread = threading.Thread(
@@ -364,47 +372,53 @@ class CoreWorker:
                 self._request_lease(shape, proto_spec)
             )
 
+    async def _lease_with_retry(self, spec: TaskSpec) -> dict:
+        """request_lease following spillback redirects and re-targeting on
+        supervisor connection loss (≈ RequestNewWorkerIfNeeded,
+        direct_task_transport.cc:353,513). An ungranted lease is always safe
+        to retry on another node — wait out failure detection and re-resolve.
+        Returns the grant dict with '_supervisor_addr' set to the granting
+        supervisor."""
+        target = await self._lease_target(spec)
+        hops = 0
+        conn_failures = 0
+        while True:
+            try:
+                grant = await self.clients.get(target).call(
+                    "request_lease",
+                    {"spec": serialization.dumps(spec), "hops": hops},
+                    timeout=self.config.worker_lease_timeout_s + 3600,
+                )
+            except RpcConnectionError:
+                conn_failures += 1
+                if conn_failures > 30:
+                    raise
+                await asyncio.sleep(0.3)
+                target = await self._alive_lease_target(spec, exclude=target)
+                hops = 0
+                continue
+            if grant.get("granted"):
+                grant["_supervisor_addr"] = target
+                return grant
+            if grant.get("retry_at"):
+                target = tuple(grant["retry_at"])
+                hops = grant.get("hops", hops + 1)
+                continue
+            raise RuntimeError(grant.get("error", "lease rejected"))
+
     async def _request_lease(self, shape: str, spec: TaskSpec) -> None:
-        """Lease a worker, following spillback redirects
-        (≈ RequestNewWorkerIfNeeded, direct_task_transport.cc:353,513)."""
+        """Lease a worker for one task of this shape and register it for
+        pipelined dispatch."""
         try:
-            target = await self._lease_target(spec)
-            hops = 0
-            conn_failures = 0
-            while True:
-                try:
-                    grant = await self.clients.get(target).call(
-                        "request_lease",
-                        {"spec": serialization.dumps(spec), "hops": hops},
-                        timeout=self.config.worker_lease_timeout_s + 3600,
-                    )
-                except RpcConnectionError:
-                    # The target supervisor died mid-request. The lease never
-                    # granted, so retrying elsewhere is always safe — wait out
-                    # failure detection and re-resolve to an alive node
-                    # (≈ lease retry on raylet death, direct_task_transport).
-                    conn_failures += 1
-                    if conn_failures > 30:
-                        raise
-                    await asyncio.sleep(0.3)
-                    target = await self._alive_lease_target(spec, exclude=target)
-                    hops = 0
-                    continue
-                if grant.get("granted"):
-                    lease = _Lease(
-                        lease_id=grant["lease_id"],
-                        worker_id_hex=grant["worker_id_hex"],
-                        worker_addr=tuple(grant["worker_address"]),
-                        supervisor_addr=target,
-                        shape_key=shape,
-                    )
-                    self._leases.setdefault(shape, []).append(lease)
-                    break
-                elif grant.get("retry_at"):
-                    target = tuple(grant["retry_at"])
-                    hops = grant.get("hops", hops + 1)
-                else:
-                    raise RuntimeError(grant.get("error", "lease rejected"))
+            grant = await self._lease_with_retry(spec)
+            lease = _Lease(
+                lease_id=grant["lease_id"],
+                worker_id_hex=grant["worker_id_hex"],
+                worker_addr=tuple(grant["worker_address"]),
+                supervisor_addr=grant["_supervisor_addr"],
+                shape_key=shape,
+            )
+            self._leases.setdefault(shape, []).append(lease)
         except Exception as e:
             # fail one queued task of this shape (others will retry leasing)
             queue = self._task_queues.get(shape)
@@ -534,6 +548,7 @@ class CoreWorker:
             if spec is not None:
                 self._fail_task(spec, err)
         else:
+            any_shared = False
             for oid_raw, kind, payload in body["results"]:
                 oid = ObjectID(oid_raw)
                 entry = self._ensure_entry(oid)
@@ -545,9 +560,12 @@ class CoreWorker:
                     entry.state = SHARED
                     entry.size = payload["size"]
                     entry.location = tuple(payload["node_addr"])
+                    any_shared = True
                 self._wake(entry)
             if spec is not None:
                 self._record_event(spec, "FINISHED")
+                if any_shared:
+                    self._record_lineage(spec)
         if task is not None:
             self._inflight_tasks.pop(task_id, None)
             self._unpin_arg_refs(spec)
@@ -557,6 +575,73 @@ class CoreWorker:
                 await self._pump_shape(lease.shape_key, spec)
                 if lease.in_flight == 0 and not self._task_queues.get(lease.shape_key):
                     asyncio.get_running_loop().create_task(self._maybe_release(lease))
+
+    # ------------------------------------------------------------- lineage
+
+    def _record_lineage(self, spec: TaskSpec) -> None:
+        """Retain the spec of a finished task with SHARED returns so the
+        returns can be reconstructed if their node dies. Only stateless
+        NORMAL tasks are re-executable (actor tasks escalate to actor
+        restart / checkpoint restore), and max_retries=0 is the user's
+        opt-out: a task with side effects must never silently re-run."""
+        if (
+            spec.kind != TaskKind.NORMAL
+            or spec.max_retries == 0
+            or self.config.lineage_max_bytes <= 0
+        ):
+            return
+        size = 256 + sum(
+            len(a.value) if a.value is not None else 64 for a in spec.args
+        )
+        prev = self._lineage.pop(spec.task_id, None)
+        if prev is not None:
+            self._lineage_bytes -= prev[1]
+        else:
+            # hold this spec's by-reference args while it sits in lineage:
+            # reconstruction re-executes the task, which needs them resolvable
+            self._pin_arg_refs(spec)
+        self._lineage[spec.task_id] = (spec, size)
+        self._lineage_bytes += size
+        while self._lineage_bytes > self.config.lineage_max_bytes and len(self._lineage) > 1:
+            _, (evicted, sz) = self._lineage.popitem(last=False)
+            self._lineage_bytes -= sz
+            self._unpin_arg_refs(evicted)
+
+    def _try_reconstruct(self, oid: ObjectID) -> bool:
+        """Owner-side object recovery: re-execute the creating task of a
+        lost SHARED object (≈ ObjectRecoveryManager::RecoverObject). Returns
+        False when the lineage was never recorded, evicted past
+        lineage_max_bytes, or the object was a put (not reconstructable)."""
+        if oid.is_put():
+            return False
+        task_id = oid.task_id()
+        if task_id in self._inflight_tasks:
+            return True  # reconstruction already running
+        rec = self._lineage.get(task_id)
+        if rec is None:
+            return False
+        spec, _ = rec
+        _trace(f"reconstruct {spec.name} for {oid.hex()[:12]}")
+        for rid in spec.return_ids():
+            entry = self._ensure_entry(rid)
+            entry.state = PENDING
+            entry.error = None
+            if entry.event is not None:
+                entry.event.clear()
+        self._pin_arg_refs(spec)
+        self._record_event(spec, "RECONSTRUCTING")
+        pending = _PendingTask(spec, retries_left=max(1, spec.max_retries))
+        self._inflight_tasks[spec.task_id] = pending
+        shape = self._shape_key(spec)
+        self._task_queues.setdefault(shape, deque()).append(pending)
+        asyncio.get_running_loop().create_task(self._pump_shape(shape, spec))
+        return True
+
+    async def rpc_object_lost(self, body) -> bool:
+        """A borrower failed to read one of our SHARED objects (its node is
+        gone). Kick off reconstruction; the borrower keeps polling
+        get_object and sees PENDING until the re-execution lands."""
+        return self._try_reconstruct(ObjectID(body["object_id"]))
 
     async def _maybe_release(self, lease: _Lease) -> None:
         await asyncio.sleep(1.0)  # linger for reuse
@@ -733,23 +818,39 @@ class CoreWorker:
 
     async def _get_owned(self, oid: ObjectID, deadline) -> Any:
         entry = self._ensure_entry(oid)
-        while entry.state == PENDING:
-            entry.event.clear()
+        lost_attempts = 0
+        while True:
+            while entry.state == PENDING:
+                entry.event.clear()
+                try:
+                    await asyncio.wait_for(
+                        entry.event.wait(),
+                        None if deadline is None else max(0.01, deadline - time.monotonic()),
+                    )
+                except asyncio.TimeoutError:
+                    raise GetTimeoutError(f"get timed out for {oid.hex()[:16]}")
+            if entry.state == FAILED:
+                raise entry.error
+            if entry.state == INLINE:
+                return serialization.unpack(self.in_process.get(oid))
             try:
-                await asyncio.wait_for(
-                    entry.event.wait(),
-                    None if deadline is None else max(0.01, deadline - time.monotonic()),
-                )
-            except asyncio.TimeoutError:
-                raise GetTimeoutError(f"get timed out for {oid.hex()[:16]}")
-        if entry.state == FAILED:
-            raise entry.error
-        if entry.state == INLINE:
-            return serialization.unpack(self.in_process.get(oid))
-        return await self._read_shared(oid, entry.size, entry.location)
+                return await self._read_shared(oid, entry.size, entry.location)
+            except (ObjectLostError, RpcConnectionError, RpcTimeoutError, RemoteError) as e:
+                # The node holding the data is gone: reconstruct by
+                # re-executing the creating task from lineage, then loop
+                # (entry is PENDING again until the re-execution lands).
+                lost_attempts += 1
+                if lost_attempts > 3 or not self._try_reconstruct(oid):
+                    raise ObjectLostError(
+                        oid.hex(),
+                        f"object lost and not reconstructable "
+                        f"(lineage evicted, a put, or {lost_attempts} failed "
+                        f"reconstruction attempts): {e}",
+                    ) from e
 
     async def _get_remote(self, oid: ObjectID, owner: Address, deadline) -> Any:
         delay = 0.005
+        lost_attempts = 0
         while True:
             try:
                 r = await self.clients.get(owner).call(
@@ -761,7 +862,31 @@ class CoreWorker:
             if status == "value":
                 return serialization.unpack(r["value"])
             if status == "location":
-                return await self._read_shared(oid, r["size"], tuple(r["node_addr"]))
+                try:
+                    return await self._read_shared(oid, r["size"], tuple(r["node_addr"]))
+                except (ObjectLostError, RpcConnectionError, RpcTimeoutError, RemoteError) as e:
+                    # data node died: ask the owner to reconstruct, then keep
+                    # polling (owner reports PENDING while re-executing)
+                    lost_attempts += 1
+                    if lost_attempts > 3:
+                        raise ObjectLostError(
+                            oid.hex(), f"object lost; reconstruction failed: {e}"
+                        ) from e
+                    try:
+                        recoverable = await self.clients.get(owner).call(
+                            "object_lost", {"object_id": oid.binary()}
+                        )
+                    except Exception:
+                        # transient owner hiccup must not fail closed — the
+                        # owner may well be able to reconstruct; retry
+                        await asyncio.sleep(0.1)
+                        continue
+                    if not recoverable:
+                        raise ObjectLostError(
+                            oid.hex(), f"object lost and not reconstructable: {e}"
+                        ) from e
+                    await asyncio.sleep(0.05)
+                    continue
             if status == "error":
                 raise serialization.loads(r["error"])
             if status == "unknown":
@@ -978,33 +1103,8 @@ class CoreWorker:
 
     async def _create_actor_flow(self, spec: TaskSpec, pending: _PendingTask) -> None:
         try:
-            target = await self._lease_target(spec)
-            hops = 0
-            conn_failures = 0
-            while True:
-                try:
-                    grant = await self.clients.get(target).call(
-                        "request_lease",
-                        {"spec": serialization.dumps(spec), "hops": hops},
-                        timeout=self.config.worker_lease_timeout_s + 3600,
-                    )
-                except RpcConnectionError:
-                    # same reasoning as _request_lease: an ungranted lease is
-                    # always safe to retry on another (alive) supervisor
-                    conn_failures += 1
-                    if conn_failures > 30:
-                        raise
-                    await asyncio.sleep(0.3)
-                    target = await self._alive_lease_target(spec, exclude=target)
-                    hops = 0
-                    continue
-                if grant.get("granted"):
-                    break
-                if grant.get("retry_at"):
-                    target = tuple(grant["retry_at"])
-                    hops = grant.get("hops", hops + 1)
-                    continue
-                raise RuntimeError(grant.get("error", "lease rejected"))
+            grant = await self._lease_with_retry(spec)
+            target = grant["_supervisor_addr"]
             await self.clients.get(target).call(
                 "worker_set_actor",
                 {

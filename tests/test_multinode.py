@@ -229,6 +229,67 @@ class TestNodeFailure:
         _wait_for(replaced, timeout=30, msg="PG reschedule")
 
 
+class TestLineageReconstruction:
+    def test_lost_object_reconstructed_by_reexecution(self, ray_cluster):
+        """A SHARED task output whose node dies is reconstructed by
+        re-executing the creating task (ObjectID embeds the TaskID;
+        ≈ object_recovery_manager.h:90)."""
+        ray_cluster.add_node(num_cpus=2)
+        victim = ray_cluster.add_node(num_cpus=2, resources={"doomed": 10})
+        ray_cluster.wait_for_nodes(2)
+        ray_tpu.init(address=ray_cluster.address)
+
+        ref = _make_array.options(resources={"doomed": 1}).remote(300_000)
+        # resolve completion (records lineage) WITHOUT pulling the data to
+        # the driver's node — the only copy stays on the doomed node
+        ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=30)
+        assert ready == [ref]
+        ray_cluster.remove_node(victim)
+        # a replacement that satisfies the task's resources comes up
+        ray_cluster.add_node(num_cpus=2, resources={"doomed": 10})
+        ray_cluster.wait_for_nodes(2)
+
+        out = ray_tpu.get(ref, timeout=60)
+        assert out.shape == (300_000,)
+        np.testing.assert_allclose(out[:4], [0, 1, 2, 3])
+
+    def test_max_retries_zero_opts_out_of_reconstruction(self, ray_cluster):
+        """max_retries=0 marks a task side-effectful: its lost outputs must
+        raise, never silently re-execute."""
+        from ray_tpu._private.exceptions import ObjectLostError
+
+        ray_cluster.add_node(num_cpus=2)
+        victim = ray_cluster.add_node(num_cpus=2, resources={"doomed": 10})
+        ray_cluster.wait_for_nodes(2)
+        ray_tpu.init(address=ray_cluster.address)
+
+        ref = _make_array.options(
+            resources={"doomed": 1}, max_retries=0).remote(300_000)
+        ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=30)
+        assert ready == [ref]
+        ray_cluster.remove_node(victim)
+        with pytest.raises(ObjectLostError):
+            ray_tpu.get(ref, timeout=30)
+
+    def test_lost_object_without_lineage_raises(self, ray_cluster):
+        """With lineage disabled (budget 0 ≈ evicted past lineage_max_bytes)
+        the loss is terminal: ObjectLostError, not a hang."""
+        from ray_tpu._private.exceptions import ObjectLostError
+
+        ray_cluster.add_node(num_cpus=2)
+        victim = ray_cluster.add_node(num_cpus=2, resources={"doomed": 10})
+        ray_cluster.wait_for_nodes(2)
+        ray_tpu.init(address=ray_cluster.address,
+                     _system_config={"lineage_max_bytes": 0})
+
+        ref = _make_array.options(resources={"doomed": 1}).remote(300_000)
+        ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=30)
+        assert ready == [ref]
+        ray_cluster.remove_node(victim)
+        with pytest.raises(ObjectLostError):
+            ray_tpu.get(ref, timeout=30)
+
+
 class TestChaosTraining:
     def test_train_survives_node_killer(self, ray_cluster):
         """NodeKiller chaos during a DataParallelTrainer run with
