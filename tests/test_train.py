@@ -9,7 +9,7 @@ import pytest
 import ray_tpu
 from ray_tpu import train
 from ray_tpu.train import (Checkpoint, CheckpointConfig, DataParallelTrainer,
-                           FailureConfig, JaxTrainer, RunConfig,
+                           FailureConfig, JaxConfig, JaxTrainer, RunConfig,
                            ScalingConfig)
 from ray_tpu.train._internal.checkpoint_manager import CheckpointManager
 from ray_tpu.train._internal.worker_group import WorkerGroup
@@ -245,3 +245,97 @@ class TestCheckpointManager:
         assert os.path.exists(cks[0].path)
         assert os.path.exists(cks[2].path)
         assert not os.path.exists(cks[1].path)
+
+
+class TestJaxDistributed:
+    """Multi-process jax.distributed through the JaxTrainer backend — the
+    v5p multi-host FSDP story de-risked on CPU (VERDICT r2 item 4).
+    Reference analog: torch dist.init_process_group across train workers
+    (python/ray/train/torch/config.py:150), here a jax.distributed runtime
+    rendezvoused by _JaxBackend.on_start (train/backend.py)."""
+
+    def test_two_process_distributed_psum(self, ray_init, storage):
+        def loop():
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+            from jax.sharding import Mesh, NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            from ray_tpu.train._internal.session import get_session
+
+            sess = get_session()
+            assert jax.process_count() == 2, jax.process_count()
+            devs = np.array(jax.devices())  # global: both processes' devices
+            assert len(devs) == 16  # 8 virtual CPU devices per process
+            mesh = Mesh(devs, ("dp",))
+            shard = NamedSharding(mesh, P("dp"))
+            # each device contributes one element == its global index
+            arr = jax.make_array_from_callback(
+                (len(devs),), shard,
+                lambda idx: np.asarray([idx[0].start], dtype=np.float32))
+            # cross-process reduction under GSPMD: sum of 0..15
+            total = jax.jit(
+                jnp.sum, out_shardings=NamedSharding(mesh, P()))(arr)
+            sess.report({
+                "total": float(total),
+                "rank": jax.process_index(),
+                "world": jax.process_count(),
+            })
+
+        t = JaxTrainer(
+            loop,
+            jax_config=JaxConfig(distributed=True),
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(storage_path=storage),
+        )
+        res = t.fit()
+        assert res.error is None
+        assert res.metrics["total"] == sum(range(16))
+        assert res.metrics["world"] == 2
+
+    def test_distributed_worker_kill_recovers(self, ray_init, storage,
+                                              tmp_path):
+        marker = str(tmp_path / "killed-once")
+
+        def loop(config):
+            import os
+
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+            from jax.sharding import Mesh, NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            from ray_tpu.train._internal.session import get_session
+
+            sess = get_session()
+            rank = jax.process_index()
+            # first incarnation: rank 1 dies hard before the collective
+            if rank == 1 and not os.path.exists(config["marker"]):
+                open(config["marker"], "w").close()
+                os._exit(1)
+            devs = np.array(jax.devices())
+            mesh = Mesh(devs, ("dp",))
+            arr = jax.make_array_from_callback(
+                (len(devs),), NamedSharding(mesh, P("dp")),
+                lambda idx: np.ones((1,), dtype=np.float32))
+            total = jax.jit(
+                jnp.sum, out_shardings=NamedSharding(mesh, P()))(arr)
+            sess.report({"total": float(total), "world": jax.process_count()})
+
+        t = JaxTrainer(
+            loop,
+            train_loop_config={"marker": marker},
+            jax_config=JaxConfig(distributed=True),
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(
+                storage_path=storage,
+                failure_config=FailureConfig(max_failures=2),
+            ),
+        )
+        res = t.fit()
+        assert res.error is None
+        assert os.path.exists(marker)  # the kill really happened
+        assert res.metrics["total"] == 16.0
+        assert res.metrics["world"] == 2
