@@ -23,11 +23,27 @@ CONTROLLER_NAME = "SERVE_CONTROLLER"
 
 
 
+class _ReplicaHolder:
+    """One replica plus its lifecycle state (≈ DeploymentReplica in
+    deployment_state.py: STARTING until the first successful health probe,
+    then RUNNING). A STARTING replica is only killed after
+    INIT_TIMEOUT_S — model replicas legitimately take many seconds to
+    construct (worker spawn + framework import + weight init/load), and
+    probing them with the steady-state timeout would replace them forever."""
+
+    INIT_TIMEOUT_S = 120.0
+
+    def __init__(self, handle):
+        self.handle = handle
+        self.created_at = time.time()
+        self.ready = False
+
+
 class _DeploymentState:
     def __init__(self, app_name: str, spec: Dict[str, Any]):
         self.app_name = app_name
         self.spec = spec
-        self.replicas: List[Any] = []  # actor handles
+        self.replicas: List[_ReplicaHolder] = []
         self.version = 0
         self.target = spec["num_replicas"]
         self.status = "UPDATING"
@@ -51,6 +67,14 @@ class ServeController:
         self._routes: Dict[str, str] = {}  # route_prefix -> "app/ingress"
         self._shutdown = False
         self._loop_task = None
+        # long-poll support (≈ python/ray/serve/_private/long_poll.py):
+        # routers hold a listen_for_change call open; any replica-set
+        # version bump wakes them
+        self._change_event = asyncio.Event()
+
+    def _notify_change(self) -> None:
+        self._change_event.set()
+        self._change_event = asyncio.Event()
 
     async def _ensure_loop(self):
         if self._loop_task is None:
@@ -76,6 +100,7 @@ class ServeController:
                 name_state.spec = spec
                 name_state.target = spec["num_replicas"]
                 name_state.version += 1
+                self._notify_change()
             else:
                 app[spec["name"]] = _DeploymentState(app_name, spec)
         if route_prefix:
@@ -109,8 +134,12 @@ class ServeController:
                     continue
                 await self._health_sweep(st)
                 await self._scale_to(st, st.target)
-                st.status = "RUNNING" if len(st.replicas) == st.target \
-                    else "UPDATING"
+                ready = sum(1 for h in st.replicas if h.ready)
+                st.status = "RUNNING" if ready == st.target else "UPDATING"
+
+    @staticmethod
+    def _init_expired(holder: _ReplicaHolder) -> bool:
+        return time.time() - holder.created_at > holder.INIT_TIMEOUT_S
 
     async def _health_sweep(self, st: _DeploymentState):
         # Probe a snapshot, then REMOVE the dead under the lock. Never
@@ -118,22 +147,43 @@ class ServeController:
         # popped a replica mid-probe, and re-assigning would resurrect it.
         snapshot = list(st.replicas)
         dead = []
-        for r in snapshot:
+        for holder in snapshot:
             try:
                 ok = await asyncio.wait_for(
-                    r.check_health.remote(), timeout=5)
-                if not ok:
-                    dead.append(r)
+                    holder.handle.check_health.remote(), timeout=5)
+                if ok:
+                    if not holder.ready:
+                        holder.ready = True
+                        st.version += 1  # routers learn of the new replica
+                        self._notify_change()
+                elif holder.ready or self._init_expired(holder):
+                    logger.warning(
+                        "replica of %s reported unhealthy; replacing", st.name)
+                    dead.append(holder)
             except Exception:
-                logger.warning("replica of %s failed health check; replacing",
-                               st.name)
-                dead.append(r)
+                if holder.ready:
+                    logger.warning(
+                        "replica of %s failed health check; replacing",
+                        st.name)
+                    dead.append(holder)
+                elif self._init_expired(holder):
+                    logger.warning(
+                        "replica of %s never became ready in %.0fs; replacing",
+                        st.name, holder.INIT_TIMEOUT_S)
+                    dead.append(holder)
+                # else: still STARTING — constructor running; leave it be
         if dead:
             async with st.lock:
                 before = len(st.replicas)
-                st.replicas = [r for r in st.replicas if r not in dead]
+                st.replicas = [h for h in st.replicas if h not in dead]
                 if len(st.replicas) != before:
                     st.version += 1
+                    self._notify_change()
+            for h in dead:
+                try:
+                    ray_tpu.kill(h.handle)
+                except Exception:
+                    pass
 
     async def _scale_to(self, st: _DeploymentState, n: int):
         from ray_tpu.serve._private.replica import ReplicaActor
@@ -143,14 +193,16 @@ class ServeController:
 
     async def _scale_to_locked(self, st, n, ReplicaActor):
         while len(st.replicas) > n:
-            r = st.replicas.pop()
+            holder = st.replicas.pop()
             st.version += 1
+            self._notify_change()
             try:
-                await r.prepare_for_shutdown.remote()
+                await asyncio.wait_for(
+                    holder.handle.prepare_for_shutdown.remote(), timeout=15)
             except Exception:
                 pass
             try:
-                ray_tpu.kill(r)
+                ray_tpu.kill(holder.handle)
             except Exception:
                 pass
         spec = st.spec
@@ -164,8 +216,9 @@ class ServeController:
                      spec.get("init_args", ()), spec.get("init_kwargs", {}))
             if spec.get("user_config") is not None:
                 await handle.reconfigure.remote(spec["user_config"])
-            st.replicas.append(handle)
+            st.replicas.append(_ReplicaHolder(handle))
             st.version += 1
+            self._notify_change()
 
     async def _autoscale(self):
         for app in self._apps.values():
@@ -174,10 +227,12 @@ class ServeController:
                 if not cfg:
                     continue
                 stats = []
-                for r in st.replicas:
+                for holder in st.replicas:
+                    if not holder.ready:
+                        continue
                     try:
                         stats.append(await asyncio.wait_for(
-                            r.stats.remote(), timeout=5))
+                            holder.handle.stats.remote(), timeout=5))
                     except Exception:
                         pass
                 if not stats:
@@ -200,8 +255,33 @@ class ServeController:
         st = self._apps.get(app_name, {}).get(deployment_name)
         if st is None:
             return {"version": -1, "replicas": []}
-        return {"version": st.version, "replicas": list(st.replicas),
+        # routers only see READY replicas (reference: RUNNING state), so a
+        # still-initializing model replica never receives traffic
+        return {"version": st.version,
+                "replicas": [h.handle for h in st.replicas if h.ready],
                 "max_ongoing": st.spec.get("max_ongoing_requests", 8)}
+
+    async def listen_for_change(self, app_name: str, deployment_name: str,
+                                known_version: int,
+                                timeout_s: float = 30.0):
+        """Long-poll: returns the replica set as soon as its version differs
+        from `known_version`, or the current (unchanged) state after
+        timeout_s so the caller can re-arm. Replaces router interval
+        polling (≈ LongPollHost.listen_for_change, long_poll.py)."""
+        deadline = asyncio.get_running_loop().time() + timeout_s
+        while True:
+            st = self._apps.get(app_name, {}).get(deployment_name)
+            version = st.version if st is not None else -1
+            if version != known_version:
+                return await self.get_replicas(app_name, deployment_name)
+            remaining = deadline - asyncio.get_running_loop().time()
+            if remaining <= 0:
+                return await self.get_replicas(app_name, deployment_name)
+            ev = self._change_event
+            try:
+                await asyncio.wait_for(ev.wait(), timeout=remaining)
+            except asyncio.TimeoutError:
+                pass
 
     async def get_routes(self) -> Dict[str, str]:
         return dict(self._routes)

@@ -94,6 +94,13 @@ class ProxyActor:
             resp = await loop.run_in_executor(
                 None, lambda: handle.remote(payload))
             out = await resp
+            from ray_tpu.serve.handle import STREAM_MARKER
+
+            if isinstance(out, dict) and STREAM_MARKER in out:
+                # token streaming: chunked transfer, one pull loop on the
+                # replica that produced the stream (proxy.py:424 analog)
+                return await self._stream_response(
+                    request, resp._replica, out[STREAM_MARKER])
             if isinstance(out, (dict, list, int, float, bool)) or out is None:
                 return web.json_response(out)
             if isinstance(out, bytes):
@@ -102,3 +109,43 @@ class ProxyActor:
         except Exception as e:
             logger.exception("proxy error on %s", path)
             return web.Response(status=500, text=f"{type(e).__name__}: {e}")
+
+    async def _stream_response(self, request, replica, stream_id: int):
+        from aiohttp import web
+
+        resp = web.StreamResponse(
+            headers={"Content-Type": "text/plain; charset=utf-8",
+                     "X-Serve-Stream": "1"})
+        resp.enable_chunked_encoding()
+        await resp.prepare(request)
+        # once prepare() has sent 200 + headers, every failure must
+        # terminate THIS response — returning a fresh 500 Response on a
+        # transport mid-chunked-stream corrupts the connection
+        try:
+            while True:
+                chunk = await replica.stream_next.remote(stream_id)
+                for item in chunk["items"]:
+                    if isinstance(item, bytes):
+                        data = item
+                    elif isinstance(item, str):
+                        data = item.encode()
+                    else:
+                        data = (json.dumps(item) + "\n").encode()
+                    await resp.write(data)
+                if chunk.get("error"):
+                    await resp.write(
+                        f"\n[stream error: {chunk['error']}]".encode())
+                    break
+                if chunk["done"]:
+                    break
+        except Exception as e:  # noqa: BLE001 — replica died / client gone
+            logger.warning("stream %d aborted: %s", stream_id, e)
+            try:
+                await resp.write(f"\n[stream aborted: {e}]".encode())
+            except Exception:
+                pass
+        try:
+            await resp.write_eof()
+        except Exception:
+            pass
+        return resp

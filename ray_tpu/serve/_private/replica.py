@@ -32,6 +32,10 @@ class ReplicaActor:
         self._ongoing = 0
         self._total = 0
         self._started = time.time()
+        # streaming responses: stream_id -> [queue, last_pull_monotonic]
+        self._streams: Dict[int, list] = {}
+        self._next_stream_id = 0
+        self._stream_idle_ttl_s = 120.0
 
     async def handle_request(self, method_name: str, args, kwargs) -> Any:
         self._ongoing += 1
@@ -41,12 +45,94 @@ class ReplicaActor:
                 fn = self._callable
             else:
                 fn = getattr(self._callable, method_name or "__call__")
-            out = fn(*args, **(kwargs or {}))
+            if inspect.iscoroutinefunction(fn):
+                out = fn(*args, **(kwargs or {}))
+            else:
+                # sync callables (jitted decode steps, blocking compute)
+                # must not stall the actor loop — health checks and
+                # concurrent requests ride the same loop
+                out = await asyncio.get_running_loop().run_in_executor(
+                    None, lambda: fn(*args, **(kwargs or {})))
             if inspect.isawaitable(out):
                 out = await out
+            if inspect.isgenerator(out) or inspect.isasyncgen(out):
+                # streaming response: drain the generator into a queue the
+                # caller pulls with stream_next (the chunk-pull transport
+                # standing in for the reference's gRPC/ASGI streaming,
+                # proxy.py:424)
+                self._reap_idle_streams()
+                sid = self._next_stream_id
+                self._next_stream_id += 1
+                q: asyncio.Queue = asyncio.Queue()
+                self._streams[sid] = [q, time.monotonic()]
+                asyncio.ensure_future(self._drain_stream(out, q))
+                return {"__serve_stream__": sid}
             return out
         finally:
             self._ongoing -= 1
+
+    def _reap_idle_streams(self) -> None:
+        """Abandoned streams (consumer gone mid-iteration) must not leak
+        their buffered chunks for the replica's lifetime."""
+        now = time.monotonic()
+        for sid, (q, last_pull) in list(self._streams.items()):
+            if now - last_pull > self._stream_idle_ttl_s:
+                self._streams.pop(sid, None)
+
+    async def _drain_stream(self, gen, q: asyncio.Queue) -> None:
+        try:
+            if inspect.isasyncgen(gen):
+                async for item in gen:
+                    await q.put(("item", item))
+            else:
+                # a sync generator's body (e.g. a jitted decode step per
+                # token) must not block the actor loop: pump on a thread
+                loop = asyncio.get_running_loop()
+
+                def pump():
+                    for item in gen:
+                        loop.call_soon_threadsafe(
+                            q.put_nowait, ("item", item))
+
+                await loop.run_in_executor(None, pump)
+            await q.put(("end", None))
+        except Exception as e:  # noqa: BLE001 — crosses to the consumer
+            await q.put(("error", f"{type(e).__name__}: {e}"))
+
+    async def stream_next(self, stream_id: int, max_items: int = 256,
+                          timeout_s: float = 10.0) -> Dict[str, Any]:
+        """Pull the next buffered chunk(s) of a streaming response.
+        Returns {items, done, error?}; an unknown id is a finished stream."""
+        holder = self._streams.get(stream_id)
+        if holder is None:
+            return {"items": [], "done": True}
+        q = holder[0]
+        holder[1] = time.monotonic()
+        items: list = []
+        done = False
+        error = None
+        try:
+            kind, item = await asyncio.wait_for(q.get(), timeout=timeout_s)
+        except asyncio.TimeoutError:
+            return {"items": [], "done": False}
+        while True:
+            if kind == "end":
+                done = True
+                break
+            if kind == "error":
+                done = True
+                error = item
+                break
+            items.append(item)
+            if len(items) >= max_items or q.empty():
+                break
+            kind, item = q.get_nowait()
+        if done:
+            self._streams.pop(stream_id, None)
+        out: Dict[str, Any] = {"items": items, "done": done}
+        if error is not None:
+            out["error"] = error
+        return out
 
     async def reconfigure(self, user_config: Any) -> None:
         if hasattr(self._callable, "reconfigure"):
@@ -55,7 +141,9 @@ class ReplicaActor:
                 await out
 
     async def stats(self) -> Dict[str, Any]:
-        return {"ongoing": self._ongoing, "total": self._total,
+        # live streams count as ongoing work for autoscaling and draining
+        return {"ongoing": self._ongoing + len(self._streams),
+                "total": self._total,
                 "uptime_s": time.time() - self._started}
 
     async def check_health(self) -> bool:
@@ -67,7 +155,9 @@ class ReplicaActor:
         return True
 
     async def prepare_for_shutdown(self) -> None:
-        # drain: wait for in-flight requests
+        # drain: wait for in-flight requests AND live streams
         deadline = time.monotonic() + 10
-        while self._ongoing > 0 and time.monotonic() < deadline:
+        while ((self._ongoing > 0 or self._streams)
+               and time.monotonic() < deadline):
+            self._reap_idle_streams()
             await asyncio.sleep(0.02)

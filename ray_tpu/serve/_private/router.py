@@ -4,8 +4,13 @@ Analog of `ray.serve._private.router.Router.assign_request`
 (`python/ray/serve/_private/router.py:518`) +
 `PowerOfTwoChoicesReplicaScheduler`
 (`_private/replica_scheduler/pow_2_scheduler.py:49`): sample two replicas,
-send to the one with the lower locally-tracked in-flight count; refresh
-the replica set from the controller when its version bumps.
+send to the one with the lower locally-tracked in-flight count.
+
+The replica set is pushed, not polled: a background thread holds a
+long-poll (`controller.listen_for_change`) open so config changes land
+the moment the controller bumps the version — there is no interval
+re-listing and no sleep loop in the request hot path
+(≈ `python/ray/serve/_private/long_poll.py` LongPollClient).
 """
 
 from __future__ import annotations
@@ -19,7 +24,7 @@ import ray_tpu
 
 
 class Router:
-    REFRESH_INTERVAL_S = 1.0
+    LONG_POLL_TIMEOUT_S = 30.0
 
     def __init__(self, controller, app_name: str, deployment_name: str):
         self._controller = controller
@@ -29,47 +34,96 @@ class Router:
         self._version = -2
         self._inflight: Dict[int, int] = {}
         self._lock = threading.Lock()
-        self._last_refresh = 0.0
+        self._update_event = threading.Event()
+        self._stopped = False
+        self._poll_thread: Optional[threading.Thread] = None
 
-    def _refresh(self, force: bool = False):
-        now = time.monotonic()
-        if not force and now - self._last_refresh < self.REFRESH_INTERVAL_S:
-            return
-        self._last_refresh = now
-        info = ray_tpu.get(
-            self._controller.get_replicas.remote(self._app, self._deployment))
-        if info["version"] != self._version:
+    def _ensure_polling(self) -> None:
+        if self._poll_thread is None:
             with self._lock:
-                self._replicas = info["replicas"]
-                self._version = info["version"]
-                self._inflight = {i: 0 for i in range(len(self._replicas))}
+                if self._poll_thread is None:
+                    t = threading.Thread(
+                        target=self._poll_loop,
+                        name=f"serve-longpoll-{self._deployment}",
+                        daemon=True,
+                    )
+                    self._poll_thread = t
+                    t.start()
+
+    def _poll_loop(self) -> None:
+        """Keep one listen_for_change call in flight; apply each push.
+        If the controller stays unreachable (serve.shutdown), the thread
+        retires itself; the next assign_request restarts polling."""
+        failures = 0
+        while not self._stopped:
+            try:
+                info = ray_tpu.get(
+                    self._controller.listen_for_change.remote(
+                        self._app, self._deployment, self._version,
+                        self.LONG_POLL_TIMEOUT_S),
+                    timeout=self.LONG_POLL_TIMEOUT_S + 30,
+                )
+            except Exception:
+                if self._stopped:
+                    return
+                failures += 1
+                if failures >= 10:
+                    with self._lock:
+                        self._replicas = []
+                        self._version = -2
+                        self._poll_thread = None
+                    return
+                time.sleep(min(0.2 * failures, 2.0))
+                continue
+            failures = 0
+            if info["version"] != self._version:
+                with self._lock:
+                    self._replicas = info["replicas"]
+                    self._version = info["version"]
+                    self._inflight = {
+                        i: 0 for i in range(len(self._replicas))}
+                self._update_event.set()
+
+    def _pick(self):
+        """Pow-2 choice under the lock; None if no replicas known."""
+        with self._lock:
+            n = len(self._replicas)
+            if not n:
+                return None
+            if n == 1:
+                idx = 0
+            else:
+                a, b = random.sample(range(n), 2)
+                idx = (a if self._inflight.get(a, 0)
+                       <= self._inflight.get(b, 0) else b)
+            self._inflight[idx] = self._inflight.get(idx, 0) + 1
+            return idx, self._replicas[idx]
 
     def assign_request(self, method_name: str, args, kwargs):
+        ref, _replica = self.assign_request_with_replica(
+            method_name, args, kwargs)
+        return ref
+
+    def assign_request_with_replica(self, method_name: str, args, kwargs):
+        """Returns (result_ref, replica_handle). The replica handle lets
+        callers continue a streaming response on the same replica."""
+        self._ensure_polling()
         deadline = time.monotonic() + 30
         while True:
-            self._refresh()
-            # select under the same lock acquisition as the length check —
-            # a concurrent _refresh can otherwise shrink the list in between.
-            with self._lock:
-                n = len(self._replicas)
-                if n:
-                    if n == 1:
-                        idx = 0
-                    else:
-                        a, b = random.sample(range(n), 2)
-                        idx = (a if self._inflight.get(a, 0)
-                               <= self._inflight.get(b, 0) else b)
-                    self._inflight[idx] = self._inflight.get(idx, 0) + 1
-                    replica = self._replicas[idx]
-                    break
-            if time.monotonic() > deadline:
+            picked = self._pick()
+            if picked is not None:
+                idx, replica = picked
+                break
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
                 raise RuntimeError(
                     f"no replicas for {self._app}/{self._deployment}")
-            self._refresh(force=True)
-            time.sleep(0.05)
+            # wait for the long-poll push, not an interval
+            self._update_event.clear()
+            self._update_event.wait(timeout=min(remaining, 5.0))
         ref = replica.handle_request.remote(method_name, args, kwargs)
         self._watch_completion(ref, idx)
-        return ref
+        return ref, replica
 
     def _watch_completion(self, ref, idx: int):
         def done(_f):
@@ -83,3 +137,6 @@ class Router:
             with self._lock:
                 if idx in self._inflight and self._inflight[idx] > 0:
                     self._inflight[idx] -= 1
+
+    def stop(self) -> None:
+        self._stopped = True

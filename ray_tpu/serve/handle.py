@@ -3,20 +3,26 @@
 Analog of `ray.serve.handle.DeploymentHandle`: `handle.remote(...)`
 returns a `DeploymentResponse` (resolve with `.result()`, await it, or
 pass the underlying ref onward). Method access (`handle.other.remote()`)
-routes to that method of the callable.
+routes to that method of the callable. A deployment method that returns
+a (sync or async) generator streams: iterate the response
+(`for chunk in handle.remote(...)`) to pull chunks as they are produced
+(≈ handle.options(stream=True) in the reference).
 """
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any, Iterator, Optional
 
 import ray_tpu
 from ray_tpu.serve._private.router import Router
 
+STREAM_MARKER = "__serve_stream__"
+
 
 class DeploymentResponse:
-    def __init__(self, ref):
+    def __init__(self, ref, replica=None):
         self._ref = ref
+        self._replica = replica
 
     def result(self, timeout: Optional[float] = None) -> Any:
         return ray_tpu.get(self._ref, timeout=timeout)
@@ -27,6 +33,24 @@ class DeploymentResponse:
     @property
     def ref(self):
         return self._ref
+
+    def __iter__(self) -> Iterator[Any]:
+        """Stream the response. Non-streaming results yield once."""
+        out = self.result()
+        if not (isinstance(out, dict) and STREAM_MARKER in out):
+            yield out
+            return
+        if self._replica is None:
+            raise RuntimeError("streaming response without replica binding")
+        sid = out[STREAM_MARKER]
+        while True:
+            chunk = ray_tpu.get(self._replica.stream_next.remote(sid))
+            for item in chunk["items"]:
+                yield item
+            if chunk.get("error"):
+                raise RuntimeError(f"stream failed: {chunk['error']}")
+            if chunk["done"]:
+                return
 
 
 class _BoundMethod:
@@ -66,8 +90,9 @@ class DeploymentHandle:
                      for a in args)
         kwargs = {k: (v._ref if isinstance(v, DeploymentResponse) else v)
                   for k, v in kwargs.items()}
-        ref = self._get_router().assign_request(method, args, kwargs)
-        return DeploymentResponse(ref)
+        ref, replica = self._get_router().assign_request_with_replica(
+            method, args, kwargs)
+        return DeploymentResponse(ref, replica=replica)
 
     def __getattr__(self, name: str) -> _BoundMethod:
         if name.startswith("_"):

@@ -1,0 +1,167 @@
+"""LLM decode deployment — the Serve flagship (BASELINE.md row 5).
+
+The reference leaves model serving to torch/vLLM inside replicas (its
+`ray.serve.llm` wraps vLLM engines); here the decode loop is TPU-native:
+jitted prefill + per-token jitted decode steps over the functional KV
+caches in `ray_tpu.models.decode`, with
+
+  * continuous batching: concurrent HTTP/handle requests coalesce via
+    `@serve.batch` into one batched `generate` program per flush
+    (≈ vLLM's batched engine step inside a Serve replica);
+  * token streaming: `{"prompt": ..., "stream": true}` returns a
+    generator — the replica pumps a jitted decode step per token and the
+    proxy/handle stream chunks as they are produced;
+  * replica autoscaling/health from the regular serve control plane.
+
+The default preset is `llama_debug` (random weights) so the deployment
+is runnable anywhere; pass `preset="llama3_8b"` plus a checkpoint
+loader for the real thing.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, List, Optional
+
+import ray_tpu.serve as serve
+from ray_tpu.models import presets
+from ray_tpu.models.decode import (decode_step, init_caches, prefill,
+                                   sample_token)
+
+
+def _byte_tokenize(text: str, vocab_size: int) -> List[int]:
+    """Byte-level toy tokenizer (debug presets have vocab >= 256). Real
+    deployments pass `tokenize`/`detokenize` callables to LLMServer."""
+    return [b % vocab_size for b in text.encode("utf-8")]
+
+
+def _byte_detokenize(ids: List[int]) -> str:
+    return bytes(int(i) % 256 for i in ids).decode("utf-8", errors="replace")
+
+
+@serve.deployment(name="llm", max_ongoing_requests=32)
+class LLMServer:
+    """One model replica: owns params + the jitted prefill/decode programs."""
+
+    def __init__(self, preset: str = "llama_debug",
+                 max_new_tokens: int = 16,
+                 temperature: float = 0.0,
+                 max_batch_size: int = 8,
+                 params_loader=None,
+                 tokenize=None, detokenize=None):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models.transformer import init_params
+
+        self._jnp = jnp
+        self._jax = jax
+        self.cfg = getattr(presets, preset)()
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self._max_batch = max_batch_size
+        self.params = (params_loader(self.cfg) if params_loader is not None
+                       else init_params(self.cfg, jax.random.PRNGKey(0)))
+        self._tokenize = tokenize or partial(
+            _byte_tokenize, vocab_size=self.cfg.vocab_size)
+        self._detokenize = detokenize or _byte_detokenize
+        # jitted programs, shared by the batched and streaming paths
+        self._prefill = jax.jit(partial(prefill, self.cfg))
+        self._decode_step = jax.jit(partial(decode_step, self.cfg))
+        self._key = jax.random.PRNGKey(0)
+        import threading
+
+        self._key_lock = threading.Lock()  # batch flushes run on executor threads
+
+    # ------------------------------------------------------------ batched
+
+    @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.02)
+    async def _generate_batch(self, prompts: List[List[int]]) -> List[List[int]]:
+        """Continuous batching: concurrent requests run one decode program.
+        The jax work runs on an executor thread — blocking the replica's
+        event loop would stall health checks and stream pulls."""
+        import asyncio
+
+        return await asyncio.get_running_loop().run_in_executor(
+            None, self._generate_batch_sync, prompts)
+
+    def _generate_batch_sync(self, prompts: List[List[int]]) -> List[List[int]]:
+        """Group prompts by exact length and run one decode program per
+        group. Padding mixed lengths into one program would let real tokens
+        attend to pad positions (the causal cache mask has no pad masking),
+        silently degrading shorter prompts; grouping keeps every program
+        exact while still batching the common same-shape case."""
+        by_len: Dict[int, List[int]] = {}
+        for i, p in enumerate(prompts):
+            by_len.setdefault(len(p), []).append(i)
+        outs: List[List[int]] = [[] for _ in prompts]
+        for _length, indices in by_len.items():
+            group = [prompts[i] for i in indices]
+            for i, out in zip(indices, self._generate_group(group)):
+                outs[i] = out
+        return outs
+
+    def _generate_group(self, prompts: List[List[int]]) -> List[List[int]]:
+        """One batched decode program over same-length prompts."""
+        jnp = self._jnp
+        batch = len(prompts)
+        length = len(prompts[0])
+        tokens = jnp.asarray(prompts, dtype=jnp.int32)
+        caches = init_caches(self.cfg, batch, length + self.max_new_tokens)
+        logits, caches = self._prefill(self.params, tokens, caches)
+        outs: List[List[int]] = [[] for _ in range(batch)]
+        for _ in range(self.max_new_tokens):
+            with self._key_lock:
+                self._key, sub = self._jax.random.split(self._key)
+            tok = sample_token(logits, sub, self.temperature)
+            for i, t in enumerate(tok.tolist()):
+                outs[i].append(int(t))
+            logits, caches = self._decode_step(
+                self.params, tok[:, None].astype(jnp.int32), caches)
+        return outs
+
+    # ---------------------------------------------------------- streaming
+
+    def _generate_stream(self, prompt_ids: List[int]):
+        """Yield decoded text one token at a time (single-sequence decode:
+        a stream holds its own KV cache for its whole lifetime)."""
+        jnp = self._jnp
+        tokens = jnp.asarray([prompt_ids], dtype=jnp.int32)
+        caches = init_caches(self.cfg, 1, len(prompt_ids) + self.max_new_tokens)
+        logits, caches = self._prefill(self.params, tokens, caches)
+        key = self._jax.random.PRNGKey(len(prompt_ids))
+        for _ in range(self.max_new_tokens):
+            key, sub = self._jax.random.split(key)
+            tok = sample_token(logits, sub, self.temperature)
+            yield self._detokenize([int(tok[0])])
+            logits, caches = self._decode_step(
+                self.params, tok[:, None].astype(jnp.int32), caches)
+
+    # ------------------------------------------------------------ entry
+
+    async def __call__(self, request: Optional[Dict[str, Any]] = None):
+        request = request or {}
+        if isinstance(request, str):
+            request = {"prompt": request}
+        prompt = request.get("prompt", "")
+        ids = self._tokenize(prompt)
+        if not ids:
+            raise ValueError("prompt must be non-empty")
+        if request.get("stream"):
+            return self._generate_stream(ids)
+        out_ids = await self._generate_batch(ids)
+        return {"prompt": prompt, "text": self._detokenize(out_ids),
+                "num_tokens": len(out_ids)}
+
+    def check_health(self) -> bool:
+        return self.params is not None
+
+
+def build_app(preset: str = "llama_debug", num_replicas: int = 1,
+              max_new_tokens: int = 16, temperature: float = 0.0,
+              **kwargs) -> "serve.Application":
+    """`serve.run(build_app(...), route_prefix="/llm")` — the deployable
+    LLM decode application."""
+    dep = LLMServer.options(num_replicas=num_replicas)
+    return dep.bind(preset=preset, max_new_tokens=max_new_tokens,
+                    temperature=temperature, **kwargs)
