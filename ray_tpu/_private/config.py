@@ -58,6 +58,8 @@ class Config:
     event_buffer_size: int = 10_000
     metrics_report_interval_ms: int = 5000
     task_event_buffer_size: int = 100_000
+    # Prometheus /metrics HTTP port per daemon: 0 = auto-pick, -1 = off
+    metrics_export_port: int = 0
     # ---- TPU ----
     tpu_chips_per_host: int = 0  # 0 = autodetect via jax
     tpu_topology: str = ""  # e.g. "v5p-64"; "" = autodetect

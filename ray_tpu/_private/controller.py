@@ -27,7 +27,9 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private import serialization
 from ray_tpu._private.config import Config
+from ray_tpu._private.http_util import MetricsHttpServer
 from ray_tpu._private.ids import ActorID, JobID, NodeID, PlacementGroupID
+from ray_tpu._private.metrics import Counter, Gauge, default_registry
 from ray_tpu._private.resources import ResourceSet
 from ray_tpu._private.rpc import ClientPool, RpcServer
 from ray_tpu._private.scheduling import NodeView, PlacementError, place_bundles
@@ -58,6 +60,13 @@ class NodeRecord:
     last_seen: float = 0.0
     missed_health_checks: int = 0
     store_stats: Dict[str, float] = dataclasses.field(default_factory=dict)
+    # queued-but-unserved demand gossiped by the supervisor; the
+    # autoscaler bin-packs this into node launches
+    pending_demand: List[Dict[str, float]] = dataclasses.field(
+        default_factory=list)
+    # monotonic timestamp of the last sync in which the node was busy
+    # (available != total or demand pending); drives idle scale-down
+    last_busy: float = 0.0
 
     def view(self) -> NodeView:
         return NodeView(
@@ -134,6 +143,15 @@ class Controller:
         self._pg_retry_task: Optional[asyncio.Task] = None
         self._next_job_int = 0
         self._started = time.time()
+        # metrics (≈ metric_defs.h:46 definitions, served per-daemon)
+        self.metrics_server: Optional[MetricsHttpServer] = None
+        self._m_nodes = Gauge("ray_tpu_nodes",
+                              "Cluster nodes by liveness")
+        self._m_actors = Gauge("ray_tpu_actors", "Actors by state")
+        self._m_pgs = Gauge("ray_tpu_placement_groups",
+                            "Placement groups by state")
+        self._m_task_events = Counter("ray_tpu_task_events_total",
+                                      "Task lifecycle events received")
 
     # ------------------------------------------------------------- lifecycle
 
@@ -142,7 +160,50 @@ class Controller:
         loop = asyncio.get_running_loop()
         self._health_task = loop.create_task(self._health_loop())
         self._pg_retry_task = loop.create_task(self._pg_retry_loop())
+        if self.config.metrics_export_port >= 0:
+            try:
+                self.metrics_server = MetricsHttpServer(
+                    port=self.config.metrics_export_port)
+                self.metrics_server.route("/metrics", self._render_metrics)
+                self.metrics_server.route(
+                    "/healthz", lambda: ("text/plain", "ok"))
+                await self.metrics_server.start()
+            except OSError as e:
+                # a scrape-endpoint bind failure must not take down the
+                # control plane (fixed port + several daemons per host)
+                logger.warning("metrics endpoint unavailable: %s", e)
+                self.metrics_server = None
         return addr
+
+    def _render_metrics(self):
+        by_alive = {"alive": 0, "dead": 0}
+        for r in self.nodes.values():
+            by_alive["alive" if r.alive else "dead"] += 1
+        for state, count in by_alive.items():
+            self._m_nodes.set(count, {"state": state})
+        # seed every known state with 0 — a label-child left unset would
+        # freeze at its last nonzero value when the state empties out
+        actor_states: Dict[str, int] = {
+            s: 0 for s in (ACTOR_PENDING, ACTOR_ALIVE, ACTOR_RESTARTING,
+                           ACTOR_DEAD)}
+        for a in self.actors.values():
+            actor_states[a.state] = actor_states.get(a.state, 0) + 1
+        for state, count in actor_states.items():
+            self._m_actors.set(count, {"state": state})
+        pg_states: Dict[str, int] = {
+            s: 0 for s in (PG_PENDING, PG_CREATED, PG_REMOVED)}
+        for p in self.pgs.values():
+            pg_states[p.state] = pg_states.get(p.state, 0) + 1
+        for state, count in pg_states.items():
+            self._m_pgs.set(count, {"state": state})
+        return ("text/plain; version=0.0.4",
+                default_registry().render_prometheus())
+
+    async def rpc_metrics(self, body=None) -> str:
+        return self._render_metrics()[1]
+
+    async def rpc_metrics_port(self, body=None) -> int:
+        return self.metrics_server.port if self.metrics_server else -1
 
     async def _pg_retry_loop(self) -> None:
         """Pending placement groups retry as resources free up
@@ -158,6 +219,8 @@ class Controller:
         for t in (self._health_task, self._pg_retry_task):
             if t is not None:
                 t.cancel()
+        if self.metrics_server is not None:
+            await self.metrics_server.stop()
         await self.clients.close_all()
         await self.server.stop()
 
@@ -171,6 +234,7 @@ class Controller:
             available=ResourceSet.of(body["available"]),
             labels=body.get("labels", {}),
             last_seen=time.monotonic(),
+            last_busy=time.monotonic(),
         )
         self.nodes[rec.node_id_hex] = rec
         logger.info("node %s registered at %s", rec.node_id_hex[:8], rec.address)
@@ -187,8 +251,11 @@ class Controller:
         if "total" in body:
             rec.total = ResourceSet.of(body["total"])
         rec.store_stats = body.get("store_stats", {})
+        rec.pending_demand = body.get("pending_demand", [])
         rec.last_seen = time.monotonic()
         rec.missed_health_checks = 0
+        if rec.pending_demand or dict(rec.available) != dict(rec.total):
+            rec.last_busy = time.monotonic()
 
     async def rpc_node_views(self, body=None) -> list:
         return [
@@ -600,6 +667,7 @@ class Controller:
     async def rpc_task_events(self, body) -> None:
         for ev in body["events"]:
             self.task_events.append(ev)
+        self._m_task_events.inc(len(body["events"]))
 
     async def rpc_state_tasks(self, body=None) -> list:
         limit = (body or {}).get("limit", 1000)
@@ -624,6 +692,27 @@ class Controller:
 
     async def rpc_ping(self, body=None) -> str:
         return "pong"
+
+    async def rpc_autoscaler_state(self, body=None) -> dict:
+        """Cluster state consumed by StandardAutoscaler.update():
+        per-node views + pending demand + idle ages
+        (≈ LoadMetrics fed by GCS resource reports,
+        python/ray/autoscaler/_private/load_metrics.py)."""
+        now = time.monotonic()
+        return {
+            "nodes": [
+                {
+                    "node_id_hex": r.node_id_hex,
+                    "total": dict(r.total),
+                    "available": dict(r.available),
+                    "alive": r.alive,
+                    "labels": r.labels,
+                    "pending_demand": r.pending_demand,
+                    "idle_s": (now - r.last_busy) if r.alive else 0.0,
+                }
+                for r in self.nodes.values()
+            ],
+        }
 
 
 def main() -> None:

@@ -1,0 +1,77 @@
+"""Minimal asyncio HTTP server for daemon endpoints (/metrics, /healthz).
+
+The daemons' RPC substrate is a binary protocol (rpc.py); Prometheus and
+humans speak HTTP. This is a deliberately tiny HTTP/1.0 responder — one
+request per connection, GET only — sufficient for scrape endpoints
+(≈ the reference's metrics agent exposing the Prometheus port).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Callable, Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+class MetricsHttpServer:
+    """Routes GET paths to handlers returning (content_type, body)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._host = host
+        self._port = port
+        self._routes: Dict[str, Callable[[], Tuple[str, str]]] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    def route(self, path: str, handler: Callable[[], Tuple[str, str]]):
+        self._routes[path] = handler
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    async def start(self) -> int:
+        self._server = await asyncio.start_server(
+            self._handle, self._host, self._port)
+        self._port = self._server.sockets[0].getsockname()[1]
+        return self._port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), timeout=2)
+            except Exception:
+                pass
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            line = await asyncio.wait_for(reader.readline(), timeout=10)
+            parts = line.decode("latin1").split()
+            path = parts[1].split("?")[0] if len(parts) >= 2 else "/"
+            # drain headers
+            while True:
+                h = await asyncio.wait_for(reader.readline(), timeout=10)
+                if h in (b"\r\n", b"\n", b""):
+                    break
+            handler = self._routes.get(path)
+            if handler is None:
+                body = b"not found"
+                head = (f"HTTP/1.0 404 Not Found\r\nContent-Length: "
+                        f"{len(body)}\r\n\r\n")
+            else:
+                ctype, text = handler()
+                body = text.encode()
+                head = (f"HTTP/1.0 200 OK\r\nContent-Type: {ctype}\r\n"
+                        f"Content-Length: {len(body)}\r\n\r\n")
+            writer.write(head.encode("latin1") + body)
+            await writer.drain()
+        except Exception:
+            logger.debug("metrics http request failed", exc_info=True)
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass

@@ -1,0 +1,179 @@
+"""Process-local metrics registry with Prometheus text exposition.
+
+Analog of the reference's stats layer (`src/ray/stats/metric.h:392`
+Counter/Gauge/Histogram + `metric_defs.h:46` definitions) and the
+Prometheus export path (`python/ray/_private/metrics_agent.py`), without
+OpenCensus: a lock-protected registry per process, rendered on demand in
+Prometheus text format, served by each daemon's HTTP endpoint
+(`http_util.py` in this package).
+
+User-facing wrappers live in `ray_tpu.util.metrics`.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Optional[Dict[str, str]]) -> _LabelKey:
+    return tuple(sorted((labels or {}).items()))
+
+
+def _render_labels(key: _LabelKey) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+class Metric:
+    def __init__(self, name: str, description: str, registry: "Registry"):
+        self.name = name
+        self.description = description
+        self._lock = threading.Lock()
+        registry._register(self)
+
+
+class Counter(Metric):
+    TYPE = "counter"
+
+    def __init__(self, name, description="", registry=None):
+        super().__init__(name, description, registry or default_registry())
+        self._values: Dict[_LabelKey, float] = {}
+
+    def inc(self, value: float = 1.0,
+            labels: Optional[Dict[str, str]] = None) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def render(self) -> List[str]:
+        with self._lock:
+            return [
+                f"{self.name}{_render_labels(k)} {v}"
+                for k, v in sorted(self._values.items())
+            ]
+
+
+class Gauge(Metric):
+    TYPE = "gauge"
+
+    def __init__(self, name, description="", registry=None):
+        super().__init__(name, description, registry or default_registry())
+        self._values: Dict[_LabelKey, float] = {}
+
+    def set(self, value: float,
+            labels: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = float(value)
+
+    def inc(self, value: float = 1.0, labels=None) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def dec(self, value: float = 1.0, labels=None) -> None:
+        self.inc(-value, labels)
+
+    def render(self) -> List[str]:
+        with self._lock:
+            return [
+                f"{self.name}{_render_labels(k)} {v}"
+                for k, v in sorted(self._values.items())
+            ]
+
+
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
+                   60.0, 300.0)
+
+
+class Histogram(Metric):
+    TYPE = "histogram"
+
+    def __init__(self, name, description="",
+                 buckets: Sequence[float] = DEFAULT_BUCKETS, registry=None):
+        super().__init__(name, description, registry or default_registry())
+        self.buckets = tuple(sorted(buckets))
+        self._counts: Dict[_LabelKey, List[int]] = {}
+        self._sums: Dict[_LabelKey, float] = {}
+        self._totals: Dict[_LabelKey, int] = {}
+
+    def observe(self, value: float,
+                labels: Optional[Dict[str, str]] = None) -> None:
+        key = _label_key(labels)
+        idx = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            if idx < len(counts):
+                counts[idx] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def render(self) -> List[str]:
+        out: List[str] = []
+        with self._lock:
+            for key, counts in sorted(self._counts.items()):
+                cumulative = 0
+                for bound, c in zip(self.buckets, counts):
+                    cumulative += c
+                    lk = dict(key)
+                    lk["le"] = repr(bound)
+                    out.append(
+                        f"{self.name}_bucket{_render_labels(_label_key(lk))}"
+                        f" {cumulative}")
+                lk = dict(key)
+                lk["le"] = "+Inf"
+                out.append(
+                    f"{self.name}_bucket{_render_labels(_label_key(lk))}"
+                    f" {self._totals[key]}")
+                out.append(
+                    f"{self.name}_sum{_render_labels(key)} {self._sums[key]}")
+                out.append(
+                    f"{self.name}_count{_render_labels(key)} "
+                    f"{self._totals[key]}")
+        return out
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: Dict[str, Metric] = {}
+        self._lock = threading.Lock()
+
+    def _register(self, metric: Metric) -> None:
+        with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None and type(existing) is not type(metric):
+                raise ValueError(
+                    f"metric {metric.name!r} already registered with a "
+                    f"different type")
+            self._metrics[metric.name] = metric
+
+    def get(self, name: str) -> Optional[Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def render_prometheus(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines: List[str] = []
+        for m in metrics:
+            lines.append(f"# HELP {m.name} {m.description}")
+            lines.append(f"# TYPE {m.name} {m.TYPE}")
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+_default: Optional[Registry] = None
+_default_lock = threading.Lock()
+
+
+def default_registry() -> Registry:
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = Registry()
+        return _default

@@ -32,7 +32,9 @@ from typing import Any, Deque, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private import serialization
 from ray_tpu._private.config import Config
+from ray_tpu._private.http_util import MetricsHttpServer
 from ray_tpu._private.ids import NodeID, ObjectID, WorkerID
+from ray_tpu._private.metrics import Counter, Gauge, default_registry
 from ray_tpu._private.object_store import NodeObjectStore
 from ray_tpu._private.resources import ResourceSet, detect_node_resources
 from ray_tpu._private.rpc import ClientPool, RpcServer
@@ -154,6 +156,21 @@ class Supervisor:
         self._monitor_task: Optional[asyncio.Task] = None
         # TPU chip assignment bookkeeping
         self._tpu_free: List[int] = list(range(int(self.total.get("TPU", 0))))
+        # metrics (rendered by the per-node /metrics endpoint)
+        self.metrics_server: Optional[MetricsHttpServer] = None
+        self._m_leases_granted = Counter(
+            "ray_tpu_leases_granted_total", "Worker leases granted")
+        self._m_leases_spilled = Counter(
+            "ray_tpu_leases_spilled_total", "Leases redirected to other nodes")
+        self._m_workers_spawned = Counter(
+            "ray_tpu_workers_spawned_total", "Worker processes spawned")
+        self._m_worker_exits = Counter(
+            "ray_tpu_worker_exits_total", "Worker processes exited")
+        self._m_workers = Gauge("ray_tpu_workers", "Live worker processes")
+        self._m_queue_depth = Gauge(
+            "ray_tpu_lease_queue_depth", "Queued + infeasible leases")
+        self._m_store_bytes = Gauge(
+            "ray_tpu_object_store_bytes", "Object store usage by kind")
         # original (driver) environment for spawning TPU workers
         self._orig_env = dict(os.environ)
         orig_axon = os.environ.get("RAY_TPU_AXON_ORIG")
@@ -179,6 +196,18 @@ class Supervisor:
         self._sync_task = loop.create_task(self._sync_loop())
         self._reap_task = loop.create_task(self._reap_loop())
         self._monitor_task = loop.create_task(self._monitor_loop())
+        if self.config.metrics_export_port >= 0:
+            try:
+                self.metrics_server = MetricsHttpServer(
+                    port=self.config.metrics_export_port)
+                self.metrics_server.route("/metrics", self._render_metrics)
+                self.metrics_server.route(
+                    "/healthz", lambda: ("text/plain", "ok"))
+                await self.metrics_server.start()
+            except OSError as e:
+                # never fail the data-plane daemon over a scrape endpoint
+                logger.warning("metrics endpoint unavailable: %s", e)
+                self.metrics_server = None
         logger.info(
             "supervisor %s on %s resources=%s",
             self.node_id.hex()[:8],
@@ -187,10 +216,28 @@ class Supervisor:
         )
         return addr
 
+    def _render_metrics(self):
+        self._m_workers.set(len(self.workers))
+        self._m_queue_depth.set(
+            len(self._lease_queue) + len(self._infeasible_leases))
+        for kind, value in self.store.stats().items():
+            if isinstance(value, (int, float)):
+                self._m_store_bytes.set(value, {"kind": kind})
+        return ("text/plain; version=0.0.4",
+                default_registry().render_prometheus())
+
+    async def rpc_metrics(self, body=None) -> str:
+        return self._render_metrics()[1]
+
+    async def rpc_metrics_port(self, body=None) -> int:
+        return self.metrics_server.port if self.metrics_server else -1
+
     async def stop(self) -> None:
         for t in (self._sync_task, self._reap_task, self._monitor_task):
             if t is not None:
                 t.cancel()
+        if self.metrics_server is not None:
+            await self.metrics_server.stop()
         for w in self.workers.values():
             if w.proc is not None:
                 try:
@@ -273,6 +320,7 @@ class Supervisor:
         if chosen is None or chosen.node_id_hex == self.node_id.hex():
             return False
         _trace(f"spill {q.spec.name} -> {chosen.node_id_hex[:6]} hops={q.hops + 1}")
+        self._m_leases_spilled.inc()
         q.future.set_result(
             {"granted": False, "retry_at": chosen.address, "hops": q.hops + 1}
         )
@@ -472,6 +520,7 @@ class Supervisor:
             pg_key=q.pg_key,
         )
         worker.leased = True
+        self._m_leases_granted.inc()
         num_tpu = int(q.demand.get("TPU", 0))
         if num_tpu and not worker.tpu_chips:
             worker.tpu_chips = [self._tpu_free.pop() for _ in range(num_tpu)]
@@ -570,6 +619,7 @@ class Supervisor:
         proc = subprocess.Popen(cmd, env=env, stdout=out, stderr=err)
         out.close()  # child holds its own duplicates; keeping ours leaks fds
         err.close()
+        self._m_workers_spawned.inc()
         self._spawned_procs[proc.pid] = proc
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._spawn_waiters.setdefault(env_key, deque()).append(fut)
@@ -647,6 +697,7 @@ class Supervisor:
     async def _on_worker_exit(self, w: WorkerHandle) -> None:
         _trace(f"worker_exit {w.worker_id_hex[:8]} is_actor={w.is_actor} actor={w.actor_id_hex[:8]} code={w.proc.poll() if w.proc else None}")
         self.workers.pop(w.worker_id_hex, None)
+        self._m_worker_exits.inc()
         try:
             self.idle.get(w.env_key, deque()).remove(w)
         except ValueError:

@@ -1,0 +1,24 @@
+"""ray_tpu.autoscaler — demand-driven cluster scaling.
+
+Analog of the reference autoscaler
+(`python/ray/autoscaler/_private/autoscaler.py:172` StandardAutoscaler,
+`resource_demand_scheduler.py` bin-packing, `node_provider.py` plugin
+interface), TPU-reshaped: node types are host shapes (a TPU slice host is
+one node type with its chip count as a resource), and the demand signal
+is the pending-lease gossip every supervisor already syncs to the
+controller.
+"""
+
+from ray_tpu.autoscaler.autoscaler import AutoscalerConfig, StandardAutoscaler
+from ray_tpu.autoscaler.node_provider import (GCPTPUNodeProvider,
+                                              LocalNodeProvider, NodeProvider,
+                                              NodeType)
+
+__all__ = [
+    "AutoscalerConfig",
+    "StandardAutoscaler",
+    "NodeProvider",
+    "NodeType",
+    "LocalNodeProvider",
+    "GCPTPUNodeProvider",
+]

@@ -1,0 +1,210 @@
+"""Node providers: how the autoscaler actually gets machines.
+
+Analog of `python/ray/autoscaler/node_provider.py` (the plugin interface)
+and `python/ray/autoscaler/_private/gcp/` (the GCP implementation whose
+TPU handling lives at `gcp/config.py:16-57`). Two implementations here:
+
+  * LocalNodeProvider — spawns real supervisor processes on this host;
+    the hermetic test/provider used by the autoscaler tests (reference
+    analog: the fake multi-node provider in `_private/fake_multi_node`).
+  * GCPTPUNodeProvider — maps TPU slice topologies to node shapes and
+    would drive the GCE/TPU API; the API calls are isolated behind
+    `_api_create/_api_terminate` so the shape logic is testable offline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class NodeType:
+    """One launchable host shape (≈ available_node_types entries in the
+    reference's autoscaler YAML)."""
+
+    name: str
+    resources: Dict[str, float]
+    max_workers: int = 10
+    # provider-specific payload (e.g. GCE machine type / TPU topology)
+    node_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+class NodeProvider:
+    """Minimal provider surface the autoscaler drives."""
+
+    def create_node(self, node_type: NodeType, count: int) -> List[str]:
+        """Launch `count` nodes of the type; returns provider node ids."""
+        raise NotImplementedError
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[Dict[str, Any]]:
+        """[{id, node_type, node_id_hex?}] for nodes this provider launched
+        and has not terminated."""
+        raise NotImplementedError
+
+
+class LocalNodeProvider(NodeProvider):
+    """Spawns supervisor processes on this host (one per 'node').
+
+    Wraps a `ray_tpu.cluster_utils.Cluster`-compatible session: it talks
+    to the same controller and session dir, so autoscaled nodes join the
+    cluster exactly like `Cluster.add_node` ones.
+    """
+
+    def __init__(self, session_dir: str, controller_addr, config=None):
+        from ray_tpu._private.config import Config
+
+        self._session_dir = session_dir
+        self._controller_addr = controller_addr
+        self._config = config or Config.from_env()
+        self._lock = threading.Lock()
+        self._nodes: Dict[str, Dict[str, Any]] = {}
+        self._next_id = 0
+
+    def create_node(self, node_type: NodeType, count: int) -> List[str]:
+        from ray_tpu._private.node import start_supervisor
+
+        out = []
+        resources = {k: float(v) for k, v in node_type.resources.items()}
+        resources.setdefault("memory", 2.0 * 1024**3)
+        with self._lock:
+            for _ in range(count):
+                self._next_id += 1
+                pid = f"local-{node_type.name}-{self._next_id}"
+                proc, addr = start_supervisor(
+                    self._session_dir,
+                    self._config,
+                    self._controller_addr,
+                    resources=dict(resources),
+                    node_name=pid,
+                )
+                self._nodes[pid] = {
+                    "id": pid,
+                    "node_type": node_type.name,
+                    "proc": proc,
+                    "address": addr,
+                }
+                out.append(pid)
+        return out
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        with self._lock:
+            rec = self._nodes.pop(provider_node_id, None)
+        if rec is not None:
+            try:
+                rec["proc"].kill()
+                rec["proc"].wait(timeout=5)
+            except Exception:
+                pass
+
+    def non_terminated_nodes(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [
+                {"id": r["id"], "node_type": r["node_type"],
+                 "node_name": r["id"]}
+                for r in self._nodes.values()
+            ]
+
+    def shutdown(self) -> None:
+        for pid in [r["id"] for r in self.non_terminated_nodes()]:
+            self.terminate_node(pid)
+
+
+# TPU slice shapes: topology -> (hosts, chips per host). The head
+# resource marks host 0 of a slice so gang placement can pin the
+# coordinator (ray_tpu.parallel.slices convention).
+TPU_TOPOLOGIES: Dict[str, Dict[str, int]] = {
+    "v4-8": {"hosts": 1, "chips_per_host": 4},
+    "v4-16": {"hosts": 2, "chips_per_host": 4},
+    "v5p-8": {"hosts": 1, "chips_per_host": 4},
+    "v5p-16": {"hosts": 2, "chips_per_host": 4},
+    "v5p-64": {"hosts": 8, "chips_per_host": 4},
+    "v5e-4": {"hosts": 1, "chips_per_host": 4},
+    "v5e-8": {"hosts": 1, "chips_per_host": 8},
+    "v5e-16": {"hosts": 2, "chips_per_host": 8},
+    "v6e-8": {"hosts": 1, "chips_per_host": 8},
+}
+
+
+def tpu_slice_node_types(topology: str, *, cpus_per_host: float = 120.0,
+                         max_slices: int = 4) -> List[NodeType]:
+    """Expand a TPU slice topology into launchable host node-types
+    (≈ the reference's GCP TPU config handling, gcp/config.py:16-57:
+    one replicated worker pool per slice, `TPU` chips as resources)."""
+    if topology not in TPU_TOPOLOGIES:
+        raise ValueError(
+            f"unknown TPU topology {topology!r}; known: "
+            f"{sorted(TPU_TOPOLOGIES)}")
+    shape = TPU_TOPOLOGIES[topology]
+    accel = topology.split("-")[0]
+    types = [
+        NodeType(
+            name=f"tpu-{topology}-host",
+            resources={
+                "CPU": cpus_per_host,
+                "TPU": float(shape["chips_per_host"]),
+                f"accelerator_type:{accel.upper()}": 1.0,
+            },
+            max_workers=shape["hosts"] * max_slices,
+            node_config={"topology": topology,
+                         "hosts_per_slice": shape["hosts"]},
+        )
+    ]
+    return types
+
+
+class GCPTPUNodeProvider(NodeProvider):
+    """GCE/TPU provider skeleton: full shape mapping, stubbed API calls.
+
+    The control flow and node bookkeeping are real; `_api_create` /
+    `_api_terminate` raise unless a transport is injected (this image has
+    no network egress). Reference: `python/ray/autoscaler/_private/gcp/
+    node_provider.py` + TPU pod handling in `gcp/config.py:16-57`.
+    """
+
+    def __init__(self, project: str, zone: str,
+                 api_client: Optional[Any] = None):
+        self.project = project
+        self.zone = zone
+        self._api = api_client
+        self._nodes: Dict[str, Dict[str, Any]] = {}
+        self._next = 0
+
+    def create_node(self, node_type: NodeType, count: int) -> List[str]:
+        out = []
+        for _ in range(count):
+            self._next += 1
+            name = f"tpu-{node_type.node_config.get('topology', 'host')}-{self._next}"
+            self._api_create(name, node_type)
+            self._nodes[name] = {"id": name, "node_type": node_type.name}
+            out.append(name)
+        return out
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        if provider_node_id in self._nodes:
+            self._api_terminate(provider_node_id)
+            self._nodes.pop(provider_node_id, None)
+
+    def non_terminated_nodes(self) -> List[Dict[str, Any]]:
+        return [dict(r) for r in self._nodes.values()]
+
+    # -- API boundary (injectable for tests; raises without a client) --
+
+    def _api_create(self, name: str, node_type: NodeType) -> None:
+        if self._api is None:
+            raise RuntimeError(
+                "GCPTPUNodeProvider needs an api_client (no network egress "
+                "in this environment); inject one or use LocalNodeProvider")
+        self._api.create(
+            project=self.project, zone=self.zone, name=name,
+            accelerator_type=node_type.node_config.get("topology"),
+            resources=node_type.resources)
+
+    def _api_terminate(self, name: str) -> None:
+        if self._api is None:
+            raise RuntimeError("GCPTPUNodeProvider needs an api_client")
+        self._api.terminate(project=self.project, zone=self.zone, name=name)

@@ -17,6 +17,33 @@ import time
 from typing import Any, Dict, Optional
 
 
+class _Stream:
+    """One in-flight streaming response: the source generator, the chunk
+    buffer, and consumer-liveness bookkeeping."""
+
+    __slots__ = ("gen", "queue", "last_pull", "cancelled")
+
+    def __init__(self, gen):
+        self.gen = gen
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self.last_pull = time.monotonic()
+        self.cancelled = False
+
+    async def close(self) -> None:
+        """Stop the pump and release the generator."""
+        self.cancelled = True
+        try:
+            if inspect.isasyncgen(self.gen):
+                await self.gen.aclose()
+            else:
+                self.gen.close()
+        except Exception:
+            # a sync generator mid-__next__ on the pump thread raises
+            # "generator already executing"; the cancelled flag stops the
+            # pump at its next item instead
+            pass
+
+
 class ReplicaActor:
     def __init__(self, app_name: str, deployment_name: str,
                  callable_factory, init_args, init_kwargs):
@@ -32,10 +59,11 @@ class ReplicaActor:
         self._ongoing = 0
         self._total = 0
         self._started = time.time()
-        # streaming responses: stream_id -> [queue, last_pull_monotonic]
-        self._streams: Dict[int, list] = {}
+        # streaming responses: stream_id -> _Stream
+        self._streams: Dict[int, "_Stream"] = {}
         self._next_stream_id = 0
-        self._stream_idle_ttl_s = 120.0
+        self._stream_idle_ttl_s = 60.0
+        self._stream_reaper_task = None
 
     async def handle_request(self, method_name: str, args, kwargs) -> Any:
         self._ongoing += 1
@@ -60,30 +88,45 @@ class ReplicaActor:
                 # caller pulls with stream_next (the chunk-pull transport
                 # standing in for the reference's gRPC/ASGI streaming,
                 # proxy.py:424)
-                self._reap_idle_streams()
                 sid = self._next_stream_id
                 self._next_stream_id += 1
-                q: asyncio.Queue = asyncio.Queue()
-                self._streams[sid] = [q, time.monotonic()]
-                asyncio.ensure_future(self._drain_stream(out, q))
+                stream = _Stream(out)
+                self._streams[sid] = stream
+                asyncio.ensure_future(self._drain_stream(stream))
+                if self._stream_reaper_task is None:
+                    self._stream_reaper_task = asyncio.ensure_future(
+                        self._stream_reaper())
                 return {"__serve_stream__": sid}
             return out
         finally:
             self._ongoing -= 1
 
-    def _reap_idle_streams(self) -> None:
-        """Abandoned streams (consumer gone mid-iteration) must not leak
-        their buffered chunks for the replica's lifetime."""
-        now = time.monotonic()
-        for sid, (q, last_pull) in list(self._streams.items()):
-            if now - last_pull > self._stream_idle_ttl_s:
-                self._streams.pop(sid, None)
+    async def _stream_reaper(self) -> None:
+        """Abandoned streams (consumer gone mid-iteration) must not pump
+        the generator, hold buffered chunks, or count as ongoing work for
+        the replica's lifetime — reap proactively, not only on the next
+        request."""
+        while True:
+            await asyncio.sleep(5.0)
+            now = time.monotonic()
+            for sid, stream in list(self._streams.items()):
+                if now - stream.last_pull > self._stream_idle_ttl_s:
+                    self._streams.pop(sid, None)
+                    await stream.close()
 
-    async def _drain_stream(self, gen, q: asyncio.Queue) -> None:
+    def _active_streams(self, window_s: float = 15.0) -> int:
+        now = time.monotonic()
+        return sum(1 for s in self._streams.values()
+                   if now - s.last_pull < window_s)
+
+    async def _drain_stream(self, stream: "_Stream") -> None:
+        gen, q = stream.gen, stream.queue
         try:
             if inspect.isasyncgen(gen):
                 async for item in gen:
                     await q.put(("item", item))
+                    if stream.cancelled:
+                        return
             else:
                 # a sync generator's body (e.g. a jitted decode step per
                 # token) must not block the actor loop: pump on a thread
@@ -91,6 +134,8 @@ class ReplicaActor:
 
                 def pump():
                     for item in gen:
+                        if stream.cancelled:
+                            return
                         loop.call_soon_threadsafe(
                             q.put_nowait, ("item", item))
 
@@ -103,11 +148,11 @@ class ReplicaActor:
                           timeout_s: float = 10.0) -> Dict[str, Any]:
         """Pull the next buffered chunk(s) of a streaming response.
         Returns {items, done, error?}; an unknown id is a finished stream."""
-        holder = self._streams.get(stream_id)
-        if holder is None:
+        stream = self._streams.get(stream_id)
+        if stream is None:
             return {"items": [], "done": True}
-        q = holder[0]
-        holder[1] = time.monotonic()
+        q = stream.queue
+        stream.last_pull = time.monotonic()
         items: list = []
         done = False
         error = None
@@ -141,8 +186,9 @@ class ReplicaActor:
                 await out
 
     async def stats(self) -> Dict[str, Any]:
-        # live streams count as ongoing work for autoscaling and draining
-        return {"ongoing": self._ongoing + len(self._streams),
+        # actively-consumed streams count as ongoing work for autoscaling;
+        # abandoned ones must not pin the replica at scale
+        return {"ongoing": self._ongoing + self._active_streams(),
                 "total": self._total,
                 "uptime_s": time.time() - self._started}
 
@@ -155,9 +201,9 @@ class ReplicaActor:
         return True
 
     async def prepare_for_shutdown(self) -> None:
-        # drain: wait for in-flight requests AND live streams
+        # drain: wait for in-flight requests AND actively-consumed streams
+        # (abandoned streams must not burn the drain window)
         deadline = time.monotonic() + 10
-        while ((self._ongoing > 0 or self._streams)
+        while ((self._ongoing > 0 or self._active_streams(window_s=5.0))
                and time.monotonic() < deadline):
-            self._reap_idle_streams()
             await asyncio.sleep(0.02)

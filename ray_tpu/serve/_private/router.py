@@ -110,6 +110,10 @@ class Router:
         self._ensure_polling()
         deadline = time.monotonic() + 30
         while True:
+            # clear BEFORE picking: a push landing between a failed pick
+            # and clear() would otherwise be erased and stall us a full
+            # wait interval
+            self._update_event.clear()
             picked = self._pick()
             if picked is not None:
                 idx, replica = picked
@@ -119,7 +123,6 @@ class Router:
                 raise RuntimeError(
                     f"no replicas for {self._app}/{self._deployment}")
             # wait for the long-poll push, not an interval
-            self._update_event.clear()
             self._update_event.wait(timeout=min(remaining, 5.0))
         ref = replica.handle_request.remote(method_name, args, kwargs)
         self._watch_completion(ref, idx)

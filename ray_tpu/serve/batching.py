@@ -83,7 +83,15 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
                 self_obj, item = args
                 q = getattr(self_obj, queue_attr, None)
                 if q is None:
-                    q = _BatchQueue(fn, max_batch_size, batch_wait_timeout_s)
+                    # instances may override the decorator defaults
+                    # (e.g. a model replica configured at deploy time)
+                    size = getattr(
+                        self_obj, "__serve_batch_size_" + fn.__name__,
+                        max_batch_size)
+                    timeout = getattr(
+                        self_obj, "__serve_batch_timeout_" + fn.__name__,
+                        batch_wait_timeout_s)
+                    q = _BatchQueue(fn, size, timeout)
                     setattr(self_obj, queue_attr, q)
                 return await q.submit(self_obj, item)
             (item,) = args

@@ -72,6 +72,8 @@ class LLMServer:
         import threading
 
         self._key_lock = threading.Lock()  # batch flushes run on executor threads
+        # deploy-time batch size overrides the @serve.batch default
+        setattr(self, "__serve_batch_size__generate_batch", max_batch_size)
 
     # ------------------------------------------------------------ batched
 

@@ -1,0 +1,19 @@
+"""User-defined metrics (≈ `ray.util.metrics` Counter/Gauge/Histogram).
+
+Metrics record into the process-local registry; in daemons they are
+served on that daemon's /metrics endpoint, and in driver/worker
+processes they can be rendered with `render()` or scraped by whatever
+owns the process. Names should be prometheus-safe.
+"""
+
+from __future__ import annotations
+
+from ray_tpu._private.metrics import (Counter, Gauge, Histogram,
+                                      default_registry)
+
+__all__ = ["Counter", "Gauge", "Histogram", "render"]
+
+
+def render() -> str:
+    """Prometheus text exposition of this process's registry."""
+    return default_registry().render_prometheus()

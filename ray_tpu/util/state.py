@@ -1,0 +1,71 @@
+"""State API: list/summarize cluster entities from the driver.
+
+Analog of `python/ray/util/state/api.py` (`ray list tasks`,
+`list_actors`, `summary`): thin client functions over the controller's
+record tables and task-event sink. Each returns plain dicts so output is
+directly printable/serializable.
+"""
+
+from __future__ import annotations
+
+from collections import Counter as _Counter
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import api
+
+
+def _call(method: str, body: Optional[dict] = None):
+    core = api._require_core()
+    return core._run(
+        core.clients.get(core.controller_addr).call(method, body))
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    return _call("node_views")
+
+
+def list_actors(state: Optional[str] = None) -> List[Dict[str, Any]]:
+    records = _call("actor_list")
+    out = []
+    for rec in records:
+        rec = dict(rec)
+        rec.pop("creation_spec", None)  # serialized bytes, not listable
+        if state is None or rec.get("state") == state:
+            out.append(rec)
+    return out
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    return _call("pg_list")
+
+
+def list_jobs() -> List[Dict[str, Any]]:
+    return _call("job_list")
+
+
+def list_tasks(limit: int = 1000,
+               name: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Task lifecycle events folded to latest-state-per-task
+    (≈ `ray list tasks` over the GCS task events)."""
+    events = _call("state_tasks", {"limit": limit * 8})
+    latest: Dict[str, Dict[str, Any]] = {}
+    for ev in events:
+        latest[ev["task_id"]] = ev
+    out = [
+        ev for ev in latest.values()
+        if name is None or ev.get("name") == name
+    ]
+    return out[-limit:]
+
+
+def summarize_tasks() -> Dict[str, Dict[str, int]]:
+    """{task name: {state: count}} (≈ `ray summary tasks`)."""
+    summary: Dict[str, _Counter] = {}
+    for ev in list_tasks(limit=100_000):
+        summary.setdefault(ev["name"], _Counter())[ev["state"]] += 1
+    return {k: dict(v) for k, v in summary.items()}
+
+
+def cluster_metrics() -> str:
+    """The controller's Prometheus exposition text."""
+    return _call("metrics")

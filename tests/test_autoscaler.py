@@ -1,0 +1,147 @@
+"""Autoscaler: demand-driven scale-up, idle scale-down, bin-packing,
+providers. Mirrors the reference's autoscaler test strategy
+(`python/ray/tests/test_autoscaler.py` with a fake provider) over real
+supervisor processes via LocalNodeProvider."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (AutoscalerConfig, GCPTPUNodeProvider,
+                                LocalNodeProvider, NodeType,
+                                StandardAutoscaler)
+from ray_tpu.autoscaler.autoscaler import (_nodes_to_launch,
+                                           _unmet_after_packing)
+from ray_tpu.autoscaler.node_provider import tpu_slice_node_types
+
+
+class TestPacking:
+    def test_unmet_after_packing_uses_existing_capacity(self):
+        alive = [{"available": {"CPU": 2.0}}]
+        demand = [{"CPU": 1.0}, {"CPU": 1.0}, {"CPU": 1.0}]
+        unmet = _unmet_after_packing(demand, alive, [])
+        assert unmet == [{"CPU": 1.0}]
+
+    def test_nodes_to_launch_packs_multiple_bundles_per_node(self):
+        unmet = [{"CPU": 1.0}] * 4
+        types = [NodeType("quad", {"CPU": 4.0})]
+        launches = _nodes_to_launch(unmet, types, current=0, max_workers=8)
+        assert launches == {"quad": 1}
+
+    def test_nodes_to_launch_prefers_smallest_feasible(self):
+        unmet = [{"CPU": 1.0}]
+        types = [NodeType("big", {"CPU": 16.0}),
+                 NodeType("small", {"CPU": 2.0})]
+        launches = _nodes_to_launch(unmet, types, current=0, max_workers=8)
+        assert launches == {"small": 1}
+
+    def test_nodes_to_launch_respects_max_workers(self):
+        unmet = [{"CPU": 4.0}] * 5
+        types = [NodeType("quad", {"CPU": 4.0})]
+        launches = _nodes_to_launch(unmet, types, current=3, max_workers=5)
+        assert launches == {"quad": 2}
+
+    def test_unfittable_bundle_skipped(self):
+        unmet = [{"TPU": 8.0}]
+        types = [NodeType("cpuonly", {"CPU": 4.0})]
+        assert _nodes_to_launch(unmet, types, current=0, max_workers=8) == {}
+
+
+class TestTPUShapes:
+    def test_topology_expansion(self):
+        (t,) = tpu_slice_node_types("v5p-16")
+        assert t.resources["TPU"] == 4.0
+        assert t.node_config["hosts_per_slice"] == 2
+        assert "accelerator_type:V5P" in t.resources
+
+    def test_unknown_topology_raises(self):
+        with pytest.raises(ValueError, match="unknown TPU topology"):
+            tpu_slice_node_types("v99-1")
+
+    def test_gcp_provider_drives_injected_api(self):
+        calls = []
+
+        class FakeAPI:
+            def create(self, **kw):
+                calls.append(("create", kw))
+
+            def terminate(self, **kw):
+                calls.append(("terminate", kw))
+
+        (t,) = tpu_slice_node_types("v5e-8")
+        prov = GCPTPUNodeProvider("proj", "us-central2-b", api_client=FakeAPI())
+        ids = prov.create_node(t, 2)
+        assert len(ids) == 2
+        assert len(prov.non_terminated_nodes()) == 2
+        assert calls[0][1]["accelerator_type"] == "v5e-8"
+        prov.terminate_node(ids[0])
+        assert len(prov.non_terminated_nodes()) == 1
+        assert calls[-1][0] == "terminate"
+
+    def test_gcp_provider_refuses_without_api(self):
+        (t,) = tpu_slice_node_types("v4-8")
+        prov = GCPTPUNodeProvider("proj", "zone")
+        with pytest.raises(RuntimeError, match="api_client"):
+            prov.create_node(t, 1)
+
+
+class TestEndToEnd:
+    def test_infeasible_demand_launches_node_then_runs(self, ray_cluster):
+        """The VERDICT item-6 'done' test: infeasible demand -> provider
+        adds a node -> the parked lease is rescued and the task runs."""
+        ray_cluster.add_node(num_cpus=2)
+        ray_cluster.wait_for_nodes(1)
+        ray_tpu.init(address=ray_cluster.address)
+
+        provider = LocalNodeProvider(
+            ray_cluster.session_dir, ray_cluster.controller_addr)
+        autoscaler = StandardAutoscaler(
+            ray_cluster.controller_addr, provider,
+            AutoscalerConfig(
+                node_types=[NodeType("quad", {"CPU": 4.0})],
+                max_workers=2, update_interval_s=0.5))
+        try:
+            @ray_tpu.remote(num_cpus=4)
+            def big():
+                return ray_tpu.get_runtime_context().node_id
+
+            ref = big.remote()  # no node has 4 CPUs: parks infeasible
+            # let the supervisor gossip the pending demand
+            time.sleep(0.6)
+            summary = autoscaler.update()
+            assert summary["launched"] == {"quad": 1}
+            node_hex = ray_tpu.get(ref, timeout=60)
+            assert node_hex
+            # second update inside the grace window: no double launch
+            assert autoscaler.update()["launched"] == {}
+        finally:
+            autoscaler.stop()
+            provider.shutdown()
+
+    def test_idle_autoscaled_node_scaled_down(self, ray_cluster):
+        ray_cluster.add_node(num_cpus=2)
+        ray_cluster.wait_for_nodes(1)
+        ray_tpu.init(address=ray_cluster.address)
+
+        provider = LocalNodeProvider(
+            ray_cluster.session_dir, ray_cluster.controller_addr)
+        autoscaler = StandardAutoscaler(
+            ray_cluster.controller_addr, provider,
+            AutoscalerConfig(
+                node_types=[NodeType("quad", {"CPU": 4.0})],
+                max_workers=2, idle_timeout_s=1.5, launch_grace_s=3.0))
+        try:
+            provider.create_node(
+                NodeType("quad", {"CPU": 4.0}), 1)
+            ray_cluster.wait_for_nodes(2)
+            deadline = time.monotonic() + 30
+            removed = []
+            while time.monotonic() < deadline and not removed:
+                time.sleep(0.5)
+                removed = autoscaler.update()["removed"]
+            assert removed, "idle node never scaled down"
+            assert provider.non_terminated_nodes() == []
+        finally:
+            autoscaler.stop()
+            provider.shutdown()

@@ -1,0 +1,102 @@
+"""Metrics registry, Prometheus endpoints, and the state API.
+Reference analogs: `src/ray/stats/metric.h` unit behavior,
+`python/ray/tests/test_metrics_agent.py` (scrape during a run),
+`python/ray/util/state` listing tests."""
+
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.metrics import (Counter, Gauge, Histogram, Registry)
+from ray_tpu.util import state as state_api
+
+
+class TestRegistry:
+    def test_counter_and_labels(self):
+        reg = Registry()
+        c = Counter("t_total", "desc", registry=reg)
+        c.inc()
+        c.inc(2, labels={"k": "a"})
+        text = reg.render_prometheus()
+        assert "# TYPE t_total counter" in text
+        assert "t_total 1.0" in text
+        assert 't_total{k="a"} 2.0' in text
+
+    def test_gauge_set_inc_dec(self):
+        reg = Registry()
+        g = Gauge("t_gauge", registry=reg)
+        g.set(5)
+        g.inc()
+        g.dec(2)
+        assert "t_gauge 4.0" in reg.render_prometheus()
+
+    def test_histogram_buckets(self):
+        reg = Registry()
+        h = Histogram("t_hist", buckets=(0.1, 1.0, 10.0), registry=reg)
+        for v in (0.05, 0.5, 5.0, 500.0):
+            h.observe(v)
+        text = reg.render_prometheus()
+        assert 't_hist_bucket{le="0.1"} 1' in text
+        assert 't_hist_bucket{le="1.0"} 2' in text
+        assert 't_hist_bucket{le="10.0"} 3' in text
+        assert 't_hist_bucket{le="+Inf"} 4' in text
+        assert "t_hist_count 4" in text
+
+    def test_type_conflict_raises(self):
+        reg = Registry()
+        Counter("t_x", registry=reg)
+        with pytest.raises(ValueError, match="different type"):
+            Gauge("t_x", registry=reg)
+
+
+class TestClusterObservability:
+    def test_scrape_and_state_during_run(self, ray_init):
+        @ray_tpu.remote
+        def work(i):
+            return i * 2
+
+        assert ray_tpu.get([work.remote(i) for i in range(20)]) == \
+            [i * 2 for i in range(20)]
+
+        @ray_tpu.remote
+        class Holder:
+            def ping(self):
+                return "ok"
+
+        a = Holder.remote()
+        assert ray_tpu.get(a.ping.remote()) == "ok"
+
+        # controller metrics over RPC
+        text = state_api.cluster_metrics()
+        assert 'ray_tpu_nodes{state="alive"} 1.0' in text
+        assert "ray_tpu_actors" in text
+
+        # supervisor metrics over its HTTP endpoint
+        core = ray_tpu._private.api._require_core()
+        port = core._run(
+            core.clients.get(core.supervisor_addr).call("metrics_port"))
+        assert port > 0
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        assert "ray_tpu_leases_granted_total" in body
+        assert "ray_tpu_workers " in body
+
+        # state API
+        nodes = state_api.list_nodes()
+        assert len(nodes) == 1 and nodes[0]["alive"]
+        actors = state_api.list_actors(state="ALIVE")
+        assert any(rec["class_name"] == "Holder" for rec in actors)
+
+        # task events flush in batches of 100; push the rest through
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            ray_tpu.get([work.remote(i) for i in range(40)])
+            tasks = state_api.list_tasks(name=None)
+            if any(t["name"].endswith("work") for t in tasks):
+                break
+        summary = state_api.summarize_tasks()
+        work_keys = [k for k in summary if k.endswith("work")]
+        assert work_keys, f"no work tasks in {list(summary)[:5]}"
+        ray_tpu.kill(a)
