@@ -424,7 +424,7 @@ class RemoteFunction:
             strategy=_strategy_from_options(opts),
             max_retries=opts.get("max_retries", -1),
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
-            runtime_env=opts.get("runtime_env"),
+            runtime_env=_resolve_runtime_env(opts.get("runtime_env"), core),
             function_key=key,
             function_blob=blob,
         )
@@ -436,6 +436,16 @@ class RemoteFunction:
             f"remote function {self._fn.__name__}() cannot be called directly; "
             f"use .remote()"
         )
+
+
+def _resolve_runtime_env(env, core):
+    """Package local working_dir/py_modules paths into content-addressed
+    URIs uploaded to the cluster KV (see _private/runtime_env.py)."""
+    if not env:
+        return env
+    from ray_tpu._private.runtime_env import resolve_runtime_env
+
+    return resolve_runtime_env(env, core)
 
 
 def _resources_from_options(opts: Dict[str, Any]) -> Optional[Dict[str, float]]:
@@ -553,7 +563,7 @@ class ActorClass:
             max_task_retries=opts.get("max_task_retries", 0),
             max_concurrency=opts.get("max_concurrency", 1),
             is_async=is_async,
-            runtime_env=opts.get("runtime_env"),
+            runtime_env=_resolve_runtime_env(opts.get("runtime_env"), core),
             detached=opts.get("lifetime") == "detached",
             class_name=self._cls.__name__,
         )

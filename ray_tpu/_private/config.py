@@ -60,6 +60,8 @@ class Config:
     task_event_buffer_size: int = 100_000
     # Prometheus /metrics HTTP port per daemon: 0 = auto-pick, -1 = off
     metrics_export_port: int = 0
+    # bind address for /metrics; set 0.0.0.0 for off-host Prometheus
+    metrics_export_host: str = "127.0.0.1"
     # ---- TPU ----
     tpu_chips_per_host: int = 0  # 0 = autodetect via jax
     tpu_topology: str = ""  # e.g. "v5p-64"; "" = autodetect

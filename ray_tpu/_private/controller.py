@@ -163,6 +163,7 @@ class Controller:
         if self.config.metrics_export_port >= 0:
             try:
                 self.metrics_server = MetricsHttpServer(
+                    host=self.config.metrics_export_host,
                     port=self.config.metrics_export_port)
                 self.metrics_server.route("/metrics", self._render_metrics)
                 self.metrics_server.route(

@@ -328,12 +328,16 @@ class CoreWorker:
         await self._pump_shape(shape, spec)
 
     def _shape_key(self, spec: TaskSpec) -> str:
-        env = (spec.runtime_env or {}).get("env_vars", {})
+        from ray_tpu._private.runtime_env import runtime_env_cache_key
+
+        # the FULL runtime-env identity must partition leases: a cached
+        # lease on a plain worker must never serve a task that needs a
+        # staged working_dir / venv
         return repr(
             (
                 sorted(spec.required_resources().items()),
                 spec.strategy,
-                tuple(sorted(env.items())),
+                runtime_env_cache_key(spec.runtime_env),
             )
         )
 

@@ -23,10 +23,17 @@ def _label_key(labels: Optional[Dict[str, str]]) -> _LabelKey:
     return tuple(sorted((labels or {}).items()))
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus exposition escaping: one bad user label value must not
+    invalidate the whole scrape."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _render_labels(key: _LabelKey) -> str:
     if not key:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in key)
     return "{" + inner + "}"
 
 

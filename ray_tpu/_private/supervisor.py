@@ -38,6 +38,8 @@ from ray_tpu._private.metrics import Counter, Gauge, default_registry
 from ray_tpu._private.object_store import NodeObjectStore
 from ray_tpu._private.resources import ResourceSet, detect_node_resources
 from ray_tpu._private.rpc import ClientPool, RpcServer
+from ray_tpu._private.runtime_env import (RuntimeEnvManager,
+                                          runtime_env_cache_key)
 from ray_tpu._private.scheduling import NodeView, pick_node
 from ray_tpu._private.task_spec import PlacementGroupStrategy, TaskSpec
 
@@ -156,6 +158,13 @@ class Supervisor:
         self._monitor_task: Optional[asyncio.Task] = None
         # TPU chip assignment bookkeeping
         self._tpu_free: List[int] = list(range(int(self.total.get("TPU", 0))))
+        # runtime envs staged on this node (working_dir/py_modules/pip)
+        async def _kv_get(ns: str, key: str):
+            return await self.clients.get(self.controller_addr).call(
+                "kv_get", {"ns": ns, "key": key}, timeout=60)
+
+        self.runtime_envs = RuntimeEnvManager(
+            session_dir, self.node_id.hex()[:12], _kv_get)
         # metrics (rendered by the per-node /metrics endpoint)
         self.metrics_server: Optional[MetricsHttpServer] = None
         self._m_leases_granted = Counter(
@@ -199,6 +208,7 @@ class Supervisor:
         if self.config.metrics_export_port >= 0:
             try:
                 self.metrics_server = MetricsHttpServer(
+                    host=self.config.metrics_export_host,
                     port=self.config.metrics_export_port)
                 self.metrics_server.route("/metrics", self._render_metrics)
                 self.metrics_server.route(
@@ -565,8 +575,8 @@ class Supervisor:
 
     def _env_key_for(self, spec: TaskSpec) -> str:
         needs_tpu = spec.required_resources().get("TPU", 0) > 0
-        env_vars = (spec.runtime_env or {}).get("env_vars", {})
-        key = {"tpu": needs_tpu, "env": tuple(sorted(env_vars.items()))}
+        key = {"tpu": needs_tpu,
+               "env": runtime_env_cache_key(spec.runtime_env)}
         return repr(key)
 
     def _worker_env(self, spec: TaskSpec) -> Dict[str, str]:
@@ -594,8 +604,15 @@ class Supervisor:
     async def _spawn_worker(self, spec: TaskSpec, env_key: str) -> WorkerHandle:
         env = self._worker_env(spec)
         env["RAY_TPU_WORKER_ENV_KEY"] = env_key
+        env_spec = await self.runtime_envs.setup(spec.runtime_env)
+        extra_pp = env_spec.env_vars.pop("RAY_TPU_RUNTIME_ENV_PYTHONPATH", "")
+        if extra_pp:
+            env["PYTHONPATH"] = (
+                extra_pp + os.pathsep + env["PYTHONPATH"]
+                if env.get("PYTHONPATH") else extra_pp)
+        env.update(env_spec.env_vars)
         cmd = [
-            sys.executable,
+            env_spec.python,
             "-m",
             "ray_tpu._private.workers.default_worker",
             "--supervisor",
@@ -616,7 +633,17 @@ class Supervisor:
         wtag = f"worker-{len(self.workers)}-{os.getpid()}-{time.monotonic_ns() % 100000}"
         out = open(os.path.join(log_dir, wtag + ".out"), "ab")
         err = open(os.path.join(log_dir, wtag + ".err"), "ab")
-        proc = subprocess.Popen(cmd, env=env, stdout=out, stderr=err)
+        # workers run from the staged working_dir (imports + relative IO);
+        # the venv interpreter still needs ray_tpu importable — inherit
+        # our package root on PYTHONPATH
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        if pkg_root not in env.get("PYTHONPATH", "").split(os.pathsep):
+            env["PYTHONPATH"] = (
+                env["PYTHONPATH"] + os.pathsep + pkg_root
+                if env.get("PYTHONPATH") else pkg_root)
+        proc = subprocess.Popen(cmd, env=env, stdout=out, stderr=err,
+                                cwd=env_spec.cwd)
         out.close()  # child holds its own duplicates; keeping ours leaks fds
         err.close()
         self._m_workers_spawned.inc()

@@ -51,6 +51,7 @@ class StandardAutoscaler:
         self.provider = provider
         self.config = config
         self._launches: List[Tuple[float, str]] = []  # (ts, node_type)
+        self._seen_nodes: set = set()  # provider node_names seen alive
         self._thread: Optional[threading.Thread] = None
         self._stopped = threading.Event()
 
@@ -76,6 +77,7 @@ class StandardAutoscaler:
         """One reconcile pass; returns a summary for logging/tests."""
         state = self._fetch_state()
         alive = [n for n in state["nodes"] if n["alive"]]
+        self._prune_registered_launches(alive)
         demand: List[Dict[str, float]] = []
         for n in alive:
             demand.extend(n.get("pending_demand", []))
@@ -84,7 +86,8 @@ class StandardAutoscaler:
         to_launch = _nodes_to_launch(
             unmet, self.config.node_types,
             current=self._autoscaled_count(alive),
-            max_workers=self.config.max_workers)
+            max_workers=self.config.max_workers,
+            existing_by_type=self._alive_counts_by_type(alive))
         for node_type, count in to_launch.items():
             nt = next(t for t in self.config.node_types
                       if t.name == node_type)
@@ -96,6 +99,38 @@ class StandardAutoscaler:
         removed = self._scale_down_idle(alive, demand)
         return {"demand": len(demand), "unmet": len(unmet),
                 "launched": dict(to_launch), "removed": removed}
+
+    def _provider_types_by_name(self) -> Dict[str, str]:
+        return {n.get("node_name", n["id"]): n["node_type"]
+                for n in self.provider.non_terminated_nodes()}
+
+    def _prune_registered_launches(self, alive) -> None:
+        """A launch that has registered must stop counting as in-flight —
+        otherwise it is double-counted (live capacity AND pending pool),
+        suppressing legitimate scale-up until the grace window lapses."""
+        types_by_name = self._provider_types_by_name()
+        for n in alive:
+            name = n.get("labels", {}).get("node_name")
+            if name in types_by_name and name not in self._seen_nodes:
+                self._seen_nodes.add(name)
+                ntype = types_by_name[name]
+                for i, (_ts, lt) in enumerate(self._launches):
+                    if lt == ntype:
+                        self._launches.pop(i)
+                        break
+
+    def _alive_counts_by_type(self, alive) -> Dict[str, int]:
+        """Existing autoscaled nodes per type (+ in-flight launches), for
+        per-type max_workers enforcement across update() calls."""
+        types_by_name = self._provider_types_by_name()
+        counts: Dict[str, int] = {}
+        for n in alive:
+            ntype = types_by_name.get(n.get("labels", {}).get("node_name"))
+            if ntype is not None:
+                counts[ntype] = counts.get(ntype, 0) + 1
+        for _ts, ntype in self._launches:
+            counts[ntype] = counts.get(ntype, 0) + 1
+        return counts
 
     def _pending_types(self) -> List[NodeType]:
         """Launches still in their grace window count as capacity so a
@@ -184,11 +219,15 @@ def _unmet_after_packing(demand: List[Dict[str, float]], alive,
 
 def _nodes_to_launch(unmet: List[Dict[str, float]],
                      node_types: List[NodeType], *, current: int,
-                     max_workers: int) -> Dict[str, int]:
+                     max_workers: int,
+                     existing_by_type: Optional[Dict[str, int]] = None,
+                     ) -> Dict[str, int]:
     """Bin-pack unmet bundles into the fewest new nodes, smallest
     feasible type first (utilization-based scoring simplified to
-    resource-sum ordering)."""
+    resource-sum ordering). Per-type max_workers counts nodes that
+    already exist (existing_by_type), not just this pass's launches."""
     launches: Dict[str, int] = {}
+    existing_by_type = existing_by_type or {}
     budget = max(0, max_workers - current)
     if not budget:
         return launches
@@ -208,7 +247,8 @@ def _nodes_to_launch(unmet: List[Dict[str, float]],
         for t in ordered:
             fits = all(t.resources.get(k, 0.0) >= v
                        for k, v in bundle.items())
-            within = launches.get(t.name, 0) < t.max_workers
+            within = (launches.get(t.name, 0)
+                      + existing_by_type.get(t.name, 0)) < t.max_workers
             if fits and within and sum(launches.values()) < budget:
                 pool = dict(t.resources)
                 for k, v in bundle.items():

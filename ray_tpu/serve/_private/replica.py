@@ -202,8 +202,10 @@ class ReplicaActor:
 
     async def prepare_for_shutdown(self) -> None:
         # drain: wait for in-flight requests AND actively-consumed streams
-        # (abandoned streams must not burn the drain window)
+        # (abandoned streams must not burn the drain window). The window
+        # must exceed stream_next's 10s server-side pull wait — a consumer
+        # blocked in a pull is active even though last_pull is aging.
         deadline = time.monotonic() + 10
-        while ((self._ongoing > 0 or self._active_streams(window_s=5.0))
+        while ((self._ongoing > 0 or self._active_streams(window_s=15.0))
                and time.monotonic() < deadline):
             await asyncio.sleep(0.02)

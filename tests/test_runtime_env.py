@@ -1,0 +1,110 @@
+"""Runtime environments: working_dir, py_modules, pip, worker reuse.
+Reference analogs: `python/ray/tests/test_runtime_env_working_dir.py`,
+`test_runtime_env_conda_and_pip.py` (offline-local variant)."""
+
+import os
+import sys
+import textwrap
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def project_dir(tmp_path):
+    d = tmp_path / "myproj"
+    d.mkdir()
+    (d / "secretmod.py").write_text("VALUE = 42\n")
+    (d / "data.txt").write_text("hello from working_dir\n")
+    return str(d)
+
+
+class TestWorkingDir:
+    def test_task_imports_shipped_module_and_reads_cwd(self, ray_init,
+                                                       project_dir):
+        @ray_tpu.remote(runtime_env={"working_dir": project_dir})
+        def probe():
+            import secretmod  # exists only in the shipped working_dir
+
+            with open("data.txt") as f:  # cwd is the staged dir
+                data = f.read().strip()
+            return secretmod.VALUE, data, os.path.basename(os.getcwd())
+
+        value, data, cwd = ray_tpu.get(probe.remote(), timeout=60)
+        assert value == 42
+        assert data == "hello from working_dir"
+        assert cwd == "myproj"
+
+    def test_actor_with_working_dir(self, ray_init, project_dir):
+        @ray_tpu.remote
+        class A:
+            def read(self):
+                import secretmod
+
+                return secretmod.VALUE
+
+        a = A.options(runtime_env={"working_dir": project_dir}).remote()
+        assert ray_tpu.get(a.read.remote(), timeout=60) == 42
+        ray_tpu.kill(a)
+
+    def test_env_workers_isolated_from_base_pool(self, ray_init,
+                                                 project_dir):
+        @ray_tpu.remote
+        def pid():
+            return os.getpid()
+
+        base_pids = set(ray_tpu.get([pid.remote() for _ in range(4)]))
+        env_pid = ray_tpu.get(
+            pid.options(runtime_env={"working_dir": project_dir}).remote(),
+            timeout=60)
+        # a runtime-env worker never comes from the plain pool
+        assert env_pid not in base_pids
+
+
+class TestPyModules:
+    def test_py_modules_importable(self, ray_init, tmp_path):
+        pkg = tmp_path / "shiny"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("def shine():\n    return 'bright'\n")
+
+        @ray_tpu.remote(runtime_env={"py_modules": [str(pkg)]})
+        def probe():
+            import shiny
+
+            return shiny.shine()
+
+        assert ray_tpu.get(probe.remote(), timeout=60) == "bright"
+
+
+class TestPip:
+    def test_pip_local_package_in_venv(self, ray_init, tmp_path):
+        """Offline pip: install a local sdist-style package into the
+        per-env venv; the worker runs under that venv's interpreter."""
+        pkg = tmp_path / "tinypkg"
+        pkg.mkdir()
+        (pkg / "setup.py").write_text(textwrap.dedent("""
+            from setuptools import setup
+            setup(name="tinypkg", version="0.1", py_modules=["tinything"])
+        """))
+        (pkg / "tinything.py").write_text("ANSWER = 1234\n")
+
+        @ray_tpu.remote(runtime_env={"pip": [str(pkg)]})
+        def probe():
+            import tinything
+
+            return tinything.ANSWER, sys.prefix
+
+        answer, prefix = ray_tpu.get(probe.remote(), timeout=180)
+        assert answer == 1234
+        assert "venv_" in prefix  # ran under the per-env venv interpreter
+
+
+class TestValidation:
+    def test_missing_path_raises_at_submit(self, ray_init):
+        @ray_tpu.remote(runtime_env={"working_dir": "/nonexistent/xyz"})
+        def probe():
+            return 1
+
+        with pytest.raises(FileNotFoundError):
+            probe.remote()
