@@ -62,6 +62,8 @@ class Config:
     metrics_export_port: int = 0
     # bind address for /metrics; set 0.0.0.0 for off-host Prometheus
     metrics_export_host: str = "127.0.0.1"
+    # controller durable-state snapshot cadence (actors/PGs/jobs/KV)
+    controller_snapshot_interval_ms: int = 500
     # ---- TPU ----
     tpu_chips_per_host: int = 0  # 0 = autodetect via jax
     tpu_topology: str = ""  # e.g. "v5p-64"; "" = autodetect

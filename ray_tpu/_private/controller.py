@@ -10,8 +10,10 @@ also serving as the function table), pubsub fan-out (≈ `src/ray/pubsub/`) and
 the task-event sink (≈ `GcsTaskManager`) backing the state API.
 
 Storage is in-memory (≈ `in_memory_store_client.h`); the record tables are
-plain dicts behind a single asyncio loop, with an optional JSON snapshot for
-restart recovery standing in for the Redis path.
+plain dicts behind a single asyncio loop, snapshotted to the session dir on
+an interval for restart recovery — the Redis-backed `gcs_init_data.h` path's
+stand-in: a restarted controller reloads actors/PGs/jobs/KV, and supervisors
+re-register via the node_sync "unknown_node" handshake.
 """
 
 from __future__ import annotations
@@ -124,8 +126,10 @@ class Controller:
     owning asyncio loop (no locks, mirroring the reference's single-threaded
     GCS event loop)."""
 
-    def __init__(self, config: Config, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, config: Config, host: str = "127.0.0.1", port: int = 0,
+                 snapshot_path: str = ""):
         self.config = config
+        self.snapshot_path = snapshot_path
         self.server = RpcServer(host, port if port else config.controller_port)
         self.server.register_object(self)
         self.clients = ClientPool(
@@ -141,6 +145,8 @@ class Controller:
         self.task_events: deque = deque(maxlen=config.task_event_buffer_size)
         self._health_task: Optional[asyncio.Task] = None
         self._pg_retry_task: Optional[asyncio.Task] = None
+        self._snapshot_task: Optional[asyncio.Task] = None
+        self._state_dirty = False
         self._next_job_int = 0
         self._started = time.time()
         # metrics (≈ metric_defs.h:46 definitions, served per-daemon)
@@ -153,13 +159,127 @@ class Controller:
         self._m_task_events = Counter("ray_tpu_task_events_total",
                                       "Task lifecycle events received")
 
+    # ----------------------------------------------------------- persistence
+
+    _SNAPSHOT_VERSION = 1
+
+    def _snapshot_state(self) -> dict:
+        """The durable subset: everything a restarted controller needs to
+        keep serving existing clients (≈ what the reference rebuilds from
+        Redis via gcs_init_data.h). Node records are NOT persisted —
+        supervisors re-register on their next sync. Task events and
+        subscribers are soft state."""
+        return {
+            "version": self._SNAPSHOT_VERSION,
+            "actors": self.actors,
+            "named_actors": self.named_actors,
+            "pgs": self.pgs,
+            "jobs": self.jobs,
+            "kv": self.kv,
+            "next_job_int": self._next_job_int,
+        }
+
+    def _mark_dirty(self) -> None:
+        self._state_dirty = True
+
+    def _write_snapshot(self) -> None:
+        if not self.snapshot_path:
+            return
+        blob = serialization.dumps(self._snapshot_state())
+        tmp = self.snapshot_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, self.snapshot_path)
+
+    def _load_snapshot(self) -> bool:
+        if not self.snapshot_path or not os.path.exists(self.snapshot_path):
+            return False
+        try:
+            with open(self.snapshot_path, "rb") as f:
+                state = serialization.loads(f.read())
+        except Exception:
+            logger.exception("controller snapshot unreadable; starting fresh")
+            return False
+        if state.get("version") != self._SNAPSHOT_VERSION:
+            logger.warning("controller snapshot version mismatch; ignoring")
+            return False
+        self.actors = state["actors"]
+        self.named_actors = state["named_actors"]
+        self.pgs = state["pgs"]
+        self.jobs = state["jobs"]
+        self.kv = state["kv"]
+        self._next_job_int = state["next_job_int"]
+        logger.info(
+            "controller recovered from snapshot: %d actors, %d pgs, "
+            "%d jobs, %d kv namespaces",
+            len(self.actors), len(self.pgs), len(self.jobs), len(self.kv))
+        return True
+
+    async def _snapshot_loop(self) -> None:
+        interval = max(0.1, self.config.controller_snapshot_interval_ms / 1000)
+        while True:
+            await asyncio.sleep(interval)
+            if not self._state_dirty:
+                continue  # nothing changed since the last write
+            self._state_dirty = False
+            try:
+                # serialize on-loop (consistent view), write off-loop so a
+                # large KV/function table never stalls RPC handling
+                blob = serialization.dumps(self._snapshot_state())
+
+                def write(blob=blob):
+                    tmp = self.snapshot_path + ".tmp"
+                    with open(tmp, "wb") as f:
+                        f.write(blob)
+                    os.replace(tmp, self.snapshot_path)
+
+                await asyncio.get_running_loop().run_in_executor(None, write)
+            except Exception:
+                self._state_dirty = True
+                logger.exception("controller snapshot write failed")
+
+    async def _reconcile_recovered(self) -> None:
+        """Fail over snapshot-recovered actors/PGs whose node never came
+        back: the health loop only probes registered nodes, so a host lost
+        during the controller outage would otherwise stay 'ALIVE' forever."""
+        grace = (self.config.health_check_period_ms
+                 * self.config.health_check_failure_threshold / 1000.0) + 3.0
+        await asyncio.sleep(grace)
+        for actor in list(self.actors.values()):
+            if actor.state in (ACTOR_ALIVE, ACTOR_PENDING, ACTOR_RESTARTING) \
+                    and actor.node_id_hex \
+                    and actor.node_id_hex not in self.nodes:
+                logger.warning(
+                    "recovered actor %s on node %s that never re-registered; "
+                    "failing over", actor.actor_id_hex[:8],
+                    actor.node_id_hex[:8])
+                await self._on_actor_failure(
+                    actor, "node lost during controller outage")
+        for pg in self.pgs.values():
+            if pg.state == PG_CREATED and any(
+                    h not in self.nodes for h in pg.assignment):
+                pg.state = PG_PENDING
+                pg.assignment = []
+                await self._publish(
+                    "pg:" + pg.pg_id_hex,
+                    {"state": PG_PENDING, "pg_id_hex": pg.pg_id_hex})
+        await self._retry_pending_pgs()
+
     # ------------------------------------------------------------- lifecycle
 
     async def start(self) -> Address:
+        recovered = self._load_snapshot()
         addr = await self.server.start()
         loop = asyncio.get_running_loop()
         self._health_task = loop.create_task(self._health_loop())
         self._pg_retry_task = loop.create_task(self._pg_retry_loop())
+        if self.snapshot_path:
+            self._snapshot_task = loop.create_task(self._snapshot_loop())
+        if recovered:
+            # surviving nodes re-register within a sync period; anything
+            # still on an unknown node after the grace window was lost
+            # during the outage and must fail over
+            loop.create_task(self._reconcile_recovered())
         if self.config.metrics_export_port >= 0:
             try:
                 self.metrics_server = MetricsHttpServer(
@@ -217,9 +337,14 @@ class Controller:
                 logger.exception("pg retry failed")
 
     async def stop(self) -> None:
-        for t in (self._health_task, self._pg_retry_task):
+        for t in (self._health_task, self._pg_retry_task,
+                  self._snapshot_task):
             if t is not None:
                 t.cancel()
+        try:
+            self._write_snapshot()
+        except Exception:
+            pass
         if self.metrics_server is not None:
             await self.metrics_server.stop()
         await self.clients.close_all()
@@ -243,11 +368,13 @@ class Controller:
         await self._retry_pending_pgs()
         return {"num_nodes": len(self.nodes)}
 
-    async def rpc_node_sync(self, body) -> None:
+    async def rpc_node_sync(self, body):
         """Resource gossip from supervisors (≈ ray_syncer)."""
         rec = self.nodes.get(body["node_id_hex"])
         if rec is None:
-            return
+            # a restarted controller has no node table: tell the
+            # supervisor to re-register (recovery handshake)
+            return {"unknown_node": True}
         rec.available = ResourceSet.of(body["available"])
         if "total" in body:
             rec.total = ResourceSet.of(body["total"])
@@ -338,12 +465,14 @@ class Controller:
         if not overwrite and body["key"] in ns:
             return False
         ns[body["key"]] = body["value"]
+        self._mark_dirty()
         return True
 
     async def rpc_kv_get(self, body):
         return self.kv.get(body.get("ns", ""), {}).get(body["key"])
 
     async def rpc_kv_del(self, body) -> bool:
+        self._mark_dirty()
         return self.kv.get(body.get("ns", ""), {}).pop(body["key"], None) is not None
 
     async def rpc_kv_exists(self, body) -> bool:
@@ -389,6 +518,7 @@ class Controller:
         self.actors[hexid] = rec
         if name:
             self.named_actors[(namespace, name)] = hexid
+        self._mark_dirty()
         return {"ok": True}
 
     async def rpc_actor_ready(self, body) -> None:
@@ -401,6 +531,7 @@ class Controller:
         rec.worker_id_hex = body.get("worker_id_hex", "")
         rec.node_id_hex = body.get("node_id_hex", "")
         rec.incarnation += 1
+        self._mark_dirty()
         await self._publish(
             "actor:" + rec.actor_id_hex,
             {
@@ -463,6 +594,7 @@ class Controller:
             rec.num_restarts += 1
             rec.state = ACTOR_RESTARTING
             rec.address = None
+            self._mark_dirty()
             await self._publish(
                 "actor:" + rec.actor_id_hex,
                 {"state": ACTOR_RESTARTING, "num_restarts": rec.num_restarts},
@@ -478,6 +610,7 @@ class Controller:
         rec.state = ACTOR_DEAD
         rec.death_cause = reason
         rec.address = None
+        self._mark_dirty()
         await self._publish(
             "actor:" + rec.actor_id_hex, {"state": ACTOR_DEAD, "reason": reason}
         )
@@ -536,6 +669,7 @@ class Controller:
             creator_job_hex=body.get("job_id_hex", ""),
         )
         self.pgs[pg.pg_id_hex] = pg
+        self._mark_dirty()
         await self._try_place_pg(pg)
         return {"state": pg.state, "assignment": pg.assignment}
 
@@ -578,6 +712,7 @@ class Controller:
             return
         pg.assignment = assignment
         pg.state = PG_CREATED
+        self._mark_dirty()
         await self._publish(
             "pg:" + pg.pg_id_hex,
             {"state": PG_CREATED, "assignment": assignment, "pg_id_hex": pg.pg_id_hex},
@@ -613,6 +748,7 @@ class Controller:
                 pass
         pg.state = PG_REMOVED
         pg.assignment = []
+        self._mark_dirty()
         await self._publish("pg:" + pg.pg_id_hex, {"state": PG_REMOVED})
 
     # ------------------------------------------------------------- jobs
@@ -621,6 +757,7 @@ class Controller:
         """Issue a cluster-unique job number (drivers must not mint their own:
         two drivers on one cluster would both claim job 1)."""
         self._next_job_int += 1
+        self._mark_dirty()
         return self._next_job_int
 
     async def rpc_job_register(self, body) -> None:
@@ -629,12 +766,14 @@ class Controller:
             driver_address=tuple(body["driver_address"]) if body.get("driver_address") else None,
             start_time=time.time(),
         )
+        self._mark_dirty()
 
     async def rpc_job_finish(self, body) -> None:
         job = self.jobs.get(body["job_id_hex"])
         if job:
             job.alive = False
             job.end_time = time.time()
+            self._mark_dirty()
 
     async def rpc_job_list(self, body=None) -> list:
         return [dataclasses.asdict(j) for j in self.jobs.values()]
@@ -722,6 +861,7 @@ def main() -> None:
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--session-dir", default="")
     parser.add_argument("--address-file", default="")
+    parser.add_argument("--snapshot-path", default="")
     args = parser.parse_args()
 
     logging.basicConfig(
@@ -730,7 +870,11 @@ def main() -> None:
     )
 
     async def run():
-        controller = Controller(Config.from_env(), args.host, args.port)
+        snapshot = args.snapshot_path
+        if not snapshot and args.session_dir:
+            snapshot = os.path.join(args.session_dir, "controller_state.bin")
+        controller = Controller(Config.from_env(), args.host, args.port,
+                                snapshot_path=snapshot)
         addr = await controller.start()
         if args.address_file:
             tmp = args.address_file + ".tmp"

@@ -277,7 +277,7 @@ class Supervisor:
         ctrl = self.clients.get(self.controller_addr)
         while True:
             try:
-                await ctrl.call(
+                sync_resp = await ctrl.call(
                     "node_sync",
                     {
                         "node_id_hex": self.node_id.hex(),
@@ -293,6 +293,21 @@ class Supervisor:
                     },
                     timeout=5,
                 )
+                if isinstance(sync_resp, dict) and sync_resp.get("unknown_node"):
+                    # controller restarted (recovered from snapshot, node
+                    # table empty): re-register with current state
+                    await ctrl.call(
+                        "node_register",
+                        {
+                            "node_id_hex": self.node_id.hex(),
+                            "address": self.server.address,
+                            "total": dict(self.total),
+                            "available": dict(self.available),
+                            "labels": {**self.labels,
+                                       "node_name": self.node_name},
+                        },
+                        timeout=5,
+                    )
                 views = await ctrl.call("node_views", timeout=5)
                 self.cluster_view = [
                     NodeView(

@@ -75,6 +75,27 @@ class Cluster:
         self.nodes.append(node)
         return node
 
+    def restart_controller(self) -> None:
+        """Hard-kill the controller and start a replacement on the SAME
+        address; it recovers actors/PGs/jobs/KV from the session-dir
+        snapshot (controller fault-tolerance chaos testing)."""
+        try:
+            self.controller_proc.kill()
+            self.controller_proc.wait(timeout=5)
+        except Exception:
+            pass
+        # the dead controller's address file would satisfy the startup
+        # wait immediately; remove it so we observe the NEW controller's
+        # write (and actually detect a failed respawn)
+        try:
+            os.remove(os.path.join(self.session_dir, "controller_address"))
+        except FileNotFoundError:
+            pass
+        self.controller_proc, addr = start_controller(
+            self.session_dir, self.config, port=self.controller_addr[1]
+        )
+        assert addr == self.controller_addr, (addr, self.controller_addr)
+
     def remove_node(self, node: ClusterNode) -> None:
         node.kill()
         if node in self.nodes:
