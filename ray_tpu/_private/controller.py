@@ -607,6 +607,7 @@ class Controller:
         if restart and (rec.num_restarts < rec.max_restarts or rec.max_restarts == -1):
             await self._on_actor_failure(rec, reason)
             return
+        owner_addr = rec.address
         rec.state = ACTOR_DEAD
         rec.death_cause = reason
         rec.address = None
@@ -614,6 +615,27 @@ class Controller:
         await self._publish(
             "actor:" + rec.actor_id_hex, {"state": ACTOR_DEAD, "reason": reason}
         )
+        # ownership fate-sharing (reference: non-detached actors die with
+        # their owner): actors CREATED BY the dead actor's process must
+        # not outlive it holding resources
+        if owner_addr is not None:
+            for child in list(self.actors.values()):
+                if (child.owner == owner_addr
+                        and not child.detached
+                        and child.state != ACTOR_DEAD):
+                    node = self.nodes.get(child.node_id_hex)
+                    if child.state == ACTOR_ALIVE and node is not None \
+                            and node.alive:
+                        try:
+                            await self.clients.get(node.address).call(
+                                "kill_worker",
+                                {"worker_id_hex": child.worker_id_hex},
+                                timeout=5)
+                        except Exception:
+                            pass
+                    await self._kill_actor(
+                        child, f"owner actor {rec.actor_id_hex[:8]} died",
+                        restart=False)
 
     async def _restart_actor(self, rec: ActorRecord) -> None:
         """Re-run the creation task on a fresh worker (≈ gcs_actor_manager.cc:1190)."""

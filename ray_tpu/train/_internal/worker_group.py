@@ -47,6 +47,12 @@ class RayTrainWorker:
     def start_session(self, session_kwargs: Dict[str, Any]) -> bool:
         from ray_tpu.train._internal import session as session_mod
 
+        session_kwargs = dict(session_kwargs)
+        gang_pg = session_kwargs.pop("gang_pg", None)
+        if gang_pg is not None:
+            # this process hosts a Tune trial whose gang PG also covers
+            # the trainer's workers (bundles 1..N)
+            set_ambient_placement_group(gang_pg, bundle_offset=1)
         self._session = session_mod.init_session(**session_kwargs)
         self._session.start()
         return True
@@ -58,6 +64,7 @@ class RayTrainWorker:
     def end_session(self) -> None:
         from ray_tpu.train._internal import session as session_mod
 
+        set_ambient_placement_group(None)
         session_mod.shutdown_session()
         self._session = None
 
@@ -73,30 +80,58 @@ class WorkerMetadata:
         self.local_world_size = 1
 
 
+# Ambient gang placement group: a Tune trial reserves ONE placement group
+# covering the trial actor AND its trainer's whole worker gang (bundle 0 =
+# trial actor, bundles 1..N = train workers); the trainer's WorkerGroup
+# inside the trial joins that group instead of creating its own, so
+# concurrent trials can never hold actors while starving each other's
+# worker bundles (reference: tune/execution/placement_groups.py).
+_ambient_pg: Optional[PlacementGroup] = None
+_ambient_bundle_offset: int = 0
+
+
+def set_ambient_placement_group(pg: Optional[PlacementGroup],
+                                bundle_offset: int = 1) -> None:
+    global _ambient_pg, _ambient_bundle_offset
+    _ambient_pg = pg
+    _ambient_bundle_offset = bundle_offset
+
+
 class WorkerGroup:
     def __init__(
         self,
         num_workers: int,
         resources_per_worker: Optional[Dict[str, float]] = None,
         placement_strategy: str = "PACK",
+        placement_group: Optional[PlacementGroup] = None,
+        bundle_offset: int = 0,
     ):
         self._num_workers = num_workers
         self._resources = dict(resources_per_worker or {"CPU": 1.0})
-        self._pg: Optional[PlacementGroup] = None
+        self._pg: Optional[PlacementGroup] = placement_group
+        self._owns_pg = placement_group is None
+        self._bundle_offset = bundle_offset
         self.workers: List[WorkerMetadata] = []
         self._placement_strategy = placement_strategy
+        if self._pg is None and _ambient_pg is not None:
+            self._pg = _ambient_pg
+            self._bundle_offset = _ambient_bundle_offset
+            self._owns_pg = False
 
     def start(self, timeout: float = 60.0) -> None:
-        bundles = [dict(self._resources) for _ in range(self._num_workers)]
-        self._pg = placement_group(bundles, strategy=self._placement_strategy)
+        if self._owns_pg:
+            bundles = [dict(self._resources)
+                       for _ in range(self._num_workers)]
+            self._pg = placement_group(
+                bundles, strategy=self._placement_strategy)
         if not self._pg.wait(timeout=timeout):
-            remove_placement_group(self._pg)
+            if self._owns_pg:
+                remove_placement_group(self._pg)
             raise TimeoutError(
                 f"placement group for {self._num_workers} workers "
                 f"({self._resources}) not ready in {timeout}s")
 
         worker_cls = ray_tpu.remote(RayTrainWorker)
-        opts: Dict[str, Any] = {"placement_group": self._pg}
         num_cpus = self._resources.get("CPU", 1.0)
         res = {k: v for k, v in self._resources.items() if k != "CPU"}
         actors = [
@@ -104,7 +139,7 @@ class WorkerGroup:
                 num_cpus=num_cpus,
                 resources=res or None,
                 placement_group=self._pg,
-                placement_group_bundle_index=i,
+                placement_group_bundle_index=self._bundle_offset + i,
             ).remote()
             for i in range(self._num_workers)
         ]
@@ -153,9 +188,9 @@ class WorkerGroup:
             except Exception:
                 pass
         self.workers = []
-        if self._pg is not None:
+        if self._pg is not None and self._owns_pg:
             try:
                 remove_placement_group(self._pg)
             except Exception:
                 pass
-            self._pg = None
+        self._pg = None

@@ -62,9 +62,19 @@ class TuneController:
         mode: str = "max",
         max_concurrent_trials: Optional[int] = None,
         stop: Optional[Dict[str, Any]] = None,
+        gang_bundles: Optional[List[Dict[str, float]]] = None,
+        gang_strategy: str = "PACK",
+        gang_placement_timeout_s: float = 60.0,
     ):
         self._fn = trainable_fn
         self.trials = trials
+        # one PG per trial covering the trial actor + its trainer's
+        # worker gang; None for plain function trainables
+        self._gang_bundles = gang_bundles
+        self._gang_strategy = gang_strategy
+        self._gang_timeout = gang_placement_timeout_s
+        self._trial_pgs: Dict[str, Any] = {}
+        self._pg_created_at: Dict[str, float] = {}
         self._run_config = run_config
         self._scheduler = scheduler or sched_mod.FIFOScheduler()
         self._scheduler.set_objective(metric or "_none_", mode)
@@ -119,20 +129,68 @@ class TuneController:
             for rt in list(self._running.values()):
                 rt.shutdown()
             self._running.clear()
+            for trial in self.trials:
+                self._remove_trial_pg(trial)
             self.save_state()
         return self.trials
 
     def _start_pending(self) -> None:
+        slots = self._max_concurrent - len(self._running)
         for trial in self.trials:
-            if len(self._running) >= self._max_concurrent:
+            if slots <= 0:
                 return
-            if trial.status == PENDING:
-                self._start_trial(trial)
+            if trial.status != PENDING:
+                continue
+            if self._gang_bundles is not None:
+                pg = self._ensure_trial_pg(trial)
+                if not pg.wait(timeout=0.05):
+                    # gang not placed yet: the trial stays PENDING and we
+                    # keep polling running trials — never block the loop.
+                    # An unsatisfiable gang must surface, not spin forever.
+                    age = time.monotonic() - self._pg_created_at.get(
+                        trial.trial_id, time.monotonic())
+                    if age > self._gang_timeout:
+                        self._remove_trial_pg(trial)
+                        trial.status = ERROR
+                        trial.error = (
+                            f"gang placement group {self._gang_bundles} not "
+                            f"placeable within {self._gang_timeout}s")
+                        self.save_state()
+                        continue
+                    slots -= 1  # the pg holds a start slot
+                    continue
+            self._start_trial(trial)
+            slots -= 1
+
+    def _ensure_trial_pg(self, trial: Trial):
+        from ray_tpu.util.placement_group import placement_group
+
+        pg = self._trial_pgs.get(trial.trial_id)
+        if pg is None:
+            pg = placement_group(
+                [dict(b) for b in self._gang_bundles],
+                strategy=self._gang_strategy)
+            self._trial_pgs[trial.trial_id] = pg
+            self._pg_created_at[trial.trial_id] = time.monotonic()
+        return pg
+
+    def _remove_trial_pg(self, trial: Trial) -> None:
+        from ray_tpu.util.placement_group import remove_placement_group
+
+        pg = self._trial_pgs.pop(trial.trial_id, None)
+        self._pg_created_at.pop(trial.trial_id, None)
+        if pg is not None:
+            try:
+                remove_placement_group(pg)
+            except Exception:
+                pass
 
     def _start_trial(self, trial: Trial,
                      checkpoint: Optional[Checkpoint] = None) -> None:
+        pg = self._trial_pgs.get(trial.trial_id)
         group = WorkerGroup(num_workers=1,
-                            resources_per_worker=trial.resources)
+                            resources_per_worker=trial.resources,
+                            placement_group=pg, bundle_offset=0)
         group.start()
         storage = StorageContext(self._run_config.storage_path,
                                  self._experiment_name,
@@ -148,6 +206,7 @@ class TuneController:
             trial_name=f"trial_{trial.trial_id}",
             loaded_checkpoint=ckpt,
             trial_info={"trial_id": trial.trial_id, "config": trial.config},
+            gang_pg=pg,  # trainer's inner WorkerGroup joins bundles 1..N
         )
         rt = _RunningTrial(trial, group)
         try:
@@ -156,6 +215,7 @@ class TuneController:
             group.shutdown()
             trial.status = ERROR
             trial.error = f"failed to start: {e}"
+            self._remove_trial_pg(trial)
             return
         trial.status = RUNNING
         rt.arm()
@@ -220,9 +280,14 @@ class TuneController:
                     exploit.new_config)
         rt.shutdown()
         self._running.pop(trial.trial_id, None)
+        # fresh gang PG for the restart: the old one's bundles may still be
+        # transiently held while the inner workers die with their owner
+        self._remove_trial_pg(trial)
         trial.config = exploit.new_config
-        trial.status = PENDING
-        self._start_trial(trial, checkpoint=src_ckpt)
+        if src is not None and src.latest_checkpoint_path:
+            # restart from the exploited trial's checkpoint
+            trial.latest_checkpoint_path = src.latest_checkpoint_path
+        trial.status = PENDING  # the main loop re-places and restarts it
 
     def _should_stop(self, result: Dict[str, Any]) -> bool:
         for k, v in self._stop_criteria.items():
@@ -237,6 +302,7 @@ class TuneController:
         self._scheduler.on_trial_complete(rt.trial, rt.trial.last_result)
         rt.shutdown()
         self._running.pop(rt.trial.trial_id, None)
+        self._remove_trial_pg(rt.trial)
         self.save_state()
 
     def _on_trial_failed(self, rt: _RunningTrial, error: str) -> None:
@@ -248,8 +314,10 @@ class TuneController:
         self._running.pop(trial.trial_id, None)
         if self._max_failures < 0 or trial.num_failures <= self._max_failures:
             trial.status = PENDING  # restart from its latest checkpoint
+            self._remove_trial_pg(trial)  # restart places a fresh gang
         else:
             trial.status = ERROR
             trial.error = error
             self._scheduler.on_trial_complete(trial, trial.last_result)
+            self._remove_trial_pg(trial)
         self.save_state()

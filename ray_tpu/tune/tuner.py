@@ -145,7 +145,7 @@ class Tuner:
     # ------------------------------------------------------------------ fit
 
     def fit(self) -> ResultGrid:
-        fn, resources = self._resolve_trainable()
+        fn, resources, gang_bundles = self._resolve_trainable()
         if self._restored_trials is not None:
             trials = self._restored_trials
         else:
@@ -163,6 +163,10 @@ class Tuner:
             mode=self._tune_config.mode,
             max_concurrent_trials=self._tune_config.max_concurrent_trials,
             stop=self._run_config.stop,
+            gang_bundles=gang_bundles,
+            gang_strategy=(self._trainable.scaling_config.placement_strategy
+                           if isinstance(self._trainable, BaseTrainer)
+                           else "PACK"),
         )
         trials = controller.run()
         return self._to_result_grid(trials, controller)
@@ -171,11 +175,18 @@ class Tuner:
         t = self._trainable
         resources = getattr(t, "_tune_resources", None)
         if isinstance(t, BaseTrainer):
-            # trial actor itself is light (the trainer's worker group claims
-            # its own resources inside the trial), unless overridden
-            return _trainer_to_fn(t), resources or {"CPU": 1.0}
+            # gang-reserve the trial actor AND the trainer's whole worker
+            # group in ONE placement group per trial (bundle 0 = trial
+            # actor, 1..N = train workers) so concurrent trials can never
+            # hold actors while starving each other's worker bundles
+            # (reference: tune/execution/placement_groups.py)
+            sc = t.scaling_config
+            trial_bundle = dict(resources
+                                or sc.trainer_resources or {"CPU": 1.0})
+            gang = [trial_bundle] + sc.as_placement_group_bundles()
+            return _trainer_to_fn(t), trial_bundle, gang
         if callable(t):
-            return t, resources or {"CPU": 1.0}
+            return t, resources or {"CPU": 1.0}, None
         raise TypeError(f"not a trainable: {t!r}")
 
     def _to_result_grid(self, trials: List[Trial],

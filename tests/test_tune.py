@@ -251,3 +251,4 @@ class TestTrainerInTuner:
         ).fit()
         assert grid.num_errors == 0, [str(e) for e in grid.errors]
         assert grid.get_best_result().metrics["out"] == 15
+

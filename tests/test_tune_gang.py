@@ -1,0 +1,52 @@
+"""Tune gang scheduling on a constrained cluster (own module: it
+needs exclusive control of cluster lifecycle, incompatible with the
+module-scoped ray_init the main tune tests share)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air.config import RunConfig
+from ray_tpu.tune import TuneConfig, Tuner
+
+
+class TestGangScheduling:
+    def test_concurrent_trainer_trials_no_deadlock(self, ray_cluster,
+                                                   tmp_path):
+        """Tune-over-Trainer on a constrained cluster: each trial gang-
+        reserves trial actor + train workers in ONE placement group, so
+        trial actors can never occupy every CPU and starve each other's
+        worker groups (reference: tune/execution/placement_groups.py).
+
+        Without gang PGs this configuration deadlocks: 3 trial actors
+        claim 3 of 4 CPUs and each inner 2-worker group waits forever."""
+        ray_cluster.add_node(num_cpus=4)
+        ray_cluster.wait_for_nodes(1)
+        ray_tpu.init(address=ray_cluster.address)
+
+        from ray_tpu.train import DataParallelTrainer, ScalingConfig
+        from ray_tpu.train._internal.session import get_session
+
+        def loop(config):
+            sess = get_session()
+            sess.report({"score": config["x"] * 10})
+
+        trainer = DataParallelTrainer(
+            loop,
+            train_loop_config={"x": 0},
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(storage_path=str(tmp_path / "inner")),
+        )
+        tuner = Tuner(
+            trainer,
+            param_space={"train_loop_config": {
+                "x": tune.grid_search([1, 2, 3])}},
+            tune_config=TuneConfig(metric="score", mode="max",
+                                   max_concurrent_trials=3),
+            run_config=RunConfig(name="gang",
+                                 storage_path=str(tmp_path / "exp")),
+        )
+        grid = tuner.fit()
+        assert grid.num_errors == 0, [str(e) for e in grid.errors]
+        assert len(grid) == 3
+        assert grid.get_best_result().metrics["score"] == 30
