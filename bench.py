@@ -33,22 +33,13 @@ def _peak_flops(device) -> float:
     return PEAK_FLOPS["cpu"]
 
 
-def main() -> None:
+def _run_config(cfg, batch: int, seq: int, steps: int):
+    """Compile + time one train-step config; returns (dt, n_params)."""
     import jax
-    import jax.numpy as jnp
 
-    from ray_tpu.models import gpt2_small, count_params
+    from ray_tpu.models import count_params
     from ray_tpu.models.training import (OptimizerConfig, init_train_state,
                                          make_train_step)
-
-    on_tpu = jax.default_backend() == "tpu"
-    if on_tpu:
-        cfg = gpt2_small()
-        batch, seq, steps = 16, 1024, 20
-    else:  # keep the CPU smoke run short
-        cfg = gpt2_small(num_layers=2, embed_dim=128, num_heads=4,
-                         vocab_size=1024, dtype=jnp.float32)
-        batch, seq, steps = 4, 128, 3
 
     ocfg = OptimizerConfig(warmup_steps=10, decay_steps=1000)
     state, tx = init_train_state(cfg, ocfg, jax.random.PRNGKey(0))
@@ -64,8 +55,47 @@ def main() -> None:
         state, m = step(state, b)
     float(m["loss"])
     dt = (time.perf_counter() - t0) / steps
+    return dt, count_params(state.params)
 
-    n_params = count_params(state.params)
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt2_small
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        batch, seq, steps = 16, 1024, 20
+        # MFU counts model flops only, so full remat's ~2N recompute
+        # flops/token cap it at 0.75x utilization. GPT-2s activations at
+        # this batch fit v5e HBM without remat; fall back through
+        # save-dots remat to full remat if memory says otherwise.
+        candidates = [gpt2_small(remat=False),
+                      gpt2_small(remat_policy="dots"),
+                      gpt2_small()]
+    else:  # keep the CPU smoke run short
+        batch, seq, steps = 4, 128, 3
+        candidates = [gpt2_small(num_layers=2, embed_dim=128, num_heads=4,
+                                 vocab_size=1024, dtype=jnp.float32)]
+
+    dt = n_params = cfg = None
+    for i, cand in enumerate(candidates):
+        try:
+            dt, n_params = _run_config(cand, batch, seq, steps)
+            cfg = cand
+            break
+        except Exception as e:
+            if i == len(candidates) - 1:
+                raise
+            # fall back only for memory pressure; any other failure in the
+            # lighter-remat paths is a real bug that must surface
+            msg = f"{type(e).__name__}: {e}"
+            if "RESOURCE_EXHAUSTED" not in msg and "memory" not in msg.lower():
+                raise
+            import sys
+            print(f"bench: candidate {i} OOM, falling back ({msg[:200]})",
+                  file=sys.stderr)
     tokens_per_step = batch * seq
     # Model FLOPs only (MFU convention — remat recompute excluded):
     # fwd+bwd ≈ 6 flops/param/token + attention 12*L*S*E per token.
@@ -82,6 +112,7 @@ def main() -> None:
             "tokens_per_sec": round(tokens_per_step / dt),
             "step_time_ms": round(dt * 1e3, 2),
             "params": n_params,
+            "remat": cfg.remat_policy if cfg.remat else "none",
             "device": str(getattr(jax.devices()[0], "device_kind", "cpu")),
         },
     }))

@@ -47,6 +47,10 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16                 # activation/compute dtype
     param_dtype: Any = jnp.float32
     remat: bool = True                        # checkpoint each block
+    # 'full': recompute everything in backward (min memory, ~+2N flops/tok);
+    # 'dots': save matmul outputs, recompute elementwise only (near-full
+    # memory, tiny recompute) — the right trade when HBM allows
+    remat_policy: str = "full"
     scan_layers: bool = True                  # stack layers, lax.scan over them
     attn_impl: str = "auto"                   # 'auto'|'flash'|'reference'|'ring'
 
@@ -258,9 +262,17 @@ def forward(cfg: TransformerConfig, params, tokens, *, positions=None,
 
     block_fn = _block
     if cfg.remat and kv_caches is None:
+        policies = {
+            "full": jax.checkpoint_policies.nothing_saveable,
+            "dots": jax.checkpoint_policies.checkpoint_dots,
+        }
+        if cfg.remat_policy not in policies:
+            raise ValueError(
+                f"unknown remat_policy {cfg.remat_policy!r}; "
+                f"expected one of {sorted(policies)}")
+        policy = policies[cfg.remat_policy]
         block_fn = jax.checkpoint(
-            _block, static_argnums=(0, 5),
-            policy=jax.checkpoint_policies.nothing_saveable)
+            _block, static_argnums=(0, 5), policy=policy)
 
     new_caches = None
     if cfg.scan_layers and kv_caches is None:
