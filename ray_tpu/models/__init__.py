@@ -14,4 +14,5 @@ from ray_tpu.models.presets import (  # noqa: F401
     gpt2_medium,
     llama3_8b,
     llama_debug,
+    moe_debug,
 )

@@ -51,3 +51,16 @@ def llama_debug(**overrides) -> TransformerConfig:
     )
     kw.update(overrides)
     return TransformerConfig(**kw)
+
+
+def moe_debug(**overrides) -> TransformerConfig:
+    """Tiny MoE config (SwiGLU experts, top-2 routing) for tests and
+    expert-parallel dry runs."""
+    kw = dict(
+        vocab_size=256, num_layers=2, embed_dim=64, num_heads=4,
+        num_kv_heads=2, mlp="moe", mlp_dim=128, moe_num_experts=4,
+        moe_top_k=2, max_seq_len=128, norm="rmsnorm", pos="rope",
+        tie_embeddings=False, dtype=jnp.float32,
+    )
+    kw.update(overrides)
+    return TransformerConfig(**kw)

@@ -9,7 +9,8 @@ and XLA/GSPMD inserts all collectives.
 Config switches:
   * norm: 'rmsnorm' (LLaMA) | 'layernorm' (GPT-2)
   * pos:  'rope' (LLaMA) | 'learned' (GPT-2)
-  * mlp:  'swiglu' (LLaMA) | 'gelu' (GPT-2)
+  * mlp:  'swiglu' (LLaMA) | 'gelu' (GPT-2) | 'moe' (SwiGLU experts,
+          top-k routing, expert-parallel over the `ep` mesh axis)
   * GQA via num_kv_heads; tied embeddings via tie_embeddings.
 """
 
@@ -37,10 +38,15 @@ class TransformerConfig:
     num_heads: int = 12
     num_kv_heads: Optional[int] = None        # None => MHA
     mlp_dim: Optional[int] = None             # None => 4x (gelu) / 8/3x (swiglu)
+    # MoE (mlp='moe'): SwiGLU experts, top-k routing, EP-sharded experts
+    moe_num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
     max_seq_len: int = 2048
     norm: str = "rmsnorm"                     # 'rmsnorm' | 'layernorm'
     pos: str = "rope"                         # 'rope' | 'learned'
-    mlp: str = "swiglu"                       # 'swiglu' | 'gelu'
+    mlp: str = "swiglu"                       # 'swiglu' | 'gelu' | 'moe'
     rope_theta: float = 10000.0
     tie_embeddings: bool = True
     norm_eps: float = 1e-5
@@ -66,7 +72,7 @@ class TransformerConfig:
     def hidden_dim(self) -> int:
         if self.mlp_dim:
             return self.mlp_dim
-        if self.mlp == "swiglu":
+        if self.mlp in ("swiglu", "moe"):
             # LLaMA convention: 2/3 * 4d rounded to a multiple of 256
             h = int(8 * self.embed_dim / 3)
             return 256 * ((h + 255) // 256)
@@ -94,7 +100,14 @@ def _block_params(cfg: TransformerConfig, key) -> Dict[str, Any]:
         "ln1": _norm_params(cfg, d),
         "ln2": _norm_params(cfg, d),
     }
-    if cfg.mlp == "swiglu":
+    if cfg.mlp == "moe":
+        from ray_tpu.ops.moe import init_moe_params
+
+        if cfg.moe_num_experts < 2:
+            raise ValueError("mlp='moe' needs moe_num_experts >= 2")
+        p["mlp"] = init_moe_params(ks[4], d, f, cfg.moe_num_experts,
+                                   cfg.param_dtype)
+    elif cfg.mlp == "swiglu":
         p["mlp"] = {
             "w_gate": init(ks[4], (d, f)),
             "w_up": init(ks[5], (d, f)),
@@ -158,7 +171,11 @@ def logical_axes(cfg: TransformerConfig) -> Dict[str, Any]:
         "ln1": norm_axes(),
         "ln2": norm_axes(),
     }
-    if cfg.mlp == "swiglu":
+    if cfg.mlp == "moe":
+        from ray_tpu.ops.moe import moe_logical_axes
+
+        block["mlp"] = {k: L + v for k, v in moe_logical_axes().items()}
+    elif cfg.mlp == "swiglu":
         block["mlp"] = {"w_gate": L + ("embed", "mlp"),
                         "w_up": L + ("embed", "mlp"),
                         "w_down": L + ("mlp", "embed")}
@@ -223,27 +240,37 @@ def _attn(cfg, p, x, rope, positions, sp_axis, kv_cache=None):
 
 
 def _mlp(cfg, p, x):
+    """Returns (y, aux_loss) — aux is 0 except for MoE routing."""
+    if cfg.mlp == "moe":
+        from ray_tpu.ops.moe import moe_layer
+
+        return moe_layer(p, x, num_experts=cfg.moe_num_experts,
+                         top_k=cfg.moe_top_k,
+                         capacity_factor=cfg.moe_capacity_factor,
+                         dtype=cfg.dtype)
     if cfg.mlp == "swiglu":
         gate = jnp.einsum("bsd,df->bsf", x, p["w_gate"].astype(cfg.dtype))
         up = jnp.einsum("bsd,df->bsf", x, p["w_up"].astype(cfg.dtype))
         return jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up,
-                          p["w_down"].astype(cfg.dtype))
+                          p["w_down"].astype(cfg.dtype)), 0.0
     h = jnp.einsum("bsd,df->bsf", x, p["w_in"].astype(cfg.dtype))
     h = jax.nn.gelu(h + p["b_in"].astype(cfg.dtype), approximate=True)
-    return jnp.einsum("bsf,fd->bsd", h,
-                      p["w_out"].astype(cfg.dtype)) + p["b_out"].astype(cfg.dtype)
+    return (jnp.einsum("bsf,fd->bsd", h, p["w_out"].astype(cfg.dtype))
+            + p["b_out"].astype(cfg.dtype)), 0.0
 
 
 def _block(cfg, p, x, rope, positions, sp_axis, kv_cache=None):
     a, new_cache = _attn(cfg, p["attn"], _norm(cfg, p["ln1"], x), rope,
                          positions, sp_axis, kv_cache)
     x = x + a
-    x = x + _mlp(cfg, p["mlp"], _norm(cfg, p["ln2"], x))
-    return x, new_cache
+    m, aux = _mlp(cfg, p["mlp"], _norm(cfg, p["ln2"], x))
+    x = x + m
+    return x, new_cache, aux
 
 
 def forward(cfg: TransformerConfig, params, tokens, *, positions=None,
-            sp_axis: Optional[str] = None, kv_caches=None):
+            sp_axis: Optional[str] = None, kv_caches=None,
+            return_aux: bool = False):
     """tokens [B, S] int32 -> logits [B, S, vocab].
 
     sp_axis: when running inside shard_map with sequence sharded over that
@@ -275,24 +302,29 @@ def forward(cfg: TransformerConfig, params, tokens, *, positions=None,
             _block, static_argnums=(0, 5), policy=policy)
 
     new_caches = None
+    aux_total = 0.0
     if cfg.scan_layers and kv_caches is None:
-        def body(h, layer_params):
-            h, _ = block_fn(cfg, layer_params, h, rope, positions, sp_axis)
-            return h, None
-        x, _ = jax.lax.scan(body, x, params["blocks"])
+        def body(carry, layer_params):
+            h, aux_acc = carry
+            h, _, aux = block_fn(cfg, layer_params, h, rope, positions,
+                                 sp_axis)
+            return (h, aux_acc + aux), None
+        (x, aux_total), _ = jax.lax.scan(body, (x, 0.0), params["blocks"])
     elif cfg.scan_layers:
         new_caches = []
         for i in range(cfg.num_layers):
             layer_p = jax.tree.map(lambda a, i=i: a[i], params["blocks"])
-            x, c = _block(cfg, layer_p, x, rope, positions, sp_axis,
-                          kv_caches[i])
+            x, c, aux = _block(cfg, layer_p, x, rope, positions, sp_axis,
+                               kv_caches[i])
+            aux_total = aux_total + aux
             new_caches.append(c)
     else:
         new_caches = [] if kv_caches is not None else None
         for i in range(cfg.num_layers):
             cache = kv_caches[i] if kv_caches is not None else None
-            x, c = block_fn(cfg, params["blocks"][str(i)], x, rope,
-                            positions, sp_axis, cache)
+            x, c, aux = block_fn(cfg, params["blocks"][str(i)], x, rope,
+                                 positions, sp_axis, cache)
+            aux_total = aux_total + aux
             if new_caches is not None:
                 new_caches.append(c)
 
@@ -305,6 +337,8 @@ def forward(cfg: TransformerConfig, params, tokens, *, positions=None,
                             params["lm_head"]["kernel"].astype(cfg.dtype))
     if kv_caches is not None:
         return logits, new_caches
+    if return_aux:
+        return logits, aux_total
     return logits
 
 
@@ -313,11 +347,16 @@ def loss_fn(cfg: TransformerConfig, params, batch, *, sp_axis=None,
     """Causal-LM loss. batch: {'tokens': [B,S], optional 'mask': [B,S]}.
     Targets are tokens shifted left; the last position is dropped."""
     tokens = batch["tokens"]
-    logits = forward(cfg, params, tokens, sp_axis=sp_axis, positions=positions)
+    logits, aux = forward(cfg, params, tokens, sp_axis=sp_axis,
+                          positions=positions, return_aux=True)
     targets = tokens[:, 1:]
     logits = logits[:, :-1]
     mask = batch.get("mask")
     if mask is not None:
         mask = mask[:, 1:]
     loss, n = softmax_cross_entropy(logits, targets, mask)
-    return loss, {"loss": loss, "tokens": n}
+    metrics = {"loss": loss, "tokens": n}
+    if cfg.mlp == "moe":
+        loss = loss + cfg.moe_aux_weight * aux
+        metrics["moe_aux"] = aux
+    return loss, metrics

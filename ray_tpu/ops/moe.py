@@ -1,0 +1,130 @@
+"""Mixture-of-Experts layer with expert parallelism over the `ep` axis.
+
+TPU-native MoE in the GShard/Switch pattern (the reference has no MoE at
+all — SURVEY §5 makes EP first-class here): a router picks top-k experts
+per token, tokens are dispatched into per-expert capacity buckets with
+one-hot dispatch/combine tensors (einsums, so everything stays dense and
+MXU-shaped), and the expert dimension is sharded over the mesh's `ep`
+axis — GSPMD turns the dispatch/combine einsums into all_to_all over ICI.
+
+All shapes are static: capacity = ceil(tokens/experts) * capacity_factor,
+overflow tokens are dropped by the capacity mask (standard Switch
+behavior) and still contribute the residual stream unchanged.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def init_moe_params(key, embed_dim: int, hidden_dim: int, num_experts: int,
+                    param_dtype=jnp.float32) -> Dict[str, Any]:
+    """SwiGLU experts: router [d,E] + per-expert gate/up [E,d,f], down [E,f,d]."""
+    ks = jax.random.split(key, 4)
+    init = jax.nn.initializers.normal(0.02, param_dtype)
+    return {
+        "w_router": init(ks[0], (embed_dim, num_experts)),
+        "w_gate": init(ks[1], (num_experts, embed_dim, hidden_dim)),
+        "w_up": init(ks[2], (num_experts, embed_dim, hidden_dim)),
+        "w_down": init(ks[3], (num_experts, hidden_dim, embed_dim)),
+    }
+
+
+def moe_logical_axes() -> Dict[str, Tuple[Optional[str], ...]]:
+    return {
+        "w_router": ("embed", None),
+        "w_gate": ("expert", "embed", "mlp"),
+        "w_up": ("expert", "embed", "mlp"),
+        "w_down": ("expert", "mlp", "embed"),
+    }
+
+
+def moe_layer(p: Dict[str, Any], x, *, num_experts: int, top_k: int = 2,
+              capacity_factor: float = 1.25,
+              dtype=jnp.bfloat16, ep_mesh=None) -> Tuple[Any, Any]:
+    """x: [B, S, d] -> (y: [B, S, d], aux_loss: scalar).
+
+    aux_loss is the Switch load-balancing loss
+    (E * sum_e fraction_tokens_e * mean_router_prob_e); add it to the
+    task loss scaled by ~1e-2.
+
+    Expert-parallel layout: under plain jit, GSPMD propagates the `ep`
+    sharding from the expert parameters (the tested path). Pass `ep_mesh`
+    (or establish a mesh context via `jax.set_mesh`) to additionally pin
+    the [E, C, d] dispatch buffers to `ep` explicitly.
+    """
+    b, s, d = x.shape
+    n = b * s
+    xt = x.reshape(n, d)
+    logits = jnp.einsum("nd,de->ne", xt, p["w_router"].astype(dtype))
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [N, E]
+
+    # top-k gate weights, renormalized over the chosen experts
+    gate_vals, gate_idx = jax.lax.top_k(probs, top_k)  # [N, k]
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(-1, keepdims=True), 1e-9)
+
+    capacity = max(1, int(math.ceil(n / num_experts * capacity_factor)))
+
+    # position of each (token, choice) within its expert's bucket:
+    # one-hot [N, k, E] -> cumulative count per expert in token order
+    choice_one_hot = jax.nn.one_hot(gate_idx, num_experts,
+                                    dtype=jnp.float32)  # [N, k, E]
+    flat_choices = choice_one_hot.reshape(n * top_k, num_experts)
+    position = (jnp.cumsum(flat_choices, axis=0) - flat_choices).reshape(
+        n, top_k, num_experts)  # slots used before this (token, choice)
+    in_capacity = position < capacity
+    keep = choice_one_hot * in_capacity  # [N, k, E]
+
+    pos_idx = jnp.minimum(
+        (position * choice_one_hot).sum(-1), capacity - 1
+    ).astype(jnp.int32)  # [N, k]
+    pos_one_hot = jax.nn.one_hot(pos_idx, capacity, dtype=jnp.float32)
+
+    # dispatch [N, E, C]: token n goes to expert e slot c
+    dispatch = jnp.einsum("nke,nkc->nec", keep, pos_one_hot)
+    # combine adds the gate weight
+    combine = jnp.einsum("nke,nkc,nk->nec", keep, pos_one_hot,
+                         gate_vals.astype(jnp.float32))
+
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(dtype), xt)
+    expert_in = _maybe_ep_constraint(expert_in, ep_mesh)
+    gate = jnp.einsum("ecd,edf->ecf", expert_in, p["w_gate"].astype(dtype))
+    up = jnp.einsum("ecd,edf->ecf", expert_in, p["w_up"].astype(dtype))
+    expert_out = jnp.einsum("ecf,efd->ecd", jax.nn.silu(gate) * up,
+                            p["w_down"].astype(dtype))
+    expert_out = _maybe_ep_constraint(expert_out, ep_mesh)
+    y = jnp.einsum("nec,ecd->nd", combine.astype(dtype), expert_out)
+
+    # Switch aux loss: encourage uniform routing
+    top1 = jax.nn.one_hot(gate_idx[:, 0], num_experts, dtype=jnp.float32)
+    fraction = top1.mean(0)          # tokens routed to e (top-1)
+    mean_prob = probs.mean(0)        # router mass on e
+    aux = num_experts * jnp.sum(fraction * mean_prob)
+    return y.reshape(b, s, d), aux
+
+
+def _maybe_ep_constraint(arr, ep_mesh=None):
+    """Pin the expert (leading) dim to the `ep` mesh axis.
+
+    Applies when an explicit mesh is passed or an ambient mesh context
+    (jax.set_mesh / use_mesh) carries an `ep` axis. Under plain jit with
+    no mesh context this is a no-op — get_abstract_mesh() is empty there
+    (verified on jax 0.9) and GSPMD propagates the layout from the
+    EP-sharded expert parameters instead.
+    """
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    spec = P("ep", *([None] * (arr.ndim - 1)))
+    if ep_mesh is not None and "ep" in ep_mesh.axis_names:
+        return jax.lax.with_sharding_constraint(
+            arr, NamedSharding(ep_mesh, spec))
+    ambient = jax.sharding.get_abstract_mesh()
+    if ambient is not None and "ep" in getattr(ambient, "axis_names", ()):
+        return jax.lax.with_sharding_constraint(arr, spec)
+    return arr
