@@ -179,6 +179,19 @@ def init(
                 {"job_id_hex": core.job_id.hex(), "driver_address": core.address},
             )
         )
+        if log_to_driver:
+            # worker stdout/stderr stream to this process (supervisors
+            # tail the files and publish; ≈ the reference's log monitor)
+            def _print_worker_logs(msg):
+                import sys as _sys
+
+                stream = (_sys.stderr if msg.get("stream") == "stderr"
+                          else _sys.stdout)
+                tag = f"({msg.get('node', '?')} pid={msg.get('pid', '?')})"
+                for line in msg.get("lines", []):
+                    print(f"{tag} {line}", file=stream)
+
+            core.subscribe("worker_logs", _print_worker_logs)
         return {
             "address": f"{controller_addr[0]}:{controller_addr[1]}",
             "node_id": core.node_id_hex,

@@ -70,6 +70,10 @@ class WorkerHandle:
     is_actor: bool = False
     actor_id_hex: str = ""
     tpu_chips: List[int] = dataclasses.field(default_factory=list)
+    # stdout/stderr files + read offsets for log streaming to drivers
+    log_paths: Tuple[str, str] = ("", "")
+    log_offsets: List[int] = dataclasses.field(
+        default_factory=lambda: [0, 0])
 
 
 @dataclasses.dataclass
@@ -156,6 +160,9 @@ class Supervisor:
         self._sync_task: Optional[asyncio.Task] = None
         self._reap_task: Optional[asyncio.Task] = None
         self._monitor_task: Optional[asyncio.Task] = None
+        self._log_task: Optional[asyncio.Task] = None
+        # pid -> log paths for spawned-but-unregistered workers
+        self._spawned_log_paths: Dict[int, Tuple[str, str]] = {}
         # TPU chip assignment bookkeeping
         self._tpu_free: List[int] = list(range(int(self.total.get("TPU", 0))))
         # runtime envs staged on this node (working_dir/py_modules/pip)
@@ -205,6 +212,7 @@ class Supervisor:
         self._sync_task = loop.create_task(self._sync_loop())
         self._reap_task = loop.create_task(self._reap_loop())
         self._monitor_task = loop.create_task(self._monitor_loop())
+        self._log_task = loop.create_task(self._log_tail_loop())
         if self.config.metrics_export_port >= 0:
             try:
                 self.metrics_server = MetricsHttpServer(
@@ -243,7 +251,8 @@ class Supervisor:
         return self.metrics_server.port if self.metrics_server else -1
 
     async def stop(self) -> None:
-        for t in (self._sync_task, self._reap_task, self._monitor_task):
+        for t in (self._sync_task, self._reap_task, self._monitor_task,
+                  self._log_task):
             if t is not None:
                 t.cancel()
         if self.metrics_server is not None:
@@ -661,6 +670,7 @@ class Supervisor:
                                 cwd=env_spec.cwd)
         out.close()  # child holds its own duplicates; keeping ours leaks fds
         err.close()
+        self._spawned_log_paths[proc.pid] = (out.name, err.name)
         self._m_workers_spawned.inc()
         self._spawned_procs[proc.pid] = proc
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
@@ -675,6 +685,7 @@ class Supervisor:
             except ValueError:
                 pass
             self._spawned_procs.pop(proc.pid, None)
+            self._spawned_log_paths.pop(proc.pid, None)
             proc.kill()
             raise RuntimeError(
                 f"worker failed to register within "
@@ -692,6 +703,7 @@ class Supervisor:
             idle_since=time.monotonic(),
             # bind the Popen by the worker's own pid — never by spawn order
             proc=self._spawned_procs.pop(body["pid"], None),
+            log_paths=self._spawned_log_paths.pop(body["pid"], ("", "")),
         )
         self.workers[handle.worker_id_hex] = handle
         waiters = self._spawn_waiters.get(handle.env_key)
@@ -740,6 +752,7 @@ class Supervisor:
         _trace(f"worker_exit {w.worker_id_hex[:8]} is_actor={w.is_actor} actor={w.actor_id_hex[:8]} code={w.proc.poll() if w.proc else None}")
         self.workers.pop(w.worker_id_hex, None)
         self._m_worker_exits.inc()
+        await self._drain_worker_logs(w)
         try:
             self.idle.get(w.env_key, deque()).remove(w)
         except ValueError:
@@ -775,6 +788,69 @@ class Supervisor:
         if w.tpu_chips:
             self._tpu_free.extend(w.tpu_chips)
 
+    async def _log_tail_loop(self) -> None:
+        """Stream worker stdout/stderr to drivers (log_to_driver): tail
+        each worker's log files and publish new lines through the
+        controller pubsub (channel 'worker_logs'); drivers subscribe and
+        print (≈ the reference's log monitor, log_monitor.py)."""
+        ctrl = self.clients.get(self.controller_addr)
+        while True:
+            await asyncio.sleep(0.5)
+            try:
+                batches = self._collect_new_log_lines()
+                for msg in batches:
+                    await ctrl.notify(
+                        "publish", {"channel": "worker_logs", "message": msg})
+            except Exception:
+                logger.debug("log tail failed", exc_info=True)
+
+    def _collect_new_log_lines(self, workers=None,
+                               final: bool = False) -> List[dict]:
+        out: List[dict] = []
+        for w in (workers if workers is not None
+                  else list(self.workers.values())):
+            for i, path in enumerate(w.log_paths):
+                if not path:
+                    continue
+                try:
+                    with open(path, "rb") as f:
+                        f.seek(w.log_offsets[i])
+                        data = f.read(1024 * 1024)
+                except OSError:
+                    continue
+                if not data:
+                    continue
+                # only consume up to the last newline so a chunk landing
+                # mid-line isn't split into two fake lines (a dead
+                # worker's trailing partial line IS final output)
+                if not final:
+                    cut = data.rfind(b"\n")
+                    if cut < 0:
+                        continue
+                    data = data[:cut + 1]
+                w.log_offsets[i] += len(data)
+                lines = data.decode(errors="replace").splitlines()
+                if lines:
+                    out.append({
+                        "pid": w.pid,
+                        "worker_id_hex": w.worker_id_hex,
+                        "node": self.node_name,
+                        "stream": "stdout" if i == 0 else "stderr",
+                        "lines": lines,
+                    })
+        return out
+
+    async def _drain_worker_logs(self, w: WorkerHandle) -> None:
+        """Publish a dead worker's remaining output — the crash traceback
+        is exactly the part written after the last poll tick."""
+        try:
+            ctrl = self.clients.get(self.controller_addr)
+            for msg in self._collect_new_log_lines([w], final=True):
+                await ctrl.notify(
+                    "publish", {"channel": "worker_logs", "message": msg})
+        except Exception:
+            logger.debug("final log drain failed", exc_info=True)
+
     async def _reap_loop(self) -> None:
         """Kill surplus idle workers (≈ idle worker killing in worker_pool.cc)."""
         while True:
@@ -803,6 +879,11 @@ class Supervisor:
                 w = pool.popleft()
                 _trace(f"reap {w.worker_id_hex[:8]} is_actor={w.is_actor}")
                 self.workers.pop(w.worker_id_hex, None)
+                try:
+                    asyncio.get_running_loop().create_task(
+                        self._drain_worker_logs(w))
+                except RuntimeError:
+                    pass
                 if w.proc is not None:
                     try:
                         w.proc.terminate()

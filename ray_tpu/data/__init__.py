@@ -3,6 +3,7 @@
 
 from ray_tpu.data.block import Block  # noqa: F401
 from ray_tpu.data.dataset import (  # noqa: F401
+    ActorPoolStrategy,
     Dataset,
     GroupedData,
     MaterializedDataset,

@@ -24,12 +24,16 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 import numpy as np
 
 import ray_tpu
-from ray_tpu.data._internal.logical import (AllToAll, InputData, Limit,
-                                            LogicalOp, OneToOne, Read, Union,
-                                            Zip, fuse_transforms)
+from ray_tpu.data._internal.logical import (ActorPoolMap, AllToAll, InputData,
+                                            Limit, LogicalOp, OneToOne, Read,
+                                            Union, Zip, fuse_transforms)
 from ray_tpu.data.block import (Block, block_meta, concat_blocks, slice_block)
 
 DEFAULT_CONCURRENCY = 8
+# memory-aware backpressure: a stage narrows its in-flight window when
+# observed block sizes would put more than this many bytes in flight
+# (≈ the reference's resource-budget backpressure, streaming_executor.py:48)
+DEFAULT_MAX_BYTES_IN_FLIGHT = 256 * 1024 * 1024
 
 # ---------------------------------------------------------------- task fns
 
@@ -161,14 +165,33 @@ def resolve_meta(meta) -> Dict[str, Any]:
 
 
 def _windowed(submit: Callable[[Any], RefMeta], upstream: Iterator,
-              concurrency: int) -> Iterator[RefMeta]:
+              concurrency: int,
+              max_bytes: int = DEFAULT_MAX_BYTES_IN_FLIGHT) -> Iterator[RefMeta]:
+    """Bounded in-flight submission window with byte-aware backpressure:
+    the effective window shrinks below `concurrency` when the running
+    average block size implies more than `max_bytes` in flight."""
     window: deque = deque()
+    bytes_seen = 0
+    blocks_seen = 0
+
+    def pop() -> RefMeta:
+        nonlocal bytes_seen, blocks_seen
+        ref, meta = window.popleft()
+        m = resolve_meta(meta)
+        bytes_seen += m.get("size_bytes", 0) or 0
+        blocks_seen += 1
+        return ref, m
+
     for item in upstream:
-        if len(window) >= concurrency:
-            yield window.popleft()
+        effective = concurrency
+        if blocks_seen:
+            avg = max(1.0, bytes_seen / blocks_seen)
+            effective = max(1, min(concurrency, int(max_bytes / avg)))
+        while len(window) >= effective:
+            yield pop()
         window.append(submit(item))
     while window:
-        yield window.popleft()
+        yield pop()
 
 
 class ReadStage:
@@ -185,16 +208,83 @@ class ReadStage:
 
 
 class MapStage:
-    def __init__(self, transform, concurrency):
+    def __init__(self, transform, concurrency, num_cpus: float = None):
         self.transform = transform
         self.concurrency = concurrency
+        self.num_cpus = num_cpus
 
     def run(self, upstream) -> Iterator[RefMeta]:
+        opts = {"num_returns": 2}
+        if self.num_cpus is not None:
+            opts["num_cpus"] = self.num_cpus
+
         def submit(pair):
-            r = _xform_r.options(num_returns=2).remote(self.transform, pair[0])
+            r = _xform_r.options(**opts).remote(self.transform, pair[0])
             return (r[0], r[1])
 
         return _windowed(submit, upstream, self.concurrency)
+
+
+class _MapWorker:
+    """Actor body for stateful batch UDFs: constructs the callable once,
+    then maps blocks through it (≈ _MapWorker in
+    actor_pool_map_operator.py)."""
+
+    def __init__(self, fn_cls, ctor_args, ctor_kwargs, batch_size,
+                 batch_format, fn_args, fn_kwargs):
+        from ray_tpu.data._internal.logical import make_map_batches_transform
+
+        self._fn = fn_cls(*ctor_args, **(ctor_kwargs or {}))
+        self._transform = make_map_batches_transform(
+            self._fn, batch_size, batch_format, fn_args, fn_kwargs)
+
+    def apply(self, block):
+        out = self._transform(block)
+        return out, block_meta(out)
+
+
+class ActorPoolMapStage:
+    """Streams blocks through a fixed pool of stateful map actors with a
+    bounded per-actor in-flight window; output order == input order."""
+
+    def __init__(self, op: ActorPoolMap):
+        self.op = op
+
+    def run(self, upstream) -> Iterator[RefMeta]:
+        op = self.op
+        worker_cls = ray_tpu.remote(_MapWorker)
+        pool = [
+            worker_cls.options(num_cpus=op.num_cpus).remote(
+                op.fn_cls, op.fn_constructor_args, op.fn_constructor_kwargs,
+                op.batch_size, op.batch_format, op.fn_args, op.fn_kwargs)
+            for _ in range(op.pool_size)
+        ]
+        inflight = [0] * len(pool)
+        window: deque = deque()  # (ref, meta_ref, actor_idx)
+        cap = op.pool_size * op.max_tasks_in_flight_per_actor
+
+        def pop() -> RefMeta:
+            ref, meta, idx = window.popleft()
+            m = resolve_meta(meta)  # blocks until that actor finished it
+            inflight[idx] -= 1
+            return ref, m
+
+        try:
+            for ref, _meta in upstream:
+                while len(window) >= cap:
+                    yield pop()
+                idx = min(range(len(pool)), key=lambda i: inflight[i])
+                r = pool[idx].apply.options(num_returns=2).remote(ref)
+                inflight[idx] += 1
+                window.append((r[0], r[1], idx))
+            while window:
+                yield pop()
+        finally:
+            for a in pool:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
 
 
 class LimitStage:
@@ -387,8 +477,15 @@ def execute_plan(ops: List[LogicalOp],
         return s
 
     for op in ops[1:]:
-        if isinstance(op, OneToOne):
+        if isinstance(op, OneToOne) and op.concurrency is None \
+                and op.num_cpus is None:
             pending_transforms.append(op.transform)
+        elif isinstance(op, OneToOne):
+            # explicit per-op concurrency/resources: own stage, not fused
+            stream = MapStage(op.transform, op.concurrency or concurrency,
+                              num_cpus=op.num_cpus).run(flush(stream))
+        elif isinstance(op, ActorPoolMap):
+            stream = ActorPoolMapStage(op).run(flush(stream))
         elif isinstance(op, Limit):
             stream = LimitStage(op.n).run(flush(stream))
         elif isinstance(op, AllToAll):

@@ -48,7 +48,31 @@ class OneToOne(LogicalOp):
 
     transform: BlockTransform
     label: str = "map"
+    # per-op overrides; ops with any override don't fuse (their window /
+    # resource request must be their own)
+    concurrency: "int | None" = None
+    num_cpus: "float | None" = None
     name = "OneToOne"
+
+
+@dataclasses.dataclass
+class ActorPoolMap(LogicalOp):
+    """A stateful batch transform on a pool of long-lived actors
+    (≈ actor_pool_map_operator.py): the UDF is a class constructed once
+    per actor; blocks stream through the pool. Never fused."""
+
+    fn_cls: Any
+    fn_constructor_args: tuple = ()
+    fn_constructor_kwargs: dict = dataclasses.field(default_factory=dict)
+    batch_size: "int | None" = None
+    batch_format: str = "numpy"
+    fn_args: tuple = ()
+    fn_kwargs: dict = dataclasses.field(default_factory=dict)
+    pool_size: int = 2
+    max_tasks_in_flight_per_actor: int = 2
+    num_cpus: float = 1.0
+    label: str = "actor_map"
+    name = "ActorPoolMap"
 
 
 @dataclasses.dataclass

@@ -16,6 +16,8 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Union a
 
 import numpy as np
 
+import dataclasses
+
 import ray_tpu
 from ray_tpu.data._internal import logical as L
 from ray_tpu.data._internal.executor import (DEFAULT_CONCURRENCY,
@@ -23,6 +25,22 @@ from ray_tpu.data._internal.executor import (DEFAULT_CONCURRENCY,
 from ray_tpu.data.block import (Block, batch_to_block, block_meta,
                                 block_rows, block_to_batch, even_cuts)
 from ray_tpu.data.iterator import DataIterator, _BlockStreamIterator
+
+
+@dataclasses.dataclass
+class ActorPoolStrategy:
+    """Stateful-UDF compute strategy (≈ ray.data.ActorPoolStrategy):
+    class UDFs run on a FIXED pool of long-lived actors sized `size`,
+    falling back to `max_size` then `min_size` (accepted for API parity;
+    this pool does not autoscale between min and max)."""
+
+    size: Optional[int] = None
+    min_size: Optional[int] = None
+    max_size: Optional[int] = None
+
+    @property
+    def pool_size(self) -> int:
+        return int(self.size or self.max_size or self.min_size or 2)
 
 
 class Dataset:
@@ -53,12 +71,52 @@ class Dataset:
         batch_format: str = "numpy",
         fn_args: Tuple = (),
         fn_kwargs: Optional[Dict] = None,
-        **_ignored,
+        compute: Optional["ActorPoolStrategy"] = None,
+        concurrency: Optional[int] = None,
+        num_cpus: Optional[float] = None,
+        fn_constructor_args: Tuple = (),
+        fn_constructor_kwargs: Optional[Dict] = None,
     ) -> "Dataset":
+        """Map a UDF over batches.
+
+        A class UDF (or compute=ActorPoolStrategy) runs on a pool of
+        long-lived actors — the constructor runs once per actor, the
+        stateful instance maps every batch (model-inference pattern).
+        `concurrency` bounds in-flight tasks for function UDFs, or sets
+        the pool size for class UDFs.
+        """
+        import inspect
+
+        is_class_udf = inspect.isclass(fn)
+        if compute is not None and not isinstance(compute, ActorPoolStrategy):
+            raise TypeError(
+                f"compute must be ActorPoolStrategy, got {compute!r}")
+        if is_class_udf or compute is not None:
+            if not is_class_udf:
+                raise TypeError(
+                    "compute=ActorPoolStrategy requires a class UDF")
+            size = (compute.pool_size if compute is not None else None) \
+                or concurrency or 2
+            return self._with(L.ActorPoolMap(
+                fn_cls=fn,
+                fn_constructor_args=tuple(fn_constructor_args),
+                fn_constructor_kwargs=dict(fn_constructor_kwargs or {}),
+                batch_size=batch_size,
+                batch_format=batch_format,
+                fn_args=tuple(fn_args),
+                fn_kwargs=dict(fn_kwargs or {}),
+                pool_size=int(size),
+                num_cpus=float(num_cpus if num_cpus is not None else 1.0),
+                label=getattr(fn, "__name__", "actor_map")))
+        if fn_constructor_args or fn_constructor_kwargs:
+            raise TypeError(
+                "fn_constructor_args/kwargs only apply to class UDFs")
         return self._with(L.OneToOne(
             L.make_map_batches_transform(fn, batch_size, batch_format,
                                          fn_args, fn_kwargs),
-            label=getattr(fn, "__name__", "map_batches")))
+            label=getattr(fn, "__name__", "map_batches"),
+            concurrency=concurrency,
+            num_cpus=num_cpus))
 
     def map(self, fn: Callable) -> "Dataset":
         return self._with(L.OneToOne(L.make_map_rows_transform(fn),

@@ -68,7 +68,10 @@ def moe_layer(p: Dict[str, Any], x, *, num_experts: int, top_k: int = 2,
     gate_vals = gate_vals / jnp.maximum(
         gate_vals.sum(-1, keepdims=True), 1e-9)
 
-    capacity = max(1, int(math.ceil(n / num_experts * capacity_factor)))
+    # top-k routing produces k*n assignments; capacity must scale with k
+    # or >=(k-1)/k of assignments overflow even under perfect balance
+    capacity = max(1, int(math.ceil(n * top_k / num_experts
+                                    * capacity_factor)))
 
     # position of each (token, choice) within its expert's bucket:
     # one-hot [N, k, E] -> cumulative count per expert in token order

@@ -8,6 +8,8 @@ import numpy as np
 import pytest
 
 import ray_tpu
+
+builtins_range = range
 from ray_tpu import data as rd
 
 
@@ -289,3 +291,68 @@ class TestMaterialize:
         ds = rd.range(5)
         assert ds.schema().names == ["id"]
         assert "blocks" in ds.stats()
+
+
+class TestActorPoolCompute:
+    """Stateful class UDFs on an actor pool
+    (≈ actor_pool_map_operator.py) + strict map_batches kwargs."""
+
+    def test_class_udf_runs_on_actor_pool(self, ray_init):
+        from ray_tpu.data import ActorPoolStrategy
+
+        class AddModelBias:
+            def __init__(self, bias):
+                import os
+
+                self.bias = bias
+                self.pid = os.getpid()
+
+            def __call__(self, batch):
+                batch["id"] = batch["id"] + self.bias
+                batch["worker_pid"] = np.full_like(batch["id"], self.pid)
+                return batch
+
+        ds = ray_tpu.data.range(64, parallelism=8).map_batches(
+            AddModelBias,
+            fn_constructor_args=(1000,),
+            compute=ActorPoolStrategy(size=2),
+            num_cpus=0.5,
+        )
+        rows = ds.take_all()
+        assert sorted(r["id"] for r in rows) == list(
+            builtins_range(1000, 1064))
+        # the work actually spread over a pool of persistent workers
+        pids = {r["worker_pid"] for r in rows}
+        assert 1 <= len(pids) <= 2
+
+    def test_class_udf_concurrency_sets_pool_size(self, ray_init):
+        class Echo:
+            def __call__(self, batch):
+                return batch
+
+        ds = ray_tpu.data.range(16, parallelism=4).map_batches(
+            Echo, concurrency=2)
+        assert ds.count() == 16
+
+    def test_function_udf_with_concurrency(self, ray_init):
+        ds = ray_tpu.data.range(32, parallelism=8).map_batches(
+            lambda b: {"id": b["id"] * 2}, concurrency=2)
+        assert sorted(r["id"] for r in ds.take_all()) == [
+            i * 2 for i in builtins_range(32)]
+
+    def test_unknown_kwargs_rejected(self, ray_init):
+        with pytest.raises(TypeError):
+            ray_tpu.data.range(4).map_batches(
+                lambda b: b, nonsense_option=True)
+
+    def test_constructor_args_require_class(self, ray_init):
+        with pytest.raises(TypeError, match="class UDF"):
+            ray_tpu.data.range(4).map_batches(
+                lambda b: b, fn_constructor_args=(1,))
+
+    def test_actor_strategy_requires_class(self, ray_init):
+        from ray_tpu.data import ActorPoolStrategy
+
+        with pytest.raises(TypeError, match="class UDF"):
+            ray_tpu.data.range(4).map_batches(
+                lambda b: b, compute=ActorPoolStrategy(size=2))

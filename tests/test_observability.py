@@ -100,3 +100,25 @@ class TestClusterObservability:
         work_keys = [k for k in summary if k.endswith("work")]
         assert work_keys, f"no work tasks in {list(summary)[:5]}"
         ray_tpu.kill(a)
+
+
+class TestLogToDriver:
+    def test_worker_prints_reach_driver(self, ray_init, capfd):
+        """log_to_driver (on by default): worker stdout streams through
+        the supervisor tail -> controller pubsub -> driver pipeline."""
+
+        @ray_tpu.remote
+        def shout():
+            print("HELLO-FROM-WORKER-xyzzy")
+            return 1
+
+        assert ray_tpu.get(shout.remote()) == 1
+        deadline = time.monotonic() + 15
+        seen = ""
+        while time.monotonic() < deadline:
+            seen += capfd.readouterr().out
+            if "HELLO-FROM-WORKER-xyzzy" in seen:
+                break
+            time.sleep(0.3)
+        assert "HELLO-FROM-WORKER-xyzzy" in seen
+        assert "pid=" in seen
