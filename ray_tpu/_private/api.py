@@ -182,9 +182,16 @@ def init(
         if log_to_driver:
             # worker stdout/stderr stream to this process (supervisors
             # tail the files and publish; ≈ the reference's log monitor)
+            my_job_hex = core.job_id.hex()
+
             def _print_worker_logs(msg):
                 import sys as _sys
 
+                # only THIS driver's workers (messages carry the job that
+                # spawned the worker; untagged = pre-tagging pooled worker)
+                job = msg.get("job_id_hex", "")
+                if job and job != my_job_hex:
+                    return
                 stream = (_sys.stderr if msg.get("stream") == "stderr"
                           else _sys.stdout)
                 tag = f"({msg.get('node', '?')} pid={msg.get('pid', '?')})"

@@ -61,7 +61,13 @@ class Config:
     # Prometheus /metrics HTTP port per daemon: 0 = auto-pick, -1 = off
     metrics_export_port: int = 0
     # bind address for /metrics; set 0.0.0.0 for off-host Prometheus
+    # (the scrape endpoint is read-only; the jobs/dashboard API lives on
+    # its own port below and is NOT safe to expose unauthenticated)
     metrics_export_host: str = "127.0.0.1"
+    # dashboard + job-submission REST (loopback-only by default: the job
+    # API executes entrypoints, treat like ssh); -1 disables
+    dashboard_host: str = "127.0.0.1"
+    dashboard_port: int = 0
     # controller durable-state snapshot cadence (actors/PGs/jobs/KV)
     controller_snapshot_interval_ms: int = 500
     # ---- TPU ----

@@ -127,9 +127,11 @@ class Controller:
     GCS event loop)."""
 
     def __init__(self, config: Config, host: str = "127.0.0.1", port: int = 0,
-                 snapshot_path: str = ""):
+                 snapshot_path: str = "", session_dir: str = ""):
         self.config = config
         self.snapshot_path = snapshot_path
+        self.session_dir = session_dir
+        self.job_manager = None  # created in start() (needs our address)
         self.server = RpcServer(host, port if port else config.controller_port)
         self.server.register_object(self)
         self.clients = ClientPool(
@@ -151,6 +153,7 @@ class Controller:
         self._started = time.time()
         # metrics (≈ metric_defs.h:46 definitions, served per-daemon)
         self.metrics_server: Optional[MetricsHttpServer] = None
+        self.dashboard_server: Optional[MetricsHttpServer] = None
         self._m_nodes = Gauge("ray_tpu_nodes",
                               "Cluster nodes by liveness")
         self._m_actors = Gauge("ray_tpu_actors", "Actors by state")
@@ -280,8 +283,14 @@ class Controller:
             # still on an unknown node after the grace window was lost
             # during the outage and must fail over
             loop.create_task(self._reconcile_recovered())
+        from ray_tpu._private.job_manager import JobManager
+
+        self.job_manager = JobManager(
+            self.session_dir, f"{addr[0]}:{addr[1]}")
         if self.config.metrics_export_port >= 0:
             try:
+                # scrape port: READ-ONLY routes only — operators may open
+                # it to an off-host Prometheus
                 self.metrics_server = MetricsHttpServer(
                     host=self.config.metrics_export_host,
                     port=self.config.metrics_export_port)
@@ -294,6 +303,18 @@ class Controller:
                 # control plane (fixed port + several daemons per host)
                 logger.warning("metrics endpoint unavailable: %s", e)
                 self.metrics_server = None
+        if self.config.dashboard_port >= 0:
+            try:
+                # dashboard + jobs API: executes entrypoints — its OWN
+                # port, loopback-bound unless the operator opts in
+                self.dashboard_server = MetricsHttpServer(
+                    host=self.config.dashboard_host,
+                    port=self.config.dashboard_port)
+                self._register_http_api(self.dashboard_server)
+                await self.dashboard_server.start()
+            except OSError as e:
+                logger.warning("dashboard endpoint unavailable: %s", e)
+                self.dashboard_server = None
         return addr
 
     def _render_metrics(self):
@@ -320,11 +341,101 @@ class Controller:
         return ("text/plain; version=0.0.4",
                 default_registry().render_prometheus())
 
+    def _register_http_api(self, srv: MetricsHttpServer) -> None:
+        """REST + dashboard-lite on the controller's HTTP port
+        (≈ dashboard job REST, dashboard/modules/job/job_head.py, and a
+        minimal cluster overview page in place of the React dashboard)."""
+        import json as _json
+
+        async def api_cluster():
+            return await self.rpc_cluster_status()
+
+        async def api_nodes():
+            return await self.rpc_node_views()
+
+        async def api_actors():
+            recs = await self.rpc_actor_list()
+            for r in recs:
+                r.pop("creation_spec", None)
+            return recs
+
+        async def api_tasks():
+            return await self.rpc_state_tasks({"limit": 200})
+
+        def api_jobs_list():
+            return self.job_manager.list()
+
+        def api_jobs_submit(body: bytes):
+            req = _json.loads(body or b"{}")
+            if not req.get("entrypoint"):
+                raise ValueError("missing 'entrypoint'")
+            job_id = self.job_manager.submit(
+                req["entrypoint"],
+                env_vars=req.get("env_vars"),
+                submission_id=req.get("submission_id"))
+            return {"job_id": job_id}
+
+        from ray_tpu._private.http_util import HttpNotFound
+
+        def api_job_detail(tail: str):
+            parts = tail.strip("/").split("/")
+            job_id = parts[0]
+            if self.job_manager.status(job_id) is None:
+                raise HttpNotFound(f"no such job {job_id}")
+            if len(parts) > 1 and parts[1] == "logs":
+                return ("text/plain", self.job_manager.logs(job_id))
+            return self.job_manager.status(job_id)
+
+        async def api_job_action(body: bytes, tail: str):
+            parts = tail.strip("/").split("/")
+            if self.job_manager.status(parts[0]) is None:
+                raise HttpNotFound(f"no such job {parts[0]}")
+            if len(parts) > 1 and parts[1] == "stop":
+                # stop() waits on the process: keep it off the event loop
+                stopped = await asyncio.get_running_loop().run_in_executor(
+                    None, self.job_manager.stop, parts[0])
+                return {"stopped": stopped}
+            raise ValueError(f"unknown action {tail!r}")
+
+        srv.route("/api/cluster", api_cluster)
+        srv.route("/api/nodes", api_nodes)
+        srv.route("/api/actors", api_actors)
+        srv.route("/api/tasks", api_tasks)
+        srv.route("/api/jobs", api_jobs_list)
+        srv.route("/api/jobs", api_jobs_submit, method="POST")
+        srv.route("/api/jobs/*", api_job_detail)
+        srv.route("/api/jobs/*", api_job_action, method="POST")
+        srv.route("/dashboard", lambda: ("text/html", _DASHBOARD_HTML))
+
     async def rpc_metrics(self, body=None) -> str:
         return self._render_metrics()[1]
 
+    # job submission RPCs (the CLI may come through RPC instead of HTTP)
+
+    async def rpc_job_submit(self, body) -> dict:
+        return {"job_id": self.job_manager.submit(
+            body["entrypoint"], env_vars=body.get("env_vars"),
+            submission_id=body.get("submission_id"))}
+
+    async def rpc_job_status(self, body):
+        return self.job_manager.status(body["job_id"])
+
+    async def rpc_job_logs(self, body) -> str:
+        return self.job_manager.logs(body["job_id"])
+
+    async def rpc_job_stop(self, body) -> bool:
+        # blocking process wait — never on the control-plane loop
+        return await asyncio.get_running_loop().run_in_executor(
+            None, self.job_manager.stop, body["job_id"])
+
+    async def rpc_job_submissions(self, body=None) -> list:
+        return self.job_manager.list()
+
     async def rpc_metrics_port(self, body=None) -> int:
         return self.metrics_server.port if self.metrics_server else -1
+
+    async def rpc_dashboard_port(self, body=None) -> int:
+        return self.dashboard_server.port if self.dashboard_server else -1
 
     async def _pg_retry_loop(self) -> None:
         """Pending placement groups retry as resources free up
@@ -347,6 +458,8 @@ class Controller:
             pass
         if self.metrics_server is not None:
             await self.metrics_server.stop()
+        if self.dashboard_server is not None:
+            await self.dashboard_server.stop()
         await self.clients.close_all()
         await self.server.stop()
 
@@ -877,6 +990,41 @@ class Controller:
         }
 
 
+_DASHBOARD_HTML = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title><style>
+body{font-family:monospace;margin:2em;background:#111;color:#ddd}
+h1{color:#7fd} h2{color:#9cf;margin-top:1.2em} table{border-collapse:collapse}
+td,th{border:1px solid #444;padding:4px 10px;text-align:left}
+.ok{color:#7f7}.bad{color:#f77} pre{background:#000;padding:8px}
+</style></head><body>
+<h1>ray_tpu</h1>
+<div id=cluster></div><h2>Nodes</h2><div id=nodes></div>
+<h2>Actors</h2><div id=actors></div><h2>Jobs</h2><div id=jobs></div>
+<script>
+function esc(s){return String(s).replace(/&/g,'&amp;').replace(/</g,'&lt;')
+ .replace(/>/g,'&gt;').replace(/"/g,'&quot;');}
+function table(rows, cols){if(!rows.length)return '<i>none</i>';
+ let h='<table><tr>'+cols.map(c=>'<th>'+esc(c)+'</th>').join('')+'</tr>';
+ for(const r of rows){h+='<tr>'+cols.map(c=>'<td>'+
+  esc(JSON.stringify(r[c]??''))+'</td>').join('')+'</tr>';}return h+'</table>';}
+async function refresh(){
+ const c=await (await fetch('/api/cluster')).json();
+ document.getElementById('cluster').innerHTML='<pre>'+
+  JSON.stringify(c,null,1)+'</pre>';
+ const n=await (await fetch('/api/nodes')).json();
+ document.getElementById('nodes').innerHTML=
+  table(n,['node_id_hex','alive','total','available']);
+ const a=await (await fetch('/api/actors')).json();
+ document.getElementById('actors').innerHTML=
+  table(a,['actor_id_hex','class_name','state','name']);
+ const j=await (await fetch('/api/jobs')).json();
+ document.getElementById('jobs').innerHTML=
+  table(j,['job_id','status','entrypoint']);
+}
+refresh();setInterval(refresh,2000);
+</script></body></html>"""
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--host", default="127.0.0.1")
@@ -896,7 +1044,8 @@ def main() -> None:
         if not snapshot and args.session_dir:
             snapshot = os.path.join(args.session_dir, "controller_state.bin")
         controller = Controller(Config.from_env(), args.host, args.port,
-                                snapshot_path=snapshot)
+                                snapshot_path=snapshot,
+                                session_dir=args.session_dir)
         addr = await controller.start()
         if args.address_file:
             tmp = args.address_file + ".tmp"

@@ -15,17 +15,29 @@ from typing import Callable, Dict, Optional, Tuple
 logger = logging.getLogger(__name__)
 
 
+class HttpNotFound(Exception):
+    """Raise from a handler to produce a 404 instead of a 500."""
+
+
 class MetricsHttpServer:
-    """Routes GET paths to handlers returning (content_type, body)."""
+    """Tiny route table over HTTP/1.0.
+
+    Handlers are registered per (method, path); a path ending in '/*'
+    matches any suffix (passed as `tail`). A handler may be sync or
+    async and returns (content_type, text); JSON handlers can return a
+    plain dict/list which is serialized for them. POST handlers receive
+    (body_bytes, tail); GET handlers receive (tail) when their route is
+    a prefix route, else no args — introspected by arity.
+    """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._host = host
         self._port = port
-        self._routes: Dict[str, Callable[[], Tuple[str, str]]] = {}
+        self._routes: Dict[Tuple[str, str], Callable] = {}
         self._server: Optional[asyncio.AbstractServer] = None
 
-    def route(self, path: str, handler: Callable[[], Tuple[str, str]]):
-        self._routes[path] = handler
+    def route(self, path: str, handler: Callable, method: str = "GET"):
+        self._routes[(method.upper(), path)] = handler
 
     @property
     def port(self) -> int:
@@ -45,33 +57,73 @@ class MetricsHttpServer:
             except Exception:
                 pass
 
+    def _match(self, method: str, path: str):
+        exact = self._routes.get((method, path))
+        if exact is not None:
+            return exact, None
+        for (m, pat), handler in self._routes.items():
+            if m == method and pat.endswith("/*") and \
+                    path.startswith(pat[:-1]):
+                return handler, path[len(pat) - 1:]
+        return None, None
+
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
         try:
             line = await asyncio.wait_for(reader.readline(), timeout=10)
             parts = line.decode("latin1").split()
+            method = parts[0].upper() if parts else "GET"
             path = parts[1].split("?")[0] if len(parts) >= 2 else "/"
-            # drain headers
+            clen = 0
             while True:
                 h = await asyncio.wait_for(reader.readline(), timeout=10)
                 if h in (b"\r\n", b"\n", b""):
                     break
-            handler = self._routes.get(path)
+                if h.lower().startswith(b"content-length:"):
+                    clen = int(h.split(b":", 1)[1].strip())
+            req_body = (await asyncio.wait_for(
+                reader.readexactly(clen), timeout=30)) if clen else b""
+
+            handler, tail = self._match(method, path)
             if handler is None:
-                body = b"not found"
-                head = (f"HTTP/1.0 404 Not Found\r\nContent-Length: "
-                        f"{len(body)}\r\n\r\n")
-            else:
-                ctype, text = handler()
-                body = text.encode()
-                head = (f"HTTP/1.0 200 OK\r\nContent-Type: {ctype}\r\n"
-                        f"Content-Length: {len(body)}\r\n\r\n")
-            writer.write(head.encode("latin1") + body)
+                self._write(writer, 404, "text/plain", "not found")
+                return
+            args = []
+            if method in ("POST", "PUT", "DELETE"):
+                args.append(req_body)
+            if tail is not None:
+                args.append(tail)
+            try:
+                result = handler(*args)
+                if asyncio.iscoroutine(result):
+                    result = await result
+                if isinstance(result, tuple):
+                    ctype, text = result
+                else:  # plain JSON-able value
+                    import json as _json
+
+                    ctype, text = "application/json", _json.dumps(result)
+                self._write(writer, 200, ctype, text)
+            except HttpNotFound as e:
+                self._write(writer, 404, "text/plain", str(e))
+            except Exception as e:  # noqa: BLE001 — surface as 500
+                logger.debug("http handler failed", exc_info=True)
+                self._write(writer, 500, "text/plain",
+                            f"{type(e).__name__}: {e}")
             await writer.drain()
         except Exception:
-            logger.debug("metrics http request failed", exc_info=True)
+            logger.debug("http request failed", exc_info=True)
         finally:
             try:
                 writer.close()
             except Exception:
                 pass
+
+    @staticmethod
+    def _write(writer, status: int, ctype: str, text: str) -> None:
+        body = text.encode()
+        reason = {200: "OK", 404: "Not Found", 500: "Error"}.get(
+            status, "OK")
+        head = (f"HTTP/1.0 {status} {reason}\r\nContent-Type: {ctype}\r\n"
+                f"Content-Length: {len(body)}\r\n\r\n")
+        writer.write(head.encode("latin1") + body)

@@ -74,6 +74,9 @@ class WorkerHandle:
     log_paths: Tuple[str, str] = ("", "")
     log_offsets: List[int] = dataclasses.field(
         default_factory=lambda: [0, 0])
+    # job that spawned this worker (log routing; pooled workers are
+    # per-runtime-env so cross-job reuse is rare but possible)
+    job_id_hex: str = ""
 
 
 @dataclasses.dataclass
@@ -161,8 +164,9 @@ class Supervisor:
         self._reap_task: Optional[asyncio.Task] = None
         self._monitor_task: Optional[asyncio.Task] = None
         self._log_task: Optional[asyncio.Task] = None
-        # pid -> log paths for spawned-but-unregistered workers
+        # pid -> log paths / owning job for spawned-but-unregistered workers
         self._spawned_log_paths: Dict[int, Tuple[str, str]] = {}
+        self._spawned_jobs: Dict[int, str] = {}
         # TPU chip assignment bookkeeping
         self._tpu_free: List[int] = list(range(int(self.total.get("TPU", 0))))
         # runtime envs staged on this node (working_dir/py_modules/pip)
@@ -673,6 +677,7 @@ class Supervisor:
         self._spawned_log_paths[proc.pid] = (out.name, err.name)
         self._m_workers_spawned.inc()
         self._spawned_procs[proc.pid] = proc
+        self._spawned_jobs[proc.pid] = spec.job_id.hex() if spec.job_id else ""
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._spawn_waiters.setdefault(env_key, deque()).append(fut)
         try:
@@ -686,6 +691,7 @@ class Supervisor:
                 pass
             self._spawned_procs.pop(proc.pid, None)
             self._spawned_log_paths.pop(proc.pid, None)
+            self._spawned_jobs.pop(proc.pid, None)
             proc.kill()
             raise RuntimeError(
                 f"worker failed to register within "
@@ -704,6 +710,7 @@ class Supervisor:
             # bind the Popen by the worker's own pid — never by spawn order
             proc=self._spawned_procs.pop(body["pid"], None),
             log_paths=self._spawned_log_paths.pop(body["pid"], ("", "")),
+            job_id_hex=self._spawned_jobs.pop(body["pid"], ""),
         )
         self.workers[handle.worker_id_hex] = handle
         waiters = self._spawn_waiters.get(handle.env_key)
@@ -797,16 +804,23 @@ class Supervisor:
         while True:
             await asyncio.sleep(0.5)
             try:
-                batches = self._collect_new_log_lines()
+                batches, commits = self._collect_new_log_lines()
                 for msg in batches:
                     await ctrl.notify(
                         "publish", {"channel": "worker_logs", "message": msg})
+                # advance offsets only after the publishes went out — a
+                # transient controller outage must re-send, not drop
+                for w, i, off in commits:
+                    w.log_offsets[i] = off
             except Exception:
                 logger.debug("log tail failed", exc_info=True)
 
-    def _collect_new_log_lines(self, workers=None,
-                               final: bool = False) -> List[dict]:
+    def _collect_new_log_lines(self, workers=None, final: bool = False):
+        """Returns (messages, commits); commits are (worker, stream_index,
+        new_offset) the CALLER applies after the messages were delivered —
+        offsets must not advance past lines that never reached a driver."""
         out: List[dict] = []
+        commits: List[tuple] = []
         for w in (workers if workers is not None
                   else list(self.workers.values())):
             for i, path in enumerate(w.log_paths):
@@ -828,26 +842,30 @@ class Supervisor:
                     if cut < 0:
                         continue
                     data = data[:cut + 1]
-                w.log_offsets[i] += len(data)
                 lines = data.decode(errors="replace").splitlines()
                 if lines:
+                    commits.append((w, i, w.log_offsets[i] + len(data)))
                     out.append({
                         "pid": w.pid,
                         "worker_id_hex": w.worker_id_hex,
                         "node": self.node_name,
+                        "job_id_hex": w.job_id_hex,
                         "stream": "stdout" if i == 0 else "stderr",
                         "lines": lines,
                     })
-        return out
+        return out, commits
 
     async def _drain_worker_logs(self, w: WorkerHandle) -> None:
         """Publish a dead worker's remaining output — the crash traceback
         is exactly the part written after the last poll tick."""
         try:
             ctrl = self.clients.get(self.controller_addr)
-            for msg in self._collect_new_log_lines([w], final=True):
+            msgs, commits = self._collect_new_log_lines([w], final=True)
+            for msg in msgs:
                 await ctrl.notify(
                     "publish", {"channel": "worker_logs", "message": msg})
+            for worker, i, off in commits:
+                worker.log_offsets[i] = off
         except Exception:
             logger.debug("final log drain failed", exc_info=True)
 

@@ -39,8 +39,12 @@ class ActorPoolStrategy:
     max_size: Optional[int] = None
 
     @property
-    def pool_size(self) -> int:
-        return int(self.size or self.max_size or self.min_size or 2)
+    def pool_size(self) -> Optional[int]:
+        """None when the strategy doesn't specify a size — map_batches
+        then falls through to its `concurrency` argument."""
+        if self.size or self.max_size or self.min_size:
+            return int(self.size or self.max_size or self.min_size)
+        return None
 
 
 class Dataset:

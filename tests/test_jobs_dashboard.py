@@ -1,0 +1,119 @@
+"""Job submission (RPC + REST + CLI) and the dashboard-lite endpoints.
+Reference analogs: dashboard/modules/job REST tests, `ray job` CLI."""
+
+import json
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+def _controller_http_port():
+    """The dashboard/jobs API port (separate from the read-only metrics
+    scrape port — the job API executes entrypoints)."""
+    core = ray_tpu._private.api._require_core()
+    return core._run(
+        core.clients.get(core.controller_addr).call("dashboard_port"))
+
+
+def _http(port, path, data=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(data).encode() if data is not None else None,
+        headers={"Content-Type": "application/json"} if data else {},
+    )
+    with urllib.request.urlopen(req, timeout=15) as resp:
+        body = resp.read().decode()
+        ctype = resp.headers.get("Content-Type", "")
+    return json.loads(body) if "json" in ctype else body
+
+
+class TestJobSubmission:
+    def test_submit_status_logs_via_rest(self, ray_init):
+        port = _controller_http_port()
+        assert port > 0
+        out = _http(port, "/api/jobs", {
+            "entrypoint":
+                f"{sys.executable} -c \"print('JOB-SAYS-HI'); print(2+2)\"",
+        })
+        job_id = out["job_id"]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            st = _http(port, f"/api/jobs/{job_id}")
+            if st["status"] != "RUNNING":
+                break
+            time.sleep(0.3)
+        assert st["status"] == "SUCCEEDED", st
+        logs = _http(port, f"/api/jobs/{job_id}/logs")
+        assert "JOB-SAYS-HI" in logs and "4" in logs
+        listing = _http(port, "/api/jobs")
+        assert any(j["job_id"] == job_id for j in listing)
+
+    def test_failed_job_reports_failed(self, ray_init):
+        port = _controller_http_port()
+        out = _http(port, "/api/jobs",
+                    {"entrypoint": f"{sys.executable} -c 'raise SystemExit(3)'"})
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            st = _http(port, f"/api/jobs/{out['job_id']}")
+            if st["status"] != "RUNNING":
+                break
+            time.sleep(0.3)
+        assert st["status"] == "FAILED"
+        assert st["exit_code"] == 3
+
+    def test_stop_running_job(self, ray_init):
+        port = _controller_http_port()
+        out = _http(port, "/api/jobs",
+                    {"entrypoint": f"{sys.executable} -c 'import time; time.sleep(60)'"})
+        job_id = out["job_id"]
+        stopped = _http(port, f"/api/jobs/{job_id}/stop", {})
+        assert stopped["stopped"] is True
+        st = _http(port, f"/api/jobs/{job_id}")
+        assert st["status"] == "STOPPED"
+
+    def test_cli_submit_follow(self, ray_init):
+        import subprocess
+
+        core = ray_tpu._private.api._require_core()
+        addr = f"{core.controller_addr[0]}:{core.controller_addr[1]}"
+        proc = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts.jobs", "submit",
+             "--address", addr, "--follow", "--",
+             "echo", "CLI-JOB-OK"],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+        assert "CLI-JOB-OK" in proc.stdout
+
+
+class TestDashboard:
+    def test_dashboard_and_state_endpoints(self, ray_init):
+        port = _controller_http_port()
+
+        @ray_tpu.remote
+        class Marker:
+            def ping(self):
+                return 1
+
+        a = Marker.remote()
+        assert ray_tpu.get(a.ping.remote()) == 1
+
+        html = _http(port, "/dashboard")
+        assert "<html" in html and "ray_tpu" in html
+        cluster = _http(port, "/api/cluster")
+        assert cluster["nodes_alive"] >= 1
+        nodes = _http(port, "/api/nodes")
+        assert nodes and nodes[0]["alive"]
+        actors = _http(port, "/api/actors")
+        assert any(r["class_name"] == "Marker" for r in actors)
+        assert _http(port, "/api/tasks") is not None
+        ray_tpu.kill(a)
+
+    def test_unknown_route_404(self, ray_init):
+        port = _controller_http_port()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _http(port, "/api/nope")
+        assert ei.value.code == 404
