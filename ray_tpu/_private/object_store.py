@@ -80,8 +80,70 @@ class OutOfMemoryError(Exception):
     pass
 
 
+class _NativeFreeList:
+    """ctypes binding of the C++ arena allocator (_native/allocator.cpp):
+    O(log n) coalescing plus double-free/overlap validation. Selected by
+    make_free_list() when the native lib builds; same surface as
+    _FreeList."""
+
+    def __init__(self, capacity: int, lib):
+        import ctypes
+
+        self.capacity = capacity
+        self._lib = lib
+        lib.rtpu_alloc_create.restype = ctypes.c_void_p
+        lib.rtpu_alloc_create.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
+        lib.rtpu_alloc_alloc.restype = ctypes.c_int64
+        lib.rtpu_alloc_alloc.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.rtpu_alloc_free.restype = ctypes.c_int
+        lib.rtpu_alloc_free.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64]
+        lib.rtpu_alloc_free_bytes.restype = ctypes.c_uint64
+        lib.rtpu_alloc_free_bytes.argtypes = [ctypes.c_void_p]
+        lib.rtpu_alloc_destroy.argtypes = [ctypes.c_void_p]
+        self._handle = lib.rtpu_alloc_create(capacity, PAGE)
+        if not self._handle:
+            raise MemoryError("native allocator init failed")
+
+    def alloc(self, size: int) -> Optional[int]:
+        off = self._lib.rtpu_alloc_alloc(self._handle, size)
+        return None if off < 0 else off
+
+    def free(self, offset: int, size: int) -> None:
+        rc = self._lib.rtpu_alloc_free(self._handle, offset, size)
+        if rc == -2:
+            raise ValueError(
+                f"double/overlapping free at offset={offset} size={size}")
+        if rc != 0:
+            raise ValueError(f"invalid free offset={offset} size={size}")
+
+    def free_bytes(self) -> int:
+        return self._lib.rtpu_alloc_free_bytes(self._handle)
+
+    def __del__(self):
+        try:
+            self._lib.rtpu_alloc_destroy(self._handle)
+        except Exception:
+            pass
+
+
+def make_free_list(capacity: int):
+    """Native allocator when the toolchain allows, Python otherwise."""
+    if os.environ.get("RAY_TPU_DISABLE_NATIVE", "") not in ("1", "true"):
+        try:
+            from ray_tpu._native import load_library
+
+            lib = load_library("allocator")
+            if lib is not None:
+                return _NativeFreeList(capacity, lib)
+        except Exception:
+            pass
+    return _FreeList(capacity)
+
+
 class _FreeList:
-    """First-fit free-list allocator over [0, capacity) with coalescing."""
+    """First-fit free-list allocator over [0, capacity) with coalescing.
+    Pure-Python fallback for make_free_list()."""
 
     def __init__(self, capacity: int):
         self.capacity = capacity
@@ -146,7 +208,7 @@ class NodeObjectStore:
     def __init__(self, arena_path: str, capacity: int, spill_dir: str):
         self.capacity = capacity
         self.arena = ArenaFile(arena_path, capacity, create=True)
-        self._alloc = _FreeList(capacity)
+        self._alloc = make_free_list(capacity)
         self._objects: Dict[ObjectID, ObjectMeta] = {}
         self._spill_dir = spill_dir
         os.makedirs(spill_dir, exist_ok=True)

@@ -267,3 +267,72 @@ class TestResources:
     def test_negative_raises(self):
         with pytest.raises(ValueError):
             ResourceSet.of({"CPU": -1})
+
+
+class TestNativeAllocator:
+    """The C++ arena allocator (_native/allocator.cpp) must agree with
+    the Python free list under randomized alloc/free workloads, and add
+    double-free detection the fallback lacks."""
+
+    def test_native_builds_and_loads(self):
+        from ray_tpu._native import native_available
+
+        assert native_available("allocator")
+
+    def test_parity_random_workload(self):
+        import random
+
+        from ray_tpu._native import load_library
+        from ray_tpu._private.object_store import (_FreeList,
+                                                   _NativeFreeList)
+
+        cap = 1 << 20
+        py = _FreeList(cap)
+        cc = _NativeFreeList(cap, load_library("allocator"))
+        rng = random.Random(7)
+        live = []
+        for step in range(2000):
+            if live and rng.random() < 0.45:
+                off, size, off2 = live.pop(rng.randrange(len(live)))
+                py.free(off, size)
+                cc.free(off2, size)
+            else:
+                size = rng.randrange(1, 9000)
+                a, b = py.alloc(size), cc.alloc(size)
+                assert (a is None) == (b is None), (step, a, b)
+                if a is not None:
+                    live.append((a, size, b))
+            assert py.free_bytes() == cc.free_bytes(), step
+
+    def test_double_free_detected(self):
+        import pytest as _pytest
+
+        from ray_tpu._native import load_library
+        from ray_tpu._private.object_store import _NativeFreeList
+
+        cc = _NativeFreeList(1 << 16, load_library("allocator"))
+        off = cc.alloc(100)
+        cc.free(off, 100)
+        with _pytest.raises(ValueError, match="free"):
+            cc.free(off, 100)
+
+    def test_out_of_bounds_free_detected(self):
+        import pytest as _pytest
+
+        from ray_tpu._native import load_library
+        from ray_tpu._private.object_store import _NativeFreeList
+
+        cc = _NativeFreeList(1 << 16, load_library("allocator"))
+        with _pytest.raises(ValueError):
+            cc.free(1 << 20, 128)
+
+    def test_store_uses_native_when_available(self, tmp_path):
+        from ray_tpu._private.object_store import (NodeObjectStore,
+                                                   _NativeFreeList)
+
+        store = NodeObjectStore(str(tmp_path / "arena"), 1 << 20,
+                                str(tmp_path / "spill"))
+        try:
+            assert isinstance(store._alloc, _NativeFreeList)
+        finally:
+            store.shutdown()
