@@ -58,7 +58,11 @@ struct Allocator {
   int free_range(uint64_t offset, uint64_t size) {
     size = align_up(size ? size : 1);
     std::lock_guard<std::mutex> lock(mu);
-    if (offset + size > capacity || offset % alignment != 0) return -1;
+    // overflow-safe bounds check: offset + size must not wrap
+    if (size > capacity || offset > capacity - size ||
+        offset % alignment != 0) {
+      return -1;
+    }
     // find the first free range at-or-after offset and its predecessor
     auto next = free_ranges.lower_bound(offset);
     if (next != free_ranges.end() && next->first < offset + size) return -2;
@@ -105,11 +109,15 @@ int rtpu_alloc_free(void* a, uint64_t offset, uint64_t size) {
 }
 
 uint64_t rtpu_alloc_free_bytes(void* a) {
-  return static_cast<Allocator*>(a)->free_bytes;
+  auto* alloc = static_cast<Allocator*>(a);
+  std::lock_guard<std::mutex> lock(alloc->mu);
+  return alloc->free_bytes;
 }
 
 uint64_t rtpu_alloc_num_ranges(void* a) {
-  return static_cast<Allocator*>(a)->free_ranges.size();
+  auto* alloc = static_cast<Allocator*>(a);
+  std::lock_guard<std::mutex> lock(alloc->mu);
+  return alloc->free_ranges.size();
 }
 
 }  // extern "C"

@@ -137,7 +137,11 @@ def make_free_list(capacity: int):
             if lib is not None:
                 return _NativeFreeList(capacity, lib)
         except Exception:
-            pass
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "native allocator unavailable; using the Python free "
+                "list (no double-free detection)", exc_info=True)
     return _FreeList(capacity)
 
 

@@ -269,10 +269,24 @@ class TestResources:
             ResourceSet.of({"CPU": -1})
 
 
+def _native_ready() -> bool:
+    import os
+
+    if os.environ.get("RAY_TPU_DISABLE_NATIVE", "") in ("1", "true"):
+        return False
+    from ray_tpu._native import native_available
+
+    return native_available("allocator")
+
+
 class TestNativeAllocator:
     """The C++ arena allocator (_native/allocator.cpp) must agree with
     the Python free list under randomized alloc/free workloads, and add
-    double-free detection the fallback lacks."""
+    double-free detection the fallback lacks. Skipped (not failed) where
+    the toolchain is absent — that is the fallback's contract."""
+
+    pytestmark = pytest.mark.skipif(
+        not _native_ready(), reason="native toolchain unavailable")
 
     def test_native_builds_and_loads(self):
         from ray_tpu._native import native_available
