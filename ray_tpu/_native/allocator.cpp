@@ -39,6 +39,7 @@ struct Allocator {
 
   // -1 on OOM (caller spills and retries), else the offset.
   int64_t alloc(uint64_t size) {
+    if (size > capacity) return -1;  // pre-alignment: align_up could wrap
     size = align_up(size ? size : 1);
     std::lock_guard<std::mutex> lock(mu);
     for (auto it = free_ranges.begin(); it != free_ranges.end(); ++it) {
@@ -56,7 +57,8 @@ struct Allocator {
 
   // 0 ok; -1 out of bounds; -2 overlaps a free range (double free).
   int free_range(uint64_t offset, uint64_t size) {
-    size = align_up(size ? size : 1);
+    if (size == 0 || size > capacity) return -1;  // before align_up wraps
+    size = align_up(size);
     std::lock_guard<std::mutex> lock(mu);
     // overflow-safe bounds check: offset + size must not wrap
     if (size > capacity || offset > capacity - size ||

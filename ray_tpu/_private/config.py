@@ -36,6 +36,9 @@ class Config:
     num_workers_soft_limit: int = 4  # max idle pre-started workers per node
     worker_register_timeout_s: float = 60.0
     worker_lease_timeout_s: float = 30.0
+    # push_task replies as soon as the executor QUEUES the task; a worker
+    # that can't ack within this window is wedged and its tasks retry
+    task_push_timeout_s: float = 60.0
     idle_worker_killing_time_ms: int = 60_000
     # hybrid policy: prefer local node until its utilization crosses this
     # threshold, then pack remote nodes by score (hybrid_scheduling_policy.h:50).
@@ -49,6 +52,11 @@ class Config:
     object_transfer_chunk_bytes: int = 8 * 1024**2
     object_spilling_threshold: float = 0.8
     object_spilling_dir: str = ""
+    # ---- OOM defense (≈ memory_monitor.h:52) ----
+    # kill the newest leased worker when host memory use crosses this
+    # fraction; <= 0 disables the monitor
+    memory_usage_threshold: float = 0.95
+    memory_monitor_interval_ms: int = 1000
     # ---- retries / lineage ----
     task_max_retries: int = 3
     actor_max_restarts: int = 0

@@ -164,6 +164,10 @@ class Supervisor:
         self._reap_task: Optional[asyncio.Task] = None
         self._monitor_task: Optional[asyncio.Task] = None
         self._log_task: Optional[asyncio.Task] = None
+        self._memory_task: Optional[asyncio.Task] = None
+        self._oom_killed: Set[str] = set()
+        # worker_id_hex -> supervisor-attributed death reason (OOM kills)
+        self._kill_reasons: Dict[str, str] = {}
         # pid -> log paths / owning job for spawned-but-unregistered workers
         self._spawned_log_paths: Dict[int, Tuple[str, str]] = {}
         self._spawned_jobs: Dict[int, str] = {}
@@ -217,6 +221,8 @@ class Supervisor:
         self._reap_task = loop.create_task(self._reap_loop())
         self._monitor_task = loop.create_task(self._monitor_loop())
         self._log_task = loop.create_task(self._log_tail_loop())
+        if self.config.memory_usage_threshold > 0:
+            self._memory_task = loop.create_task(self._memory_monitor_loop())
         if self.config.metrics_export_port >= 0:
             try:
                 self.metrics_server = MetricsHttpServer(
@@ -256,7 +262,7 @@ class Supervisor:
 
     async def stop(self) -> None:
         for t in (self._sync_task, self._reap_task, self._monitor_task,
-                  self._log_task):
+                  self._log_task, self._memory_task):
             if t is not None:
                 t.cancel()
         if self.metrics_server is not None:
@@ -765,6 +771,9 @@ class Supervisor:
         except ValueError:
             pass
         exitcode = w.proc.poll() if w.proc is not None else None
+        reason = self._kill_reasons.pop(
+            w.worker_id_hex, f"worker exited with code {exitcode}")
+        self._oom_killed.discard(w.worker_id_hex)
         # fail leases bound to this worker and tell their owners
         for lease in [l for l in self.leases.values() if l.worker is w]:
             if lease.owner is not None:
@@ -774,6 +783,7 @@ class Supervisor:
                         {
                             "worker_id_hex": w.worker_id_hex,
                             "exitcode": exitcode,
+                            "reason": reason,
                         },
                     )
                 except Exception:
@@ -786,7 +796,7 @@ class Supervisor:
                     {
                         "worker_id_hex": w.worker_id_hex,
                         "actor_id_hex": w.actor_id_hex,
-                        "reason": f"worker exited with code {exitcode}",
+                        "reason": reason,
                     },
                     timeout=5,
                 )
@@ -868,6 +878,82 @@ class Supervisor:
                 worker.log_offsets[i] = off
         except Exception:
             logger.debug("final log drain failed", exc_info=True)
+
+    # ------------------------------------------------------------ OOM defense
+
+    @staticmethod
+    def _memory_usage_fraction() -> float:
+        """Host memory pressure from /proc/meminfo (no psutil in daemons).
+        ≈ memory_monitor.h:52's cgroup/system sampling."""
+        try:
+            info = {}
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    k, v = line.split(":", 1)
+                    info[k] = int(v.strip().split()[0])  # kB
+            total = info.get("MemTotal", 0)
+            avail = info.get("MemAvailable", 0)
+            if total <= 0:
+                return 0.0
+            return 1.0 - avail / total
+        except OSError:
+            return 0.0
+
+    async def _memory_monitor_loop(self) -> None:
+        interval = self.config.memory_monitor_interval_ms / 1000.0
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                usage = self._memory_usage_fraction()
+                if usage >= self.config.memory_usage_threshold:
+                    await self._kill_for_memory(usage)
+            except Exception:
+                logger.exception("memory monitor failed")
+
+    async def _kill_for_memory(self, usage: float) -> None:
+        """Kill the newest leased worker (last to start loses — the
+        reference's group-by-owner policy simplified to newest-task-first,
+        worker_killing_policy_group_by_owner.h). The owner sees a worker
+        death whose reason is attributed to the memory monitor."""
+        for victim in self._oom_victim_order():
+            if victim.worker_id_hex in self._oom_killed:
+                continue  # already dying; give the exit monitor a tick
+            killed = False
+            if victim.proc is not None:
+                try:
+                    victim.proc.kill()
+                    killed = True
+                except Exception:
+                    pass
+            if not killed:
+                continue  # unkillable handle: try the next victim
+            self._oom_killed.add(victim.worker_id_hex)
+            self._kill_reasons[victim.worker_id_hex] = (
+                f"killed by the memory monitor: host memory usage "
+                f"{usage:.1%} >= threshold "
+                f"{self.config.memory_usage_threshold:.0%}")
+            logger.warning(
+                "memory usage %.1f%% >= %.0f%%: killed newest worker %s "
+                "(pid %d) to relieve pressure",
+                usage * 100, self.config.memory_usage_threshold * 100,
+                victim.worker_id_hex[:8], victim.pid)
+            return
+
+    def _oom_victim_order(self) -> List[WorkerHandle]:
+        """Newest-leased non-actor workers first (highest lease id), then
+        actor leases; never idle-pool workers (they hold no tasks and the
+        reaper handles them)."""
+        task_leases = sorted(
+            (l for l in self.leases.values() if not l.worker.is_actor),
+            key=lambda l: -l.lease_id)
+        actor_leases = sorted(
+            (l for l in self.leases.values() if l.worker.is_actor),
+            key=lambda l: -l.lease_id)
+        return [l.worker for l in task_leases + actor_leases]
+
+    def _pick_oom_victim(self) -> Optional[WorkerHandle]:
+        order = self._oom_victim_order()
+        return order[0] if order else None
 
     async def _reap_loop(self) -> None:
         """Kill surplus idle workers (≈ idle worker killing in worker_pool.cc)."""

@@ -28,6 +28,7 @@ import logging
 import os
 import threading
 import traceback
+from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional
 
@@ -55,6 +56,10 @@ class Executor:
         self._expected_seq: Dict[str, int] = {}
         self._waiting: Dict[str, Dict[int, TaskSpec]] = {}
         self._cancelled: set = set()
+        # push dedupe: the owner's push RPC may time out AFTER delivery
+        # and retry elsewhere/again — a task id must execute at most once
+        # here (bounded LRU)
+        self._seen_pushes: "OrderedDict[TaskID, bool]" = OrderedDict()
         self._tpu_env_set = False
         self._lock = threading.Lock()
 
@@ -62,6 +67,11 @@ class Executor:
 
     async def push_task(self, body) -> str:
         spec: TaskSpec = serialization.loads(body["spec"])
+        if spec.task_id in self._seen_pushes:
+            return "ok"  # duplicate delivery (timed-out push retried)
+        self._seen_pushes[spec.task_id] = True
+        while len(self._seen_pushes) > 10_000:
+            self._seen_pushes.popitem(last=False)
         if spec.kind == TaskKind.ACTOR_CREATION and spec.max_concurrency > 1:
             # threaded actor: widen the execution pool before __init__ runs
             self._pool = ThreadPoolExecutor(

@@ -350,3 +350,47 @@ class TestNativeAllocator:
             assert isinstance(store._alloc, _NativeFreeList)
         finally:
             store.shutdown()
+
+
+class TestOOMVictimPolicy:
+    """Memory-monitor victim selection (≈ worker_killing_policy):
+    newest leased task worker first, then actors, never the idle pool."""
+
+    def _supervisor(self):
+        from ray_tpu._private.supervisor import (Lease, Supervisor,
+                                                 WorkerHandle)
+
+        sup = Supervisor.__new__(Supervisor)
+        sup.leases = {}
+        sup.workers = {}
+        return sup, Lease, WorkerHandle
+
+    def test_prefers_newest_task_lease(self):
+        from ray_tpu._private.resources import ResourceSet
+
+        sup, Lease, WH = self._supervisor()
+        w1 = WH("w1", ("h", 1), 11, "k")
+        w2 = WH("w2", ("h", 2), 12, "k")
+        actor = WH("wa", ("h", 3), 13, "k", is_actor=True)
+        for i, w in enumerate([w1, actor, w2]):
+            sup.leases[i] = Lease(i, w, ResourceSet(), None)
+        assert sup._pick_oom_victim() is w2  # newest non-actor lease
+
+    def test_falls_back_to_actor(self):
+        from ray_tpu._private.resources import ResourceSet
+
+        sup, Lease, WH = self._supervisor()
+        actor = WH("wa", ("h", 3), 13, "k", is_actor=True)
+        sup.leases[5] = Lease(5, actor, ResourceSet(), None)
+        assert sup._pick_oom_victim() is actor
+
+    def test_no_victim_when_nothing_leased(self):
+        sup, _, WH = self._supervisor()
+        sup.workers["idle"] = WH("idle", ("h", 9), 99, "k")
+        assert sup._pick_oom_victim() is None
+
+    def test_memory_fraction_sane(self):
+        from ray_tpu._private.supervisor import Supervisor
+
+        frac = Supervisor._memory_usage_fraction()
+        assert 0.0 <= frac <= 1.0
