@@ -451,6 +451,12 @@ class RemoteFunction:
         refs = [ObjectRef(oid, core.address) for oid in oids]
         return refs[0] if num_returns == 1 else refs
 
+    def bind(self, *args, **kwargs):
+        """Build a lazy DAG node instead of submitting (ray.dag analog)."""
+        from ray_tpu.dag import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"remote function {self._fn.__name__}() cannot be called directly; "
@@ -510,6 +516,12 @@ class ActorMethod:
 
     def options(self, num_returns: int = 1) -> "ActorMethod":
         return ActorMethod(self._handle, self._name, num_returns)
+
+    def bind(self, *args, **kwargs):
+        """Build a lazy DAG node for this actor method (ray.dag analog)."""
+        from ray_tpu.dag import ClassMethodNode
+
+        return ClassMethodNode(self, args, kwargs)
 
     def remote(self, *args, **kwargs):
         core = _require_core()

@@ -1,0 +1,120 @@
+"""Lazy task DAGs: build with `.bind()`, run with `.execute()`.
+
+Analog of `ray.dag` (`python/ray/dag/dag_node.py`, function nodes
+`function_node.py`, input `input_node.py`): `fn.bind(...)` records a node
+instead of submitting; nodes compose into a graph whose edges become
+ObjectRef data dependencies at execution time — upstream results stream
+to downstream tasks through the object layer without materializing on
+the driver. `InputNode` marks runtime inputs; `MultiOutputNode` bundles
+several leaves.
+
+The reference's compiled/accelerated DAG (mutable channels,
+`compiled_dag_node.py:279`) is a GPU-NCCL-era optimization; here
+repeated execution reuses pooled workers and leases, and device-to-
+device tensor movement belongs to XLA collectives, so DAG execution
+stays uncompiled by design.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["DAGNode", "FunctionNode", "InputNode", "MultiOutputNode"]
+
+
+class DAGNode:
+    """Base: anything executable in a DAG."""
+
+    def execute(self, *input_values) -> Any:
+        """Run the graph; returns ObjectRef(s) for this node's output."""
+        cache: Dict[int, Any] = {}
+        n = _count_inputs(self)
+        if n and len(input_values) != n:
+            raise ValueError(
+                f"DAG expects {n} input(s), got {len(input_values)}")
+        return _resolve(self, list(input_values), cache)
+
+
+class InputNode(DAGNode):
+    """Placeholder bound at execute() time (≈ ray.dag.InputNode).
+
+    Supports the context-manager style of the reference:
+        with InputNode() as inp:
+            dag = f.bind(inp)
+    """
+
+    def __init__(self, index: int = 0):
+        self.index = index
+
+    def __enter__(self) -> "InputNode":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+
+class FunctionNode(DAGNode):
+    """One remote-function invocation with possibly-lazy arguments."""
+
+    def __init__(self, remote_fn, args: Tuple, kwargs: Dict[str, Any]):
+        self._fn = remote_fn
+        self._args = args
+        self._kwargs = kwargs
+
+
+class ClassMethodNode(DAGNode):
+    """One actor-method invocation with possibly-lazy arguments."""
+
+    def __init__(self, actor_method, args: Tuple, kwargs: Dict[str, Any]):
+        self._method = actor_method
+        self._args = args
+        self._kwargs = kwargs
+
+
+class MultiOutputNode(DAGNode):
+    """Bundle several DAG leaves; execute() returns a list of refs."""
+
+    def __init__(self, outputs: List[DAGNode]):
+        self._outputs = list(outputs)
+
+
+def _children(node: DAGNode):
+    if isinstance(node, (FunctionNode, ClassMethodNode)):
+        for a in list(node._args) + list(node._kwargs.values()):
+            if isinstance(a, DAGNode):
+                yield a
+    elif isinstance(node, MultiOutputNode):
+        yield from node._outputs
+
+
+def _count_inputs(node: DAGNode, seen=None) -> int:
+    seen = seen if seen is not None else set()
+    if id(node) in seen:
+        return 0
+    seen.add(id(node))
+    best = node.index + 1 if isinstance(node, InputNode) else 0
+    for c in _children(node):
+        best = max(best, _count_inputs(c, seen))
+    return best
+
+
+def _resolve(node: DAGNode, inputs: List[Any], cache: Dict[int, Any]):
+    if id(node) in cache:
+        return cache[id(node)]
+    if isinstance(node, InputNode):
+        out = inputs[node.index]
+    elif isinstance(node, MultiOutputNode):
+        out = [_resolve(c, inputs, cache) for c in node._outputs]
+    elif isinstance(node, (FunctionNode, ClassMethodNode)):
+        args = tuple(
+            _resolve(a, inputs, cache) if isinstance(a, DAGNode) else a
+            for a in node._args)
+        kwargs = {
+            k: _resolve(v, inputs, cache) if isinstance(v, DAGNode) else v
+            for k, v in node._kwargs.items()}
+        target = node._fn if isinstance(node, FunctionNode) else node._method
+        out = target.remote(*args, **kwargs)
+    else:
+        raise TypeError(f"not a DAG node: {node!r}")
+    cache[id(node)] = out
+    return out

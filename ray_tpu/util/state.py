@@ -69,3 +69,48 @@ def summarize_tasks() -> Dict[str, Dict[str, int]]:
 def cluster_metrics() -> str:
     """The controller's Prometheus exposition text."""
     return _call("metrics")
+
+
+def timeline(path: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Chrome-trace events from the task-event sink (≈ ray.timeline /
+    `ray timeline`): load the result into chrome://tracing or Perfetto.
+
+    Each task contributes one duration event per lifecycle span
+    (SUBMITTED→PUSHED as 'schedule', PUSHED→FINISHED/FAILED as 'run')
+    on a row per worker node. Returns the event list; writes JSON to
+    `path` when given.
+    """
+    events = _call("state_tasks", {"limit": 100_000})
+    by_task: Dict[str, List[Dict[str, Any]]] = {}
+    for ev in events:
+        by_task.setdefault(ev["task_id"], []).append(ev)
+
+    trace: List[Dict[str, Any]] = []
+    for task_id, evs in by_task.items():
+        evs.sort(key=lambda e: e["ts"])
+        stamps = {e["state"]: e for e in evs}
+        name = evs[0].get("name", task_id[:8])
+        node = evs[0].get("node", "") or "driver"
+        spans = [("schedule", "SUBMITTED", ("PUSHED", "RECONSTRUCTING")),
+                 ("run", "PUSHED", ("FINISHED", "FAILED"))]
+        for label, start_state, end_states in spans:
+            start = stamps.get(start_state)
+            end = next((stamps[s] for s in end_states if s in stamps), None)
+            if start is None or end is None:
+                continue
+            trace.append({
+                "name": f"{name}:{label}",
+                "cat": "task",
+                "ph": "X",  # complete event
+                "ts": start["ts"] * 1e6,   # chrome-trace wants microseconds
+                "dur": max(1.0, (end["ts"] - start["ts"]) * 1e6),
+                "pid": node[:12],
+                "tid": task_id[:8],
+                "args": {"task_id": task_id, "state_from": start_state},
+            })
+    if path:
+        import json
+
+        with open(path, "w") as f:
+            json.dump(trace, f)
+    return trace

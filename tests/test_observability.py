@@ -122,3 +122,32 @@ class TestLogToDriver:
             time.sleep(0.3)
         assert "HELLO-FROM-WORKER-xyzzy" in seen
         assert "pid=" in seen
+
+
+class TestTimeline:
+    def test_timeline_chrome_trace(self, ray_init, tmp_path):
+        """ray.timeline analog: task lifecycle events export as
+        chrome://tracing complete events."""
+        import json
+
+        @ray_tpu.remote
+        def traced_work(i):
+            time.sleep(0.01)
+            return i
+
+        # >100 tasks so the driver's event buffer flushes to the sink
+        ray_tpu.get([traced_work.remote(i) for i in range(120)])
+        deadline = time.monotonic() + 10
+        trace = []
+        while time.monotonic() < deadline:
+            trace = state_api.timeline(str(tmp_path / "trace.json"))
+            if any("traced_work" in ev["name"] for ev in trace):
+                break
+            ray_tpu.get([traced_work.remote(i) for i in range(120)])
+        run_events = [ev for ev in trace
+                      if "traced_work" in ev["name"] and ":run" in ev["name"]]
+        assert run_events, "no run spans in timeline"
+        ev = run_events[0]
+        assert ev["ph"] == "X" and ev["dur"] >= 1.0 and ev["ts"] > 0
+        loaded = json.load(open(tmp_path / "trace.json"))
+        assert len(loaded) == len(trace)
