@@ -1,0 +1,75 @@
+"""RLlib throughput harness: PPO env-steps/sec on Atari-shaped input.
+
+The BASELINE "PPO-Atari env-steps/sec/chip" row. Runs PPO with the
+Nature-CNN module over 84x84x4 uint8 frames — SyntheticAtari-v0 by
+default (same shapes/cost profile as ALE without the emulator; pass
+--env ALE/Breakout-v5 where ALE is installed). Prints ONE JSON line:
+
+    {"metric": "ppo_atari_env_steps_per_sec", "value": N, ...}
+
+Reference comparison point: tuned Ray+GPU PPO Atari sampling+learning
+sits at O(10k) env-steps/s per GPU (rllib release tests); vs_baseline
+is value / 10_000.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def run(env: str = "SyntheticAtari-v0", iters: int = 5,
+        num_env_runners: int = 2, num_envs: int = 8,
+        rollout: int = 32) -> dict:
+    import ray_tpu
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+
+    started_cluster = False
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=max(4, num_env_runners + 2))
+        started_cluster = True
+    try:
+        algo = (PPOConfig()
+                .environment(env=env)
+                .env_runners(num_env_runners=num_env_runners,
+                             num_envs_per_env_runner=num_envs,
+                             rollout_fragment_length=rollout)
+                .training(train_batch_size=rollout * num_envs,
+                          minibatch_size=256, num_epochs=2)
+                .build())
+        try:
+            algo.train()  # warmup: compiles sample + update programs
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                algo.train()
+            dt = time.perf_counter() - t0
+        finally:
+            algo.stop()
+    finally:
+        if started_cluster:
+            ray_tpu.shutdown()
+
+    steps = iters * rollout * num_envs * max(1, num_env_runners)
+    sps = steps / dt
+    return {
+        "metric": "ppo_atari_env_steps_per_sec",
+        "value": round(sps, 1),
+        "unit": "env_steps/s",
+        "vs_baseline": round(sps / 10_000, 4),
+        "detail": {"env": env, "iters": iters, "runners": num_env_runners,
+                   "envs_per_runner": num_envs, "rollout": rollout,
+                   "total_steps": steps, "elapsed_s": round(dt, 2)},
+    }
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--env", default="SyntheticAtari-v0")
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--runners", type=int, default=2)
+    ap.add_argument("--envs", type=int, default=8)
+    ap.add_argument("--rollout", type=int, default=32)
+    ns = ap.parse_args()
+    print(json.dumps(run(ns.env, ns.iters, ns.runners, ns.envs,
+                         ns.rollout)))

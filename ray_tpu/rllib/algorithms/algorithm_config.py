@@ -84,18 +84,30 @@ class AlgorithmConfig:
 
     def rl_module_spec(self) -> RLModuleSpec:
         obs_dim, num_actions = self.observation_dim, self.num_actions
+        obs_shape: tuple = tuple(self.model.get("obs_shape", ()))
         if obs_dim is None or num_actions is None:
+            import math
+
             import gymnasium as gym
+
+            import ray_tpu.rllib.env  # registers the synthetic envs
 
             probe = gym.make(self.env, **self.env_config)
             try:
-                obs_dim = obs_dim or int(probe.observation_space.shape[0])
+                shape = probe.observation_space.shape
+                if len(shape) == 3:
+                    # image obs: Nature-CNN torso over the full shape
+                    obs_shape = tuple(int(s) for s in shape)
+                    obs_dim = obs_dim or int(math.prod(shape))
+                else:
+                    obs_dim = obs_dim or int(shape[0])
                 num_actions = num_actions or int(probe.action_space.n)
             finally:
                 probe.close()
         return RLModuleSpec(obs_dim=obs_dim, num_actions=num_actions,
                             hiddens=tuple(self.model.get("hiddens",
-                                                         (64, 64))))
+                                                         (64, 64))),
+                            obs_shape=obs_shape)
 
     def build(self):
         assert self.algo_class is not None, "use a subclass (PPOConfig, …)"

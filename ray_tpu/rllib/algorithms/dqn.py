@@ -132,10 +132,12 @@ class DQN(Algorithm):
             self._steps_since_target_sync += T * B
             done = (s["terminateds"] | s["truncateds"])
             self.replay.add({
-                "obs": s["obs"].reshape(T * B, -1),
+                "obs": s["obs"].reshape(
+                    (T * B,) + s["obs"].shape[2:]),
                 "actions": s["actions"].reshape(T * B),
                 "rewards": s["rewards"].reshape(T * B).astype(np.float32),
-                "next_obs": s["next_obs"].reshape(T * B, -1),
+                "next_obs": s["next_obs"].reshape(
+                    (T * B,) + s["next_obs"].shape[2:]),
                 "dones": done.reshape(T * B),
             })
 
@@ -153,8 +155,11 @@ class DQN(Algorithm):
                 batch = self.replay.sample(cfg.train_batch_size)
             idx = batch.pop("batch_indexes", None)
             targets = self._compute_targets(batch)
+            # obs pass through at stored dtype: uint8 frames must reach the
+            # conv stem un-cast so online Q and TD targets share the same
+            # /255 normalization; flat obs are already float32
             learner_batch = {
-                "obs": batch["obs"].astype(np.float32),
+                "obs": batch["obs"],
                 "actions": batch["actions"],
                 "targets": targets,
             }

@@ -92,7 +92,8 @@ class PPO(Algorithm):
             batch_tm["truncateds"], gamma=cfg.gamma, lam=cfg.lam)
 
         flat = {
-            "obs": batch_tm["obs"].reshape(T * B, -1),
+            "obs": batch_tm["obs"].reshape(
+                (T * B,) + batch_tm["obs"].shape[2:]),
             "actions": batch_tm["actions"].reshape(T * B),
             "logp": batch_tm["logp"].reshape(T * B),
             "values": batch_tm["values"].reshape(T * B),

@@ -29,6 +29,12 @@ class RLModuleSpec:
     #: separate value-net trunk (reference default vf_share_layers=False —
     #: shared trunks let large value errors swamp the policy gradient)
     vf_share_layers: bool = False
+    #: image observations: set obs_shape (e.g. (84, 84, 4) Atari stack)
+    #: to use the Nature-CNN torso; uint8 obs are normalized to [0,1].
+    #: The MXU wants the conv path — image RL on TPU runs here.
+    obs_shape: Tuple[int, ...] = ()
+    conv_filters: Tuple[Tuple[int, int, int], ...] = (
+        (32, 8, 4), (64, 4, 2), (64, 3, 1))  # (out_ch, kernel, stride)
 
 
 def _init_linear(key, fan_in: int, fan_out: int, scale: float = 1.0):
@@ -44,11 +50,43 @@ class RLModule:
     def __init__(self, spec: RLModuleSpec):
         self.spec = spec
 
+    @property
+    def _is_conv(self) -> bool:
+        return len(self.spec.obs_shape) == 3
+
+    def _conv_out_dim(self) -> int:
+        h, w, _ = self.spec.obs_shape
+        for _, k, s in self.spec.conv_filters:
+            h = (h - k) // s + 1
+            w = (w - k) // s + 1
+            if h <= 0 or w <= 0:
+                raise ValueError(
+                    f"obs_shape {self.spec.obs_shape} too small for "
+                    f"conv_filters {self.spec.conv_filters}: spatial dim "
+                    f"collapses to {h}x{w} at kernel={k} stride={s}")
+        return h * w * self.spec.conv_filters[-1][0]
+
     def init_params(self, key) -> Dict[str, Any]:
         nh = len(self.spec.hiddens)
-        keys = jax.random.split(key, 2 * nh + 2)
+        keys = jax.random.split(key, 2 * nh + 2 + 8)
         params: Dict[str, Any] = {"torso": []}
-        fan_in = self.spec.obs_dim
+        if self._is_conv:
+            # Nature-CNN stem shared by policy and value (standard Atari
+            # practice; the dense torso is still separate when
+            # vf_share_layers=False)
+            params["conv"] = []
+            in_ch = self.spec.obs_shape[-1]
+            for j, (out_ch, k, _s) in enumerate(self.spec.conv_filters):
+                wkey = keys[2 * nh + 2 + j]
+                params["conv"].append({
+                    "w": jax.nn.initializers.orthogonal(float(np.sqrt(2)))(
+                        wkey, (k, k, in_ch, out_ch)),
+                    "b": jnp.zeros((out_ch,)),
+                })
+                in_ch = out_ch
+            fan_in = self._conv_out_dim()
+        else:
+            fan_in = self.spec.obs_dim
         for i, h in enumerate(self.spec.hiddens):
             params["torso"].append(_init_linear(keys[i], fan_in, h,
                                                 scale=float(np.sqrt(2))))
@@ -58,15 +96,30 @@ class RLModule:
         params["vf"] = _init_linear(keys[-1], fan_in, 1, scale=1.0)
         if not self.spec.vf_share_layers:
             params["vf_torso"] = []
-            fan_in = self.spec.obs_dim
+            fan_in = self._conv_out_dim() if self._is_conv \
+                else self.spec.obs_dim
             for i, h in enumerate(self.spec.hiddens):
                 params["vf_torso"].append(_init_linear(
                     keys[nh + i], fan_in, h, scale=float(np.sqrt(2))))
                 fan_in = h
         return params
 
+    def _conv_stem(self, params, obs):
+        if obs.dtype == jnp.uint8:
+            x = obs.astype(jnp.float32) / 255.0
+        else:
+            x = obs.astype(jnp.float32)
+        for layer, (_out, _k, s) in zip(params["conv"],
+                                        self.spec.conv_filters):
+            x = jax.lax.conv_general_dilated(
+                x, layer["w"], window_strides=(s, s), padding="VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            x = jax.nn.relu(x + layer["b"])
+        return x.reshape(x.shape[0], -1)
+
     def _torso(self, params, obs, key="torso"):
-        x = obs
+        # conv stem is shared between the torsos; dense layers differ
+        x = self._conv_stem(params, obs) if self._is_conv else obs
         for layer in params[key]:
             x = jnp.tanh(x @ layer["w"] + layer["b"])
         return x

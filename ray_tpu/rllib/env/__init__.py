@@ -1,0 +1,4 @@
+try:  # registers SyntheticAtari-v0 with gymnasium when available
+    from ray_tpu.rllib.env import synthetic_atari  # noqa: F401
+except ImportError:  # pragma: no cover — gym absent
+    pass

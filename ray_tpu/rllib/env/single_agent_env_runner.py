@@ -51,6 +51,14 @@ class SingleAgentEnvRunner:
         self.params = jax.tree.map(jnp.asarray, weights)
         return True
 
+
+    def _prep_obs(self, obs):
+        """uint8 image obs stay uint8 (the CNN stem normalizes by /255);
+        everything else is float32 for the torso."""
+        if len(self._spec.obs_shape) == 3 and obs.dtype == np.uint8:
+            return obs
+        return obs.astype(np.float32)
+
     def sample(self, num_steps: int,
                epsilon: Optional[float] = None,
                greedy: bool = False) -> Dict[str, np.ndarray]:
@@ -61,26 +69,31 @@ class SingleAgentEnvRunner:
         import jax
 
         T, B = num_steps, self.num_envs
-        obs_buf = np.empty((T, B, self._spec.obs_dim), np.float32)
+        # uint8 image envs keep raw (H, W, C) frames; anything else
+        # (flat specs, float-valued image envs) buffers as float32
+        obs_shape = tuple(self._spec.obs_shape) or (self._spec.obs_dim,)
+        obs_dtype = (np.uint8 if len(obs_shape) == 3
+                     and self._obs.dtype == np.uint8 else np.float32)
+        obs_buf = np.empty((T, B) + obs_shape, obs_dtype)
         act_buf = np.empty((T, B), np.int64)
         logp_buf = np.empty((T, B), np.float32)
         val_buf = np.empty((T, B), np.float32)
         rew_buf = np.empty((T, B), np.float32)
         term_buf = np.empty((T, B), np.bool_)
         trunc_buf = np.empty((T, B), np.bool_)
-        next_obs_buf = np.empty((T, B, self._spec.obs_dim), np.float32)
+        next_obs_buf = np.empty((T, B) + obs_shape, obs_dtype)
 
         for t in range(T):
             self._key, sub = jax.random.split(self._key)
             if greedy:
                 logits = self._infer_fn(self.params,
-                                        self._obs.astype(np.float32))
+                                        self._prep_obs(self._obs))
                 action = np.asarray(logits).argmax(-1)
                 logp = np.zeros(B, np.float32)
                 value = np.zeros(B, np.float32)
             else:
                 action, logp, value = self._explore_fn(
-                    self.params, self._obs.astype(np.float32), sub)
+                    self.params, self._prep_obs(self._obs), sub)
             action = np.asarray(action)
             if epsilon is not None and epsilon > 0:
                 rand_mask = np.random.random(B) < epsilon
@@ -111,7 +124,7 @@ class SingleAgentEnvRunner:
         import jax.numpy as jnp
 
         _, last_val = self.module.forward_train(
-            self.params, jnp.asarray(self._obs, jnp.float32))
+            self.params, jnp.asarray(self._prep_obs(self._obs)))
         return {
             "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
             "values": val_buf, "rewards": rew_buf,

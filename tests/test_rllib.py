@@ -298,3 +298,40 @@ class TestConfigValidation:
 
         with pytest.raises(AssertionError):
             PPOConfig().build()
+
+
+class TestAtariShapedPPO:
+    """Image-observation PPO: Nature-CNN module over 84x84x4 uint8 frames
+    (the BASELINE PPO-Atari path, SyntheticAtari standing in for ALE)."""
+
+    def test_cnn_module_spec_inferred(self, ray_init):
+        from ray_tpu.rllib.algorithms.ppo import PPOConfig
+
+        spec = (PPOConfig().environment(env="SyntheticAtari-v0")
+                .rl_module_spec())
+        assert spec.obs_shape == (84, 84, 4)
+        assert spec.num_actions == 6
+
+    def test_cnn_forward_shapes(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ray_tpu.rllib.core.rl_module import RLModule, RLModuleSpec
+
+        spec = RLModuleSpec(obs_dim=84 * 84 * 4, num_actions=6,
+                            obs_shape=(84, 84, 4))
+        mod = RLModule(spec)
+        params = mod.init_params(jax.random.PRNGKey(0))
+        obs = np.zeros((3, 84, 84, 4), np.uint8)
+        logits, value = mod.forward_train(params, jnp.asarray(obs))
+        assert logits.shape == (3, 6) and value.shape == (3,)
+
+    def test_throughput_harness_reports(self, ray_init):
+        import bench_rllib
+
+        out = bench_rllib.run(iters=2, num_env_runners=0, num_envs=4,
+                              rollout=8)
+        assert out["metric"] == "ppo_atari_env_steps_per_sec"
+        assert out["value"] > 0
+        assert out["detail"]["total_steps"] == 2 * 8 * 4
