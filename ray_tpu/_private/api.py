@@ -139,6 +139,18 @@ def init(
             if ignore_reinit_error:
                 return {"address": f"{_core.controller_addr[0]}:{_core.controller_addr[1]}"}
             raise RuntimeError("ray_tpu.init() called twice; use shutdown() first")
+        if _client is not None:
+            if ignore_reinit_error:
+                return {"address": _client._address, "client": True}
+            raise RuntimeError("ray_tpu.init() called twice; use shutdown() first")
+        if address and address.startswith("client://"):
+            from ray_tpu.util import client as _client_mod
+
+            _namespace = namespace
+            ctx = _client_mod.connect(address[len("client://"):],
+                                      namespace=namespace)
+            return {"address": address, "client": True,
+                    "namespace": ctx._server_namespace}
         config = Config.from_env(_system_config)
         if object_store_memory:
             config.object_store_memory_bytes = object_store_memory
@@ -259,9 +271,33 @@ def _connect_existing(core: CoreWorker) -> None:
     _core = core
 
 
+# ------------------------------------------------------------------ client mode
+# ≈ ray.util.client: when connected through a client server, the module-level
+# API proxies through a ClientContext instead of a local CoreWorker.
+
+_client = None
+
+
+def _install_client(ctx) -> None:
+    global _client
+    if _core is not None:
+        raise RuntimeError(
+            "cannot enter client mode: this process already runs a driver "
+            "(call shutdown() first)")
+    _client = ctx
+
+
+def _uninstall_client() -> None:
+    global _client
+    if _client is not None:
+        _client.disconnect()
+        _client = None
+
+
 def shutdown() -> None:
     global _core, _node_handle
     with _global_lock:
+        _uninstall_client()
         if _core is not None:
             try:
                 _core._run(
@@ -280,7 +316,7 @@ def shutdown() -> None:
 
 
 def is_initialized() -> bool:
-    return _core is not None
+    return _core is not None or _client is not None
 
 
 def _require_core() -> CoreWorker:
@@ -293,6 +329,8 @@ def _require_core() -> CoreWorker:
 
 
 def put(value: Any) -> ObjectRef:
+    if _client is not None:
+        return _client.put(value)
     core = _require_core()
     oid, owner = core.put(value)
     return ObjectRef(oid, owner)
@@ -301,6 +339,8 @@ def put(value: Any) -> ObjectRef:
 def get(
     refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float] = None
 ) -> Any:
+    if _client is not None:
+        return _client.get(refs, timeout=timeout)
     core = _require_core()
     single = isinstance(refs, ObjectRef)
     batch = [refs] if single else list(refs)
@@ -317,18 +357,26 @@ def wait(
     num_returns: int = 1,
     timeout: Optional[float] = None,
 ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
-    core = _require_core()
     if num_returns > len(refs):
         raise ValueError("num_returns exceeds the number of refs")
+    if _client is not None:
+        return _client.wait(refs, num_returns=num_returns, timeout=timeout)
+    core = _require_core()
     return core.wait(list(refs), num_returns=num_returns, timeout=timeout)
 
 
 def kill(actor: "ActorHandle", *, no_restart: bool = True) -> None:
+    if _client is not None:
+        _client.kill(actor, no_restart=no_restart)
+        return
     _require_core().kill_actor(actor._actor_id, no_restart=no_restart)
 
 
 def cancel(ref: ObjectRef, *, force: bool = False) -> None:
     """Best-effort cancellation of a queued task."""
+    if _client is not None:
+        _client.cancel(ref, force=force)
+        return
     core = _require_core()
     task = core._inflight_tasks.get(ref._object_id.task_id())
     if task is not None and task.lease is not None:
@@ -343,17 +391,23 @@ def cancel(ref: ObjectRef, *, force: bool = False) -> None:
 
 
 def nodes() -> List[Dict[str, Any]]:
+    if _client is not None:
+        return _client.nodes()
     core = _require_core()
     return core._run(core.clients.get(core.controller_addr).call("node_views"))
 
 
 def cluster_resources() -> Dict[str, float]:
+    if _client is not None:
+        return _client.cluster_resources()
     core = _require_core()
     status = core._run(core.clients.get(core.controller_addr).call("cluster_status"))
     return status["total_resources"]
 
 
 def available_resources() -> Dict[str, float]:
+    if _client is not None:
+        return _client.available_resources()
     core = _require_core()
     status = core._run(core.clients.get(core.controller_addr).call("cluster_status"))
     return status["available_resources"]
@@ -429,6 +483,10 @@ class RemoteFunction:
         return rf
 
     def remote(self, *args, **kwargs):
+        if _client is not None:
+            key, blob = self._materialize()
+            return _client.submit_task(
+                blob, self._fn.__qualname__, args, kwargs, self._options)
         core = _require_core()
         opts = self._options
         key, blob = self._materialize()
@@ -576,6 +634,8 @@ class ActorClass:
         return ActorClass(self._cls, new)
 
     def remote(self, *args, **kwargs) -> ActorHandle:
+        if _client is not None:
+            return _client.create_actor(self._cls, args, kwargs, self._options)
         core = _require_core()
         opts = self._options
         resources = _resources_from_options(opts)
@@ -638,6 +698,8 @@ def method(**opts):
 
 
 def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
+    if _client is not None:
+        return _client.get_actor(name, namespace)
     core = _require_core()
     rec = core._run(
         core.clients.get(core.controller_addr).call(

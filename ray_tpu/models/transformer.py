@@ -59,6 +59,10 @@ class TransformerConfig:
     remat_policy: str = "full"
     scan_layers: bool = True                  # stack layers, lax.scan over them
     attn_impl: str = "auto"                   # 'auto'|'flash'|'reference'|'ring'
+    # fold the vocab projection into the CE loss (chunked, logits never
+    # materialized — see ops/losses.fused_softmax_cross_entropy)
+    fused_ce: bool = True
+    ce_chunk: int = 2048
 
     @property
     def kv_heads(self) -> int:
@@ -270,8 +274,11 @@ def _block(cfg, p, x, rope, positions, sp_axis, kv_cache=None):
 
 def forward(cfg: TransformerConfig, params, tokens, *, positions=None,
             sp_axis: Optional[str] = None, kv_caches=None,
-            return_aux: bool = False):
+            return_aux: bool = False, return_hidden: bool = False):
     """tokens [B, S] int32 -> logits [B, S, vocab].
+
+    return_hidden: skip the vocab projection and return the post-final-norm
+    hidden states [B, S, D] (with aux) — used by the fused-CE loss path.
 
     sp_axis: when running inside shard_map with sequence sharded over that
     axis, attention goes through the ring kernel and `positions` must be the
@@ -329,6 +336,8 @@ def forward(cfg: TransformerConfig, params, tokens, *, positions=None,
                 new_caches.append(c)
 
     x = _norm(cfg, params["final_norm"], x)
+    if return_hidden:
+        return x, aux_total
     if cfg.tie_embeddings:
         logits = jnp.einsum("bsd,vd->bsv", x,
                             params["embed"]["table"].astype(cfg.dtype))
@@ -347,14 +356,26 @@ def loss_fn(cfg: TransformerConfig, params, batch, *, sp_axis=None,
     """Causal-LM loss. batch: {'tokens': [B,S], optional 'mask': [B,S]}.
     Targets are tokens shifted left; the last position is dropped."""
     tokens = batch["tokens"]
-    logits, aux = forward(cfg, params, tokens, sp_axis=sp_axis,
-                          positions=positions, return_aux=True)
     targets = tokens[:, 1:]
-    logits = logits[:, :-1]
     mask = batch.get("mask")
     if mask is not None:
         mask = mask[:, 1:]
-    loss, n = softmax_cross_entropy(logits, targets, mask)
+    if cfg.fused_ce:
+        from ray_tpu.ops.losses import fused_softmax_cross_entropy
+
+        hidden, aux = forward(cfg, params, tokens, sp_axis=sp_axis,
+                              positions=positions, return_hidden=True)
+        if cfg.tie_embeddings:
+            table, transpose = params["embed"]["table"], False
+        else:
+            table, transpose = params["lm_head"]["kernel"], True
+        loss, n = fused_softmax_cross_entropy(
+            hidden[:, :-1], table, targets, mask, chunk=cfg.ce_chunk,
+            compute_dtype=cfg.dtype, transpose_table=transpose)
+    else:
+        logits, aux = forward(cfg, params, tokens, sp_axis=sp_axis,
+                              positions=positions, return_aux=True)
+        loss, n = softmax_cross_entropy(logits[:, :-1], targets, mask)
     metrics = {"loss": loss, "tokens": n}
     if cfg.mlp == "moe":
         loss = loss + cfg.moe_aux_weight * aux
