@@ -117,8 +117,13 @@ def make_train_step(cfg: TransformerConfig, tx, mesh=None,
                     rules: Optional[ShardingRules] = None,
                     loss: Optional[Callable] = None,
                     donate: bool = True,
-                    batch_sharding=None):
-    """Returns step(state, batch) -> (state, metrics), jitted (sharded if mesh)."""
+                    batch_sharding=None,
+                    log_grad_norm: bool = True):
+    """Returns step(state, batch) -> (state, metrics), jitted (sharded if mesh).
+
+    log_grad_norm=False drops the grad_norm metric, saving one full pass
+    over the gradients (~0.5 GB of HBM reads for a 124M-param model) —
+    clipping inside `tx` still sees the norm either way."""
     loss = loss or (lambda p, b: loss_fn(cfg, p, b))
 
     def step_fn(state: TrainState, batch):
@@ -127,7 +132,8 @@ def make_train_step(cfg: TransformerConfig, tx, mesh=None,
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = dict(metrics)
-        metrics["grad_norm"] = optax.global_norm(grads)
+        if log_grad_norm:
+            metrics["grad_norm"] = optax.global_norm(grads)
         return TrainState(params=new_params, opt_state=new_opt,
                           step=state.step + 1), metrics
 

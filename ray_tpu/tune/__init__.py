@@ -11,12 +11,17 @@ from ray_tpu.tune.schedulers import (  # noqa: F401
     ASHAScheduler,
     AsyncHyperBandScheduler,
     FIFOScheduler,
+    HyperBandScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
     TrialScheduler,
 )
 from ray_tpu.tune.search import (  # noqa: F401
     BasicVariantGenerator,
+    BOHBSearcher,
+    Searcher,
+    TPESearcher,
     choice,
     grid_search,
     loguniform,

@@ -25,7 +25,8 @@ from ray_tpu.train._internal.session import TrainingReport
 from ray_tpu.train._internal.storage import StorageContext
 from ray_tpu.train._internal.worker_group import WorkerGroup
 from ray_tpu.tune import schedulers as sched_mod
-from ray_tpu.tune.trial import (ERROR, PENDING, RUNNING, TERMINATED, Trial)
+from ray_tpu.tune.trial import (ERROR, PAUSED, PENDING, RUNNING, TERMINATED,
+                                Trial)
 
 logger = logging.getLogger(__name__)
 
@@ -65,9 +66,17 @@ class TuneController:
         gang_bundles: Optional[List[Dict[str, float]]] = None,
         gang_strategy: str = "PACK",
         gang_placement_timeout_s: float = 60.0,
+        searcher=None,
+        num_samples: int = 0,
+        trial_resources: Optional[Dict[str, float]] = None,
     ):
         self._fn = trainable_fn
         self.trials = trials
+        # adaptive search: trials are suggested incrementally (up to
+        # num_samples) instead of pre-generated
+        self._searcher = searcher
+        self._num_samples = num_samples
+        self._trial_resources = dict(trial_resources or {"CPU": 1.0})
         # one PG per trial covering the trial actor + its trainer's
         # worker gang; None for plain function trainables
         self._gang_bundles = gang_bundles
@@ -117,14 +126,32 @@ class TuneController:
     def run(self) -> List[Trial]:
         try:
             while True:
+                self._apply_scheduler_actions()
+                self._maybe_suggest_trials()
                 self._start_pending()
-                if not self._running:
-                    if all(t.is_finished() for t in self.trials):
-                        break
-                    if not any(t.status == PENDING for t in self.trials):
-                        break
+                if self._running:
+                    self._poll_once()
                     continue
-                self._poll_once()
+                if any(t.status == PENDING for t in self.trials):
+                    continue
+                paused = [t for t in self.trials if t.status == PAUSED]
+                if paused:
+                    # nothing runnable anywhere: let the scheduler resolve
+                    # part-filled rungs (HyperBand with a short trial
+                    # supply), then retry once before giving up
+                    if hasattr(self._scheduler, "on_no_more_trials"):
+                        self._scheduler.on_no_more_trials(
+                            {t.trial_id for t in paused})
+                        self._apply_scheduler_actions()
+                        if any(t.status == PENDING for t in self.trials):
+                            continue
+                    for t in paused:
+                        t.status = TERMINATED
+                        self._scheduler.on_trial_complete(t, t.last_result)
+                        if self._searcher is not None:
+                            self._searcher.on_trial_complete(
+                                t.trial_id, t.last_result)
+                break
         finally:
             for rt in list(self._running.values()):
                 rt.shutdown()
@@ -133,6 +160,44 @@ class TuneController:
                 self._remove_trial_pg(trial)
             self.save_state()
         return self.trials
+
+    def _apply_scheduler_actions(self) -> None:
+        """Execute RESUME/STOP verdicts for paused trials (HyperBand)."""
+        pop = getattr(self._scheduler, "pop_actions", None)
+        if pop is None:
+            return
+        actions = pop()
+        if not actions:
+            return
+        by_id = {t.trial_id: t for t in self.trials}
+        for tid, act in actions.items():
+            trial = by_id.get(tid)
+            if trial is None or trial.status != PAUSED:
+                continue
+            if act == "RESUME":
+                trial.status = PENDING
+            else:
+                trial.status = TERMINATED
+                self._scheduler.on_trial_complete(trial, trial.last_result)
+                if self._searcher is not None:
+                    self._searcher.on_trial_complete(tid, trial.last_result)
+        self.save_state(force=False)
+
+    def _maybe_suggest_trials(self) -> None:
+        """Adaptive search: keep the concurrency window fed with fresh
+        suggestions until num_samples trials exist."""
+        if self._searcher is None:
+            return
+        while (len(self.trials) < self._num_samples
+               and sum(1 for t in self.trials
+                       if t.status in (PENDING, RUNNING))
+               < self._max_concurrent):
+            trial = Trial(config={}, resources=dict(self._trial_resources))
+            cfg = self._searcher.suggest(trial.trial_id)
+            if cfg is None:
+                return
+            trial.config = cfg
+            self.trials.append(trial)
 
     def _start_pending(self) -> None:
         slots = self._max_concurrent - len(self._running)
@@ -264,8 +329,20 @@ class TuneController:
             self._exploit(rt, exploit)
         elif decision == sched_mod.STOP:
             self._finish_trial(rt, TERMINATED)
+        elif decision == sched_mod.PAUSE:
+            self._pause_trial(rt)
         else:
             rt.arm()
+        self.save_state(force=False)
+
+    def _pause_trial(self, rt: _RunningTrial) -> None:
+        """Release the trial's actor + gang; it stays resumable from its
+        latest checkpoint (HyperBand rung synchronization)."""
+        trial = rt.trial
+        rt.shutdown()
+        self._running.pop(trial.trial_id, None)
+        self._remove_trial_pg(trial)
+        trial.status = PAUSED
         self.save_state(force=False)
 
     def _exploit(self, rt: _RunningTrial, exploit) -> None:
@@ -300,6 +377,9 @@ class TuneController:
         rt.trial.status = status
         rt.trial.error = error
         self._scheduler.on_trial_complete(rt.trial, rt.trial.last_result)
+        if self._searcher is not None:
+            self._searcher.on_trial_complete(rt.trial.trial_id,
+                                             rt.trial.last_result)
         rt.shutdown()
         self._running.pop(rt.trial.trial_id, None)
         self._remove_trial_pg(rt.trial)
@@ -319,5 +399,8 @@ class TuneController:
             trial.status = ERROR
             trial.error = error
             self._scheduler.on_trial_complete(trial, trial.last_result)
+            if self._searcher is not None:
+                self._searcher.on_trial_complete(trial.trial_id,
+                                                 trial.last_result)
             self._remove_trial_pg(trial)
         self.save_state()

@@ -165,3 +165,177 @@ class BasicVariantGenerator:
                     _set(cfg, path, dom.sample(self._rng))
                 variants.append(cfg)
         return variants
+
+
+# --------------------------------------------------------------------------
+# Adaptive searchers (suggest/observe protocol)
+
+
+class Searcher:
+    """Adaptive search protocol (≈ `python/ray/tune/search/searcher.py`):
+    the controller asks `suggest()` for each new trial config and feeds
+    completed results back via `on_trial_complete()`."""
+
+    def set_objective(self, metric: str, mode: str) -> None:
+        self._metric = metric
+        self._mode = mode
+
+    def set_search_space(self, param_space: Dict[str, Any]) -> None:
+        self._space = param_space
+
+    def _score(self, result: Optional[Dict[str, Any]]) -> Optional[float]:
+        if not result:
+            return None
+        v = result.get(self._metric)
+        if v is None:
+            return None
+        return float(v) if self._mode == "max" else -float(v)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]]) -> None:
+        pass
+
+
+class TPESearcher(Searcher):
+    """Tree-structured Parzen Estimator (the BOHB model family; ≈ the role
+    of `python/ray/tune/search/bohb/bohb_search.py` without the external
+    ConfigSpace/HpBandSter deps — pure numpy).
+
+    After `n_initial` random suggestions, observations are split into a good
+    (top `gamma` fraction) and bad set per numeric dimension; candidates are
+    drawn from a Gaussian KDE over the good set and ranked by the density
+    ratio l(x)/g(x). Choice dimensions use smoothed category counts.
+    GridSearch entries are unsupported (use BasicVariantGenerator);
+    SampleFrom falls back to random sampling.
+    """
+
+    def __init__(self, n_initial: int = 10, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: Optional[int] = None):
+        self.n_initial = n_initial
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self._rng = np.random.default_rng(seed)
+        self._suggested: Dict[str, Dict[str, Any]] = {}
+        self._obs: List[tuple] = []  # (config, score or None)
+
+    def set_search_space(self, param_space: Dict[str, Any]) -> None:
+        super().set_search_space(param_space)
+        self._leaves = list(_walk(param_space))
+        for path, spec in self._leaves:
+            if isinstance(spec, GridSearch):
+                raise ValueError(
+                    "TPESearcher does not support grid_search entries; "
+                    "use BasicVariantGenerator for grids")
+        self._obs = []  # list of (config, score)
+
+    # ------------------------------------------------------------- transforms
+
+    @staticmethod
+    def _to_unit(spec, v):
+        """Map a value into the KDE's working space."""
+        if isinstance(spec, LogUniform):
+            return np.log(v)
+        return float(v)
+
+    @staticmethod
+    def _from_unit(spec, u):
+        if isinstance(spec, LogUniform):
+            v = float(np.exp(u))
+            return float(np.clip(v, spec.low, spec.high))
+        if isinstance(spec, Uniform):
+            return float(np.clip(u, spec.low, spec.high))
+        if isinstance(spec, QUniform):
+            v = float(np.clip(u, spec.low, spec.high))
+            return float(np.round(v / spec.q) * spec.q)
+        if isinstance(spec, RandInt):
+            return int(np.clip(round(u), spec.low, spec.high - 1))
+        if isinstance(spec, Normal):
+            return float(u)
+        return float(u)
+
+    def _kde_sample_and_pick(self, spec, good_u, bad_u):
+        """Sample candidates from KDE(good), rank by good/bad density."""
+        good_u = np.asarray(good_u, np.float64)
+        bad_u = np.asarray(bad_u, np.float64)
+
+        def bw(xs):
+            if len(xs) < 2:
+                return 1.0
+            s = np.std(xs)
+            return max(s * len(xs) ** -0.2, 1e-6)
+
+        bw_g, bw_b = bw(good_u), bw(bad_u)
+        centers = good_u[self._rng.integers(0, len(good_u),
+                                            self.n_candidates)]
+        cands = centers + self._rng.normal(0, bw_g, self.n_candidates)
+
+        def log_density(xs, b, at):
+            d = (at[:, None] - xs[None, :]) / b
+            return np.log(np.mean(np.exp(-0.5 * d * d), axis=1) / b + 1e-12)
+
+        score = log_density(good_u, bw_g, cands)
+        if len(bad_u):
+            score = score - log_density(bad_u, bw_b, cands)
+        return float(cands[int(np.argmax(score))])
+
+    def _choice_pick(self, spec, good_vals):
+        """Categorical: sample ∝ smoothed counts in the good set."""
+        cats = spec.categories
+        counts = np.ones(len(cats), np.float64)
+        for v in good_vals:
+            try:
+                counts[cats.index(v)] += 1.0
+            except ValueError:
+                pass
+        p = counts / counts.sum()
+        return cats[int(self._rng.choice(len(cats), p=p))]
+
+    # --------------------------------------------------------------- protocol
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        scored = [(c, s) for c, s in self._obs if s is not None]
+        cfg = _deepcopy_plain(self._space)
+        if len(scored) < self.n_initial:
+            for path, spec in self._leaves:
+                _set(cfg, path, spec.sample(self._rng))
+            self._suggested[trial_id] = cfg
+            return cfg
+        scored.sort(key=lambda cs: cs[1], reverse=True)
+        n_good = max(1, int(np.ceil(self.gamma * len(scored))))
+        good = [c for c, _ in scored[:n_good]]
+        bad = [c for c, _ in scored[n_good:]]
+        for path, spec in self._leaves:
+            if isinstance(spec, Choice):
+                _set(cfg, path, self._choice_pick(
+                    spec, [_get(c, path) for c in good]))
+            elif isinstance(spec, SampleFrom):
+                _set(cfg, path, spec.sample(self._rng))
+            else:
+                good_u = [self._to_unit(spec, _get(c, path)) for c in good]
+                bad_u = [self._to_unit(spec, _get(c, path)) for c in bad]
+                u = self._kde_sample_and_pick(spec, good_u, bad_u)
+                _set(cfg, path, self._from_unit(spec, u))
+        self._suggested[trial_id] = cfg
+        return cfg
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]]) -> None:
+        cfg = self._suggested.pop(trial_id, None)
+        if cfg is not None:
+            self._obs.append((cfg, self._score(result)))
+
+
+# BOHB = the TPE model driven under HyperBand halving
+# (pair TPESearcher with schedulers.HyperBandScheduler, per the reference's
+# TuneBOHB + HyperBandForBOHB split).
+BOHBSearcher = TPESearcher
+
+
+def _get(cfg: Dict, path):
+    cur = cfg
+    for p in path:
+        cur = cur[p]
+    return cur

@@ -10,6 +10,7 @@ from ray_tpu.train._checkpoint import Checkpoint
 
 PENDING = "PENDING"
 RUNNING = "RUNNING"
+PAUSED = "PAUSED"          # released its actor; resumable from checkpoint
 TERMINATED = "TERMINATED"
 ERROR = "ERROR"
 

@@ -35,6 +35,9 @@ class TuneConfig:
     max_concurrent_trials: Optional[int] = None
     scheduler: Optional[TrialScheduler] = None
     search_seed: Optional[int] = None
+    # adaptive searcher (e.g. search.TPESearcher); when set, trial configs
+    # are suggested incrementally instead of pre-generated
+    search_alg: Optional[Any] = None
 
     def __post_init__(self):
         if self.mode not in ("max", "min"):
@@ -146,8 +149,14 @@ class Tuner:
 
     def fit(self) -> ResultGrid:
         fn, resources, gang_bundles = self._resolve_trainable()
+        searcher = self._tune_config.search_alg
         if self._restored_trials is not None:
             trials = self._restored_trials
+        elif searcher is not None:
+            searcher.set_objective(self._tune_config.metric or "_none_",
+                                   self._tune_config.mode)
+            searcher.set_search_space(self._param_space)
+            trials = []  # suggested incrementally by the controller
         else:
             variants = BasicVariantGenerator(
                 self._tune_config.search_seed).generate(
@@ -167,6 +176,9 @@ class Tuner:
             gang_strategy=(self._trainable.scaling_config.placement_strategy
                            if isinstance(self._trainable, BaseTrainer)
                            else "PACK"),
+            searcher=searcher if self._restored_trials is None else None,
+            num_samples=self._tune_config.num_samples,
+            trial_resources=dict(resources),
         )
         trials = controller.run()
         return self._to_result_grid(trials, controller)

@@ -36,6 +36,10 @@ class _Session:
         self.id = session_id
         self.namespace = namespace
         self.refs: Dict[str, Any] = {}       # hex -> real ObjectRef (pin)
+        # pin counts: each serialize of a ref to the client mints one client
+        # stub, and each stub GC sends one release — counts must balance or a
+        # duplicate stub (e.g. from wait()) would drop a shared pin early
+        self.pins: Dict[str, int] = {}
         self.actors: Dict[str, Any] = {}     # hex -> real ActorHandle
         self.last_seen = time.monotonic()
 
@@ -80,8 +84,10 @@ class ClientServer:
 
         def id_for(obj):
             if isinstance(obj, ObjectRef):
-                session.refs.setdefault(obj.hex(), obj)
-                return (REF_PID, obj.hex())
+                h = obj.hex()
+                session.refs.setdefault(h, obj)
+                session.pins[h] = session.pins.get(h, 0) + 1
+                return (REF_PID, h)
             if isinstance(obj, ActorHandle):
                 session.actors.setdefault(obj._actor_id.hex(), obj)
                 return (ACTOR_PID, obj._actor_id.hex(),
@@ -136,10 +142,10 @@ class ClientServer:
             return {"exc": blob}
 
     async def cl_ping(self, body):
-        self._session(body)
+        s = self._session(body)
         import ray_tpu
 
-        return {"pong": True, "namespace": self._namespace,
+        return {"pong": True, "namespace": s.namespace,
                 "cluster": ray_tpu.is_initialized()}
 
     async def cl_task(self, body):
@@ -293,7 +299,12 @@ class ClientServer:
         s = self._session_if_exists(body)
         if s is not None:
             for hex_id in body.get("refs", ()):
-                s.refs.pop(hex_id, None)
+                n = s.pins.get(hex_id, 0) - 1
+                if n <= 0:
+                    s.pins.pop(hex_id, None)
+                    s.refs.pop(hex_id, None)
+                else:
+                    s.pins[hex_id] = n
         return {}
 
     async def cl_disconnect(self, body):
@@ -302,6 +313,7 @@ class ClientServer:
             s = self._sessions.pop(sid, None)
         if s:
             s.refs.clear()
+            s.pins.clear()
             s.actors.clear()
         return {}
 
@@ -338,6 +350,7 @@ class ClientServer:
                 for sid in dead:
                     s = self._sessions.pop(sid)
                     s.refs.clear()
+                    s.pins.clear()
                     s.actors.clear()
             if dead:
                 logger.info("reaped %d idle client session(s)", len(dead))
