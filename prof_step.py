@@ -1,0 +1,36 @@
+"""Perf probe for the GPT-2s train step on the local chip.
+
+Usage: python prof_step.py <remat: none|dots|full> <batch> [scan|unroll]
+         [ce_chunk] [trace]
+"""
+import sys, time
+import jax
+from ray_tpu.models import gpt2_small
+from ray_tpu.models.training import OptimizerConfig, init_train_state, make_train_step
+
+mode = sys.argv[1] if len(sys.argv) > 1 else "dots"
+batch = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+scan = (sys.argv[3] != "unroll") if len(sys.argv) > 3 else True
+ce_chunk = int(sys.argv[4]) if len(sys.argv) > 4 else 2048
+kw = dict(remat=False) if mode == "none" else dict(remat_policy=mode)
+cfg = gpt2_small(scan_layers=scan, ce_chunk=ce_chunk, **kw)
+ocfg = OptimizerConfig(warmup_steps=10, decay_steps=1000)
+state, tx = init_train_state(cfg, ocfg, jax.random.PRNGKey(0))
+step = make_train_step(cfg, tx)
+tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, 1024), 0, cfg.vocab_size)
+b = {"tokens": tokens}
+state, m = step(state, b)
+float(m["loss"])
+t0 = time.perf_counter()
+for _ in range(10):
+    state, m = step(state, b)
+float(m["loss"])
+dt = (time.perf_counter() - t0) / 10
+print(f"mode={mode} batch={batch} scan={scan} ce_chunk={ce_chunk} "
+      f"step_ms={dt*1e3:.2f} tok/s={batch*1024/dt:.0f}")
+if "trace" in sys.argv:
+    with jax.profiler.trace("/tmp/jax_trace"):
+        for _ in range(3):
+            state, m = step(state, b)
+        float(m["loss"])
+    print("trace written")
