@@ -11,6 +11,9 @@ from ray_tpu.rllib.algorithms import (DQN, IMPALA, PPO, Algorithm,
                                       IMPALAConfig, PPOConfig)
 from ray_tpu.rllib.core.learner import Learner, LearnerGroup
 from ray_tpu.rllib.core.rl_module import RLModule, RLModuleSpec
+from ray_tpu.rllib.env.multi_agent_env import (MultiAgentEnv,
+                                               MultiAgentEnvRunner,
+                                               MultiAgentEnvRunnerGroup)
 from ray_tpu.rllib.env.single_agent_env_runner import (EnvRunnerGroup,
                                                        SingleAgentEnvRunner)
 
@@ -29,4 +32,7 @@ __all__ = [
     "RLModuleSpec",
     "EnvRunnerGroup",
     "SingleAgentEnvRunner",
+    "MultiAgentEnv",
+    "MultiAgentEnvRunner",
+    "MultiAgentEnvRunnerGroup",
 ]

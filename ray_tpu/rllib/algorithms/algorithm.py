@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import os
 import pickle
+
+import cloudpickle  # configs may hold env factories / mapping lambdas
 import tempfile
 import time
 import uuid
@@ -39,17 +41,39 @@ class Algorithm:
         self.iteration = 0
         self._total_env_steps = 0
         self._start = time.time()
-        self.spec = config.rl_module_spec()
-        self.env_runner_group = EnvRunnerGroup(
-            config.env, self.spec,
-            num_env_runners=config.num_env_runners,
-            num_envs_per_runner=config.num_envs_per_env_runner,
-            seed=config.seed, env_config=config.env_config)
-        self.learner_group = LearnerGroup(
-            self.spec, type(self).loss_fn,
-            optimizer_config={"lr": config.lr,
-                              "grad_clip": config.grad_clip},
-            num_learners=config.num_learners, seed=config.seed)
+        opt_cfg = {"lr": config.lr, "grad_clip": config.grad_clip}
+        if config.is_multi_agent:
+            # one module + learner group per policy; agents batch onto
+            # policies inside the multi-agent runner
+            from ray_tpu.rllib.env.multi_agent_env import (
+                MultiAgentEnvRunnerGroup)
+
+            self.specs = config.multi_rl_module_specs()
+            self.spec = None
+            self.env_runner_group = MultiAgentEnvRunnerGroup(
+                config.env, self.specs, config.policy_mapping_fn,
+                num_env_runners=config.num_env_runners,
+                num_envs_per_runner=config.num_envs_per_env_runner,
+                seed=config.seed)
+            self.learner_groups = {
+                pid: LearnerGroup(spec, type(self).loss_fn,
+                                  optimizer_config=dict(opt_cfg),
+                                  num_learners=config.num_learners,
+                                  seed=config.seed + i)
+                for i, (pid, spec) in enumerate(self.specs.items())}
+            self.learner_group = None
+        else:
+            self.spec = config.rl_module_spec()
+            self.env_runner_group = EnvRunnerGroup(
+                config.env, self.spec,
+                num_env_runners=config.num_env_runners,
+                num_envs_per_runner=config.num_envs_per_env_runner,
+                seed=config.seed, env_config=config.env_config)
+            self.learner_group = LearnerGroup(
+                self.spec, type(self).loss_fn,
+                optimizer_config=opt_cfg,
+                num_learners=config.num_learners, seed=config.seed)
+            self.learner_groups = None
         self._sync_weights()
 
     # ------------------------------------------------------------ interface
@@ -84,12 +108,22 @@ class Algorithm:
 
     def stop(self) -> None:
         self.env_runner_group.stop()
-        self.learner_group.shutdown()
+        if self.learner_groups is not None:
+            for lg in self.learner_groups.values():
+                lg.shutdown()
+        else:
+            self.learner_group.shutdown()
 
     # ----------------------------------------------------------- weights
 
     def _sync_weights(self) -> None:
-        self.env_runner_group.set_weights(self.learner_group.get_weights())
+        if self.learner_groups is not None:
+            self.env_runner_group.set_weights(
+                {pid: lg.get_weights()
+                 for pid, lg in self.learner_groups.items()})
+        else:
+            self.env_runner_group.set_weights(
+                self.learner_group.get_weights())
 
     # -------------------------------------------------------- checkpointing
 
@@ -102,8 +136,12 @@ class Algorithm:
         pass
 
     def get_state(self) -> Dict[str, Any]:
+        learner = (
+            {pid: lg.get_state() for pid, lg in self.learner_groups.items()}
+            if self.learner_groups is not None
+            else self.learner_group.get_state())
         return {
-            "learner": self.learner_group.get_state(),
+            "learner": learner,
             "iteration": self.iteration,
             "total_env_steps": self._total_env_steps,
             "config": self.config.to_dict(),
@@ -112,7 +150,11 @@ class Algorithm:
         }
 
     def set_state(self, state: Dict[str, Any]) -> None:
-        self.learner_group.set_state(state["learner"])
+        if self.learner_groups is not None:
+            for pid, lg in self.learner_groups.items():
+                lg.set_state(state["learner"][pid])
+        else:
+            self.learner_group.set_state(state["learner"])
         self.iteration = state["iteration"]
         self._total_env_steps = state["total_env_steps"]
         self._set_extra_state(state.get("extra", {}))
@@ -123,7 +165,7 @@ class Algorithm:
             tempfile.gettempdir(), f"algo_ckpt_{uuid.uuid4().hex[:12]}")
         os.makedirs(path, exist_ok=True)
         with open(os.path.join(path, "algorithm_state.pkl"), "wb") as f:
-            pickle.dump(self.get_state(), f)
+            cloudpickle.dump(self.get_state(), f)
         return Checkpoint(path)
 
     # alias matching the reference's Trainable surface

@@ -37,6 +37,11 @@ class AlgorithmConfig:
         self.model: Dict[str, Any] = {"hiddens": (64, 64)}
         # debugging
         self.seed: int = 0
+        # multi-agent (reference AlgorithmConfig.multi_agent()): policies
+        # maps policy_id -> RLModuleSpec kwargs (obs_dim, num_actions,
+        # hiddens); env must then be a MultiAgentEnv factory/class
+        self.policies: Optional[Dict[str, Dict[str, Any]]] = None
+        self.policy_mapping_fn: Optional[Any] = None
 
     # ------------------------------------------------------- fluent setters
 
@@ -76,6 +81,23 @@ class AlgorithmConfig:
 
     def debugging(self, *, seed: Optional[int] = None) -> "AlgorithmConfig":
         return self._apply(dict(seed=seed))
+
+    def multi_agent(self, *, policies: Optional[Dict[str, Dict[str, Any]]]
+                    = None, policy_mapping_fn=None) -> "AlgorithmConfig":
+        """≈ reference `AlgorithmConfig.multi_agent()`. `policies` maps
+        policy_id -> RLModuleSpec kwargs; `policy_mapping_fn(agent_id) ->
+        policy_id`. The env (set via .environment) must be a MultiAgentEnv
+        class or zero-arg factory."""
+        return self._apply(dict(policies=policies,
+                                policy_mapping_fn=policy_mapping_fn))
+
+    @property
+    def is_multi_agent(self) -> bool:
+        return bool(self.policies)
+
+    def multi_rl_module_specs(self) -> Dict[str, RLModuleSpec]:
+        assert self.policies, "call .multi_agent(policies=...) first"
+        return {pid: RLModuleSpec(**kw) for pid, kw in self.policies.items()}
 
     # ------------------------------------------------------------- building
 

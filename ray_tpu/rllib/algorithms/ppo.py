@@ -36,6 +36,9 @@ class PPOConfig(AlgorithmConfig):
 class PPO(Algorithm):
     def __init__(self, config: PPOConfig):
         super().__init__(config)
+        if config.is_multi_agent:
+            self._kl_coeffs = {pid: float(config.kl_coeff)
+                               for pid in self.specs}
         self._kl_coeff = float(config.kl_coeff)
 
     @classmethod
@@ -80,6 +83,8 @@ class PPO(Algorithm):
                        "entropy": entropy, "mean_kl": kl}
 
     def training_step(self) -> Dict[str, Any]:
+        if self.config.is_multi_agent:
+            return self._multi_agent_training_step()
         cfg: PPOConfig = self.config
         samples = self.env_runner_group.sample(cfg.rollout_fragment_length)
         batch_tm = self._merge_time_major(samples)
@@ -133,11 +138,71 @@ class PPO(Algorithm):
         last_metrics["kl_coeff"] = self._kl_coeff
         return last_metrics
 
+    def _multi_agent_training_step(self) -> Dict[str, Any]:
+        """Per-policy GAE + clipped-surrogate epochs; each policy trains on
+        the batch its agents produced (reference: `MultiAgentBatch` routed
+        to per-module learners)."""
+        cfg: PPOConfig = self.config
+        samples = self.env_runner_group.sample(cfg.rollout_fragment_length)
+        loss_cfg = {
+            "clip_param": cfg.clip_param,
+            "vf_clip_param": cfg.vf_clip_param,
+            "vf_loss_coeff": cfg.vf_loss_coeff,
+            "entropy_coeff": cfg.entropy_coeff,
+        }
+        rng = np.random.default_rng(cfg.seed + self.iteration)
+        result: Dict[str, Any] = {}
+        # env steps, not agent-steps: every env column appears once per
+        # policy it feeds, so count envs x T directly
+        self._total_env_steps += (cfg.rollout_fragment_length
+                                  * cfg.num_envs_per_env_runner
+                                  * max(1, cfg.num_env_runners))
+        for pid, lg in self.learner_groups.items():
+            batch_tm = self._merge_time_major([s[pid] for s in samples])
+            T, B = batch_tm["rewards"].shape
+            adv, targets = compute_gae(
+                batch_tm["rewards"], batch_tm["values"],
+                batch_tm["bootstrap_value"], batch_tm["terminateds"],
+                batch_tm["truncateds"], gamma=cfg.gamma, lam=cfg.lam)
+            flat = {
+                "obs": batch_tm["obs"].reshape(
+                    (T * B,) + batch_tm["obs"].shape[2:]),
+                "actions": batch_tm["actions"].reshape(T * B),
+                "logp": batch_tm["logp"].reshape(T * B),
+                "values": batch_tm["values"].reshape(T * B),
+                "advantages": np.asarray(adv).reshape(T * B),
+                "value_targets": np.asarray(targets).reshape(T * B),
+            }
+            n = T * B
+            mb = min(cfg.minibatch_size, n)
+            last: Dict[str, float] = {}
+            for _ in range(cfg.num_epochs):
+                perm = rng.permutation(n)
+                for lo in range(0, n - mb + 1, mb):
+                    idx = perm[lo:lo + mb]
+                    minibatch = {k: v[idx] for k, v in flat.items()}
+                    minibatch["kl_coeff"] = np.full(
+                        len(idx), self._kl_coeffs[pid], np.float32)
+                    last = lg.update_from_batch(minibatch, loss_cfg)
+            kl = last.get("mean_kl", 0.0)
+            if kl > 2.0 * cfg.kl_target:
+                self._kl_coeffs[pid] *= 1.5
+            elif kl < 0.5 * cfg.kl_target:
+                self._kl_coeffs[pid] *= 0.5
+            for k, v in last.items():
+                result[f"{pid}/{k}"] = v
+        self._sync_weights()
+        return result
+
     def _extra_state(self):
+        if self.config.is_multi_agent:
+            return {"kl_coeffs": dict(self._kl_coeffs)}
         return {"kl_coeff": self._kl_coeff}
 
     def _set_extra_state(self, extra):
         self._kl_coeff = float(extra.get("kl_coeff", self._kl_coeff))
+        if self.config.is_multi_agent and "kl_coeffs" in extra:
+            self._kl_coeffs.update(extra["kl_coeffs"])
 
 
 PPOConfig.algo_class = PPO

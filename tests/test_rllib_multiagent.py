@@ -1,0 +1,145 @@
+"""Multi-agent RLlib: MultiAgentEnv protocol, per-policy batching in the
+runner, and PPO training independent policies end-to-end (mirrors the
+reference's `rllib/env/tests/test_multi_agent_env.py` +
+multi-agent PPO learning tests)."""
+
+import numpy as np
+import pytest
+
+
+class TestTargetMatchEnv:
+    def test_protocol(self):
+        from ray_tpu.rllib.env.multi_agent_env import TargetMatchEnv
+
+        env = TargetMatchEnv(num_agents=2, num_targets=3, episode_len=4)
+        obs, _ = env.reset(seed=0)
+        assert set(obs) == {"agent_0", "agent_1"}
+        assert obs["agent_0"].shape == (3,)
+        for t in range(4):
+            actions = {a: 0 for a in env.possible_agents}
+            obs, rew, term, trunc, _ = env.step(actions)
+            assert set(rew) == {"agent_0", "agent_1"}
+        assert term["__all__"]
+
+    def test_rewards_follow_per_agent_mapping(self):
+        from ray_tpu.rllib.env.multi_agent_env import TargetMatchEnv
+
+        env = TargetMatchEnv(num_agents=2, num_targets=4, episode_len=100)
+        obs, _ = env.reset(seed=1)
+        hits = {a: 0 for a in env.possible_agents}
+        for _ in range(50):
+            # play each agent's optimal mapping: action = (target + i) % n
+            actions = {}
+            for i, a in enumerate(env.possible_agents):
+                target = int(np.argmax(obs[a]))
+                actions[a] = (target + i) % 4
+            obs, rew, term, trunc, _ = env.step(actions)
+            for a in env.possible_agents:
+                hits[a] += rew[a]
+        assert all(h == 50 for h in hits.values()), hits
+
+
+class TestMultiAgentRunner:
+    def test_per_policy_batches(self):
+        from ray_tpu.rllib.core.rl_module import RLModuleSpec
+        from ray_tpu.rllib.env.multi_agent_env import (MultiAgentEnvRunner,
+                                                       TargetMatchEnv)
+
+        specs = {"p0": RLModuleSpec(obs_dim=4, num_actions=4,
+                                    hiddens=(16,)),
+                 "p1": RLModuleSpec(obs_dim=4, num_actions=4,
+                                    hiddens=(16,))}
+        runner = MultiAgentEnvRunner(
+            lambda: TargetMatchEnv(num_agents=2, num_targets=4,
+                                   episode_len=8),
+            specs, lambda aid: "p0" if aid == "agent_0" else "p1",
+            num_envs=3, seed=0)
+        out = runner.sample(10)
+        assert set(out) == {"p0", "p1"}
+        for pid in ("p0", "p1"):
+            b = out[pid]
+            assert b["obs"].shape == (10, 3, 4)      # T, n_envs*1 agent, d
+            assert b["rewards"].shape == (10, 3)
+            assert b["bootstrap_value"].shape == (3,)
+        m = runner.get_metrics()
+        assert m["num_episodes"] >= 2
+        runner.stop()
+
+    def test_unknown_policy_rejected(self):
+        from ray_tpu.rllib.core.rl_module import RLModuleSpec
+        from ray_tpu.rllib.env.multi_agent_env import (MultiAgentEnvRunner,
+                                                       TargetMatchEnv)
+
+        with pytest.raises(ValueError, match="unknown"):
+            MultiAgentEnvRunner(
+                lambda: TargetMatchEnv(), {"p0": RLModuleSpec(4, 4)},
+                lambda aid: "nope", num_envs=1)
+
+
+class TestMultiAgentPPO:
+    def test_independent_policies_learn(self, ray_init):
+        """Two policies with different optimal mappings must BOTH learn:
+        total episode return approaches 2 agents x 16 steps = 32."""
+        from ray_tpu.rllib.algorithms.ppo import PPOConfig
+        from ray_tpu.rllib.env.multi_agent_env import TargetMatchEnv
+
+        spec_kw = {"obs_dim": 4, "num_actions": 4, "hiddens": (32, 32)}
+        config = (
+            PPOConfig()
+            .environment(env=lambda: TargetMatchEnv(
+                num_agents=2, num_targets=4, episode_len=16))
+            .multi_agent(
+                policies={"p0": dict(spec_kw), "p1": dict(spec_kw)},
+                policy_mapping_fn=lambda aid: ("p0" if aid == "agent_0"
+                                               else "p1"))
+            .env_runners(num_envs_per_env_runner=8,
+                         rollout_fragment_length=64)
+            .training(lr=3e-3, num_epochs=4, minibatch_size=256,
+                      entropy_coeff=0.01)
+            .debugging(seed=0))
+        algo = config.build()
+        best = -np.inf
+        for i in range(25):
+            result = algo.train()
+            r = result.get("episode_return_mean")
+            if r is not None:
+                best = max(best, r)
+            if best >= 28.0:
+                break
+        algo.stop()
+        assert best >= 28.0, f"multi-agent PPO failed to learn: best={best}"
+        assert any(k.startswith("p0/") for k in result)
+        assert any(k.startswith("p1/") for k in result)
+
+    def test_checkpoint_roundtrip(self, ray_init, tmp_path):
+        from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+        from ray_tpu.rllib.env.multi_agent_env import TargetMatchEnv
+
+        spec_kw = {"obs_dim": 4, "num_actions": 4, "hiddens": (16,)}
+        config = (
+            PPOConfig()
+            .environment(env=lambda: TargetMatchEnv(num_agents=2))
+            .multi_agent(
+                policies={"p0": dict(spec_kw), "p1": dict(spec_kw)},
+                policy_mapping_fn=lambda aid: ("p0" if aid == "agent_0"
+                                               else "p1"))
+            .env_runners(num_envs_per_env_runner=2,
+                         rollout_fragment_length=16)
+            .debugging(seed=0))
+        algo = config.build()
+        algo.train()
+        ckpt = algo.save_to_checkpoint(str(tmp_path / "ma_ckpt"))
+        state = algo.get_state()
+        algo.stop()
+
+        algo2 = config.build()
+        algo2.restore_from_checkpoint(ckpt)
+        s2 = algo2.get_state()
+        assert s2["iteration"] == state["iteration"]
+        w1 = state["learner"]["p0"]["params"]
+        w2 = s2["learner"]["p0"]["params"]
+        import jax
+
+        jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, b),
+                     w1, w2)
+        algo2.stop()
