@@ -15,8 +15,12 @@ from ray_tpu.data.dataset import (  # noqa: F401
     range_tensor,
     read_binary_files,
     read_csv,
+    read_images,
     read_json,
     read_numpy,
     read_parquet,
+    read_sql,
+    read_tfrecords,
+    read_webdataset,
 )
 from ray_tpu.data.iterator import DataIterator  # noqa: F401

@@ -327,6 +327,9 @@ class Dataset:
     def write_json(self, path: str) -> List[str]:
         return self._write(path, "json")
 
+    def write_tfrecords(self, path: str) -> List[str]:
+        return self._write(path, "tfrecords")
+
     def _write(self, path: str, fmt: str) -> List[str]:
         from ray_tpu.data.datasource import write_block
 
@@ -522,3 +525,38 @@ def read_binary_files(paths, **_kw) -> Dataset:
 
     return Dataset([L.Read(read_tasks=binary_tasks(paths),
                            datasource_name="binary")])
+
+
+def read_tfrecords(paths, **_kw) -> Dataset:
+    """TFRecord files of tf.train.Example protos (pure-python parser —
+    no tensorflow/protobuf dependency). ≈ `ray.data.read_tfrecords`."""
+    from ray_tpu.data.datasource import tfrecord_tasks
+
+    return Dataset([L.Read(read_tasks=tfrecord_tasks(paths),
+                           datasource_name="tfrecords")])
+
+
+def read_images(paths, *, size=None, mode=None, **_kw) -> Dataset:
+    """Image files -> {"image": [H,W,C], "path"} rows (PIL decode).
+    ≈ `ray.data.read_images`."""
+    from ray_tpu.data.datasource import image_tasks
+
+    return Dataset([L.Read(read_tasks=image_tasks(paths, size, mode),
+                           datasource_name="images")])
+
+
+def read_webdataset(paths, **_kw) -> Dataset:
+    """WebDataset tar shards: one row per sample key, one column per member
+    extension. ≈ `ray.data.read_webdataset`."""
+    from ray_tpu.data.datasource import webdataset_tasks
+
+    return Dataset([L.Read(read_tasks=webdataset_tasks(paths),
+                           datasource_name="webdataset")])
+
+
+def read_sql(sql: str, connection_factory, **_kw) -> Dataset:
+    """DBAPI-2 query -> dataset. ≈ `ray.data.read_sql`."""
+    from ray_tpu.data.datasource import sql_tasks
+
+    return Dataset([L.Read(read_tasks=sql_tasks(sql, connection_factory),
+                           datasource_name="sql")])

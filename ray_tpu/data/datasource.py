@@ -152,6 +152,339 @@ def write_block(block: Block, path: str, index: int, fmt: str) -> str:
         pacsv.write_csv(block, f)
     elif fmt == "json":
         block.to_pandas().to_json(f, orient="records", lines=True)
+    elif fmt == "tfrecords":
+        return write_tfrecords_block(block, path, index)
     else:
         raise ValueError(f"unknown write format {fmt}")
     return f
+
+
+# ------------------------------------------------- tfrecord (pure python)
+# Wire format (tensorflow/core/lib/io/record_writer.cc):
+#   [u64 length][u32 masked_crc32c(length)][data][u32 masked_crc32c(data)]
+# Payloads are tf.train.Example protos; a minimal protobuf wire parser below
+# decodes bytes_list/float_list/int64_list features without the protobuf
+# runtime (the environment does not pin tensorflow).
+
+_CRC_TABLE = None
+
+
+def _crc32c(data: bytes) -> int:
+    global _CRC_TABLE
+    if _CRC_TABLE is None:
+        poly = 0x82F63B78
+        table = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            table.append(c)
+        _CRC_TABLE = table
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+
+
+def _read_varint(buf: bytes, pos: int):
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _parse_proto_fields(buf: bytes):
+    """Yield (field_number, wire_type, value) over a proto message."""
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:          # varint
+            val, pos = _read_varint(buf, pos)
+        elif wire == 1:        # 64-bit
+            val, pos = buf[pos:pos + 8], pos + 8
+        elif wire == 2:        # length-delimited
+            n, pos = _read_varint(buf, pos)
+            val, pos = buf[pos:pos + n], pos + n
+        elif wire == 5:        # 32-bit
+            val, pos = buf[pos:pos + 4], pos + 4
+        else:
+            raise ValueError(f"unsupported proto wire type {wire}")
+        yield field, wire, val
+
+
+def _to_int64(x: int) -> int:
+    return x - (1 << 64) if x >= 1 << 63 else x
+
+
+def _parse_example(buf: bytes):
+    """tf.train.Example -> {name: list}. Example{features=1} ->
+    Features{feature=1 map<string,Feature>} -> Feature{bytes_list=1,
+    float_list=2, int64_list=3}, each a packed/repeated list field 1."""
+    import struct as _struct
+
+    out = {}
+    for f, _, features in _parse_proto_fields(buf):
+        if f != 1:
+            continue
+        for ff, _, entry in _parse_proto_fields(features):
+            if ff != 1:
+                continue
+            name, feature = None, b""
+            for ef, _, v in _parse_proto_fields(entry):
+                if ef == 1:
+                    name = v.decode()
+                elif ef == 2:
+                    feature = v
+            if name is None:
+                continue
+            values: List = []
+            for tf_, _, lst in _parse_proto_fields(feature):
+                for lf, lw, lv in _parse_proto_fields(lst):
+                    if lf != 1:
+                        continue
+                    if tf_ == 1:                  # bytes_list
+                        values.append(lv)
+                    elif tf_ == 2:                # float_list
+                        if lw == 2:               # packed
+                            values.extend(_struct.unpack(
+                                f"<{len(lv) // 4}f", lv))
+                        else:
+                            values.append(_struct.unpack("<f", lv)[0])
+                    elif tf_ == 3:                # int64_list
+                        if lw == 2:
+                            pos = 0
+                            while pos < len(lv):
+                                x, pos = _read_varint(lv, pos)
+                                values.append(_to_int64(x))
+                        else:
+                            values.append(lv)
+            out[name] = values
+    return out
+
+
+def iter_tfrecords(path: str):
+    """Yield raw record payloads from one TFRecord file (CRCs skipped on
+    read, verified lengths only)."""
+    import struct as _struct
+
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(12)
+            if len(header) < 12:
+                return
+            (length,), _crc = _struct.unpack("<Q", header[:8]), header[8:]
+            data = f.read(length)
+            f.read(4)  # data crc
+            if len(data) < length:
+                return
+            yield data
+
+
+def tfrecord_tasks(paths) -> List[Callable[[], Block]]:
+    files = _expand_paths(paths)
+
+    def make(f):
+        def task():
+            rows = []
+            for payload in iter_tfrecords(f):
+                ex = _parse_example(payload)
+                row = {}
+                for k, vals in ex.items():
+                    row[k] = vals[0] if len(vals) == 1 else vals
+                rows.append(row)
+            if not rows:
+                return pa.table({})
+            keys = sorted({k for r in rows for k in r})
+            return batch_to_block({k: [r.get(k) for r in rows]
+                                   for k in keys})
+
+        return task
+
+    return [make(f) for f in files]
+
+
+def _encode_varint(x: int) -> bytes:
+    if x < 0:
+        x += 1 << 64  # proto int64: two's-complement as unsigned varint
+    out = bytearray()
+    while True:
+        b = x & 0x7F
+        x >>= 7
+        if x:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _encode_field(field: int, wire: int, payload: bytes) -> bytes:
+    return _encode_varint((field << 3) | wire) + payload
+
+
+def _encode_example(row: Dict[str, Any]) -> bytes:
+    """Encode one row-dict as a tf.train.Example proto."""
+    import struct as _struct
+
+    entries = b""
+    for name, value in row.items():
+        vals = value if isinstance(value, (list, tuple, np.ndarray)) else [
+            value]
+        if len(vals) and isinstance(vals[0], (bytes, str)):
+            items = b"".join(
+                _encode_field(1, 2, _encode_varint(len(v)) + v)
+                for v in ((x.encode() if isinstance(x, str) else x)
+                          for x in vals))
+            feature = _encode_field(1, 2, _encode_varint(len(items)) + items)
+        elif len(vals) and isinstance(vals[0], (float, np.floating)):
+            packed = _struct.pack(f"<{len(vals)}f", *[float(v)
+                                                      for v in vals])
+            lst = _encode_field(1, 2, _encode_varint(len(packed)) + packed)
+            feature = _encode_field(2, 2, _encode_varint(len(lst)) + lst)
+        else:
+            packed = b"".join(_encode_varint(int(v)) for v in vals)
+            lst = _encode_field(1, 2, _encode_varint(len(packed)) + packed)
+            feature = _encode_field(3, 2, _encode_varint(len(lst)) + lst)
+        entry = (_encode_field(1, 2, _encode_varint(len(name.encode()))
+                               + name.encode())
+                 + _encode_field(2, 2, _encode_varint(len(feature))
+                                 + feature))
+        entries += _encode_field(1, 2, _encode_varint(len(entry)) + entry)
+    return _encode_field(1, 2, _encode_varint(len(entries)) + entries)
+
+
+def write_tfrecords_block(block: Block, path: str, index: int) -> str:
+    import struct as _struct
+
+    os.makedirs(path, exist_ok=True)
+    f = os.path.join(path, f"{index:06d}.tfrecords")
+    rows = block.to_pylist()
+    with open(f, "wb") as fh:
+        for row in rows:
+            payload = _encode_example(row)
+            header = _struct.pack("<Q", len(payload))
+            fh.write(header)
+            fh.write(_struct.pack("<I", _masked_crc(header)))
+            fh.write(payload)
+            fh.write(_struct.pack("<I", _masked_crc(payload)))
+    return f
+
+
+# ------------------------------------------------------------------ images
+
+
+def image_tasks(paths, size=None, mode: Optional[str] = None
+                ) -> List[Callable[[], Block]]:
+    """One block per file; columns: image ([H,W,C] nested list), path.
+    `size=(h, w)` resizes (required when mixing image sizes into one
+    batch); `mode` forces a PIL conversion (e.g. "RGB", "L")."""
+    exts = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
+    files = [f for f in _expand_paths(paths)
+             if f.lower().endswith(exts)]
+    if not files:
+        raise FileNotFoundError(f"no image files in {paths!r}")
+
+    def make(f):
+        def task():
+            from PIL import Image
+
+            img = Image.open(f)
+            if mode:
+                img = img.convert(mode)
+            if size is not None:
+                img = img.resize((size[1], size[0]))
+            arr = np.asarray(img)
+            return batch_to_block({"image": arr[None], "path": [f]})
+
+        return task
+
+    return [make(f) for f in files]
+
+
+# ------------------------------------------------------------- webdataset
+
+
+def webdataset_tasks(paths) -> List[Callable[[], Block]]:
+    """WebDataset-style tar shards: members grouped by basename stem form
+    one sample; columns are the extensions ("jpg" decoded to arrays, "txt"/
+    "cls" to str/int, "json" parsed, anything else raw bytes)."""
+    files = _expand_paths(paths, ".tar")
+
+    def _decode(ext: str, payload: bytes):
+        if ext in ("jpg", "jpeg", "png", "bmp", "webp"):
+            import io as _io
+
+            from PIL import Image
+
+            return np.asarray(Image.open(_io.BytesIO(payload)))
+        if ext in ("txt", "text"):
+            return payload.decode()
+        if ext == "cls":
+            return int(payload.decode().strip())
+        if ext == "json":
+            import json as _json
+
+            return _json.loads(payload.decode())
+        return payload
+
+    def make(f):
+        def task():
+            import tarfile
+
+            samples: Dict[str, Dict[str, Any]] = {}
+            order: List[str] = []
+            with tarfile.open(f) as tar:
+                for m in tar.getmembers():
+                    if not m.isfile():
+                        continue
+                    base = os.path.basename(m.name)
+                    stem, _, ext = base.partition(".")
+                    if stem not in samples:
+                        samples[stem] = {"__key__": stem}
+                        order.append(stem)
+                    payload = tar.extractfile(m).read()
+                    samples[stem][ext.lower()] = _decode(
+                        ext.lower(), payload)
+            rows = [samples[k] for k in order]
+            keys = sorted({k for r in rows for k in r})
+            return batch_to_block({k: [r.get(k) for r in rows]
+                                   for k in keys})
+
+        return task
+
+    return [make(f) for f in files]
+
+
+# ------------------------------------------------------------------- sql
+
+
+def sql_tasks(sql: str, connection_factory: Callable[[], Any]
+              ) -> List[Callable[[], Block]]:
+    """DBAPI-2 source (reference `read_sql`): one task runs the query and
+    converts the cursor to a block. `connection_factory` must be picklable
+    (e.g. `lambda: sqlite3.connect(path)`)."""
+
+    def task():
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()
+            cur.execute(sql)
+            names = [d[0] for d in cur.description]
+            rows = cur.fetchall()
+        finally:
+            conn.close()
+        if not rows:
+            return pa.table({n: [] for n in names})
+        cols = {n: [r[i] for r in rows] for i, n in enumerate(names)}
+        return batch_to_block(cols)
+
+    return [task]

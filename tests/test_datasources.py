@@ -1,0 +1,171 @@
+"""New datasources: TFRecord round-trip (pure-python wire format), images
+(PIL), webdataset tar shards, and DBAPI SQL. Mirrors the reference's
+`python/ray/data/tests/test_{tfrecords,image,webdataset,sql}.py` shape."""
+
+import os
+import sqlite3
+import tarfile
+
+import numpy as np
+import pytest
+
+
+class TestTFRecords:
+    def test_roundtrip(self, ray_init, tmp_path):
+        from ray_tpu import data
+
+        ds = data.from_items([
+            {"idx": i, "score": float(i) / 3.0, "name": f"row{i}".encode()}
+            for i in range(20)])
+        out = str(tmp_path / "tfr")
+        files = ds.write_tfrecords(out)
+        assert files and all(f.endswith(".tfrecords") for f in files)
+
+        back = data.read_tfrecords(out)
+        rows = sorted(back.take_all(), key=lambda r: r["idx"])
+        assert len(rows) == 20
+        assert rows[3]["idx"] == 3
+        assert abs(rows[3]["score"] - 1.0) < 1e-6
+        assert rows[3]["name"] == b"row3"
+
+    def test_wire_format_crc_present(self, ray_init, tmp_path):
+        """Each record is framed [len u64][crc u32][data][crc u32]."""
+        import struct
+
+        from ray_tpu import data
+        from ray_tpu.data.datasource import _masked_crc
+
+        ds = data.from_items([{"a": 1}])
+        f = ds.write_tfrecords(str(tmp_path / "one"))[0]
+        raw = open(f, "rb").read()
+        (length,) = struct.unpack("<Q", raw[:8])
+        (len_crc,) = struct.unpack("<I", raw[8:12])
+        assert len_crc == _masked_crc(raw[:8])
+        payload = raw[12:12 + length]
+        (data_crc,) = struct.unpack("<I", raw[12 + length:16 + length])
+        assert data_crc == _masked_crc(payload)
+
+    def test_vector_features(self, ray_init, tmp_path):
+        from ray_tpu import data
+
+        ds = data.from_items([{"vec": [1.5, 2.5, 3.5], "ids": [7, 8]}])
+        out = str(tmp_path / "vec")
+        ds.write_tfrecords(out)
+        row = data.read_tfrecords(out).take_all()[0]
+        np.testing.assert_allclose(row["vec"], [1.5, 2.5, 3.5], atol=1e-6)
+        assert list(row["ids"]) == [7, 8]
+
+
+class TestImages:
+    def _make_images(self, tmp_path, n=3, size=(16, 12)):
+        from PIL import Image
+
+        paths = []
+        for i in range(n):
+            arr = np.full((size[0], size[1], 3), i * 20, np.uint8)
+            p = str(tmp_path / f"img_{i}.png")
+            Image.fromarray(arr).save(p)
+            paths.append(p)
+        return paths
+
+    def test_read_images(self, ray_init, tmp_path):
+        from ray_tpu import data
+
+        self._make_images(tmp_path)
+        ds = data.read_images(str(tmp_path))
+        rows = ds.take_all()
+        assert len(rows) == 3
+        img = np.asarray(rows[0]["image"])
+        assert img.shape == (16, 12, 3)
+
+    def test_resize_and_mode(self, ray_init, tmp_path):
+        from ray_tpu import data
+
+        self._make_images(tmp_path)
+        ds = data.read_images(str(tmp_path), size=(8, 8), mode="L")
+        img = np.asarray(ds.take_all()[0]["image"])
+        assert img.shape == (8, 8)
+
+
+class TestWebDataset:
+    def test_tar_samples(self, ray_init, tmp_path):
+        import io
+        import json
+
+        from PIL import Image
+
+        from ray_tpu import data
+
+        tar_path = str(tmp_path / "shard-000.tar")
+        with tarfile.open(tar_path, "w") as tar:
+            for i in range(4):
+                img = Image.fromarray(
+                    np.full((8, 8, 3), i, np.uint8))
+                buf = io.BytesIO()
+                img.save(buf, format="PNG")
+
+                def add(name, payload):
+                    info = tarfile.TarInfo(name)
+                    info.size = len(payload)
+                    tar.addfile(info, io.BytesIO(payload))
+
+                add(f"sample{i}.png", buf.getvalue())
+                add(f"sample{i}.cls", str(i % 2).encode())
+                add(f"sample{i}.json",
+                    json.dumps({"meta": i}).encode())
+
+        ds = data.read_webdataset(tar_path)
+        rows = sorted(ds.take_all(), key=lambda r: r["__key__"])
+        assert len(rows) == 4
+        assert rows[1]["__key__"] == "sample1"
+        assert rows[1]["cls"] == 1
+        assert rows[1]["json"]["meta"] == 1
+        assert np.asarray(rows[1]["png"]).shape == (8, 8, 3)
+
+
+class TestSQL:
+    def test_read_sql_sqlite(self, ray_init, tmp_path):
+        from ray_tpu import data
+
+        db = str(tmp_path / "t.db")
+        conn = sqlite3.connect(db)
+        conn.execute("CREATE TABLE users (id INTEGER, name TEXT, score REAL)")
+        conn.executemany("INSERT INTO users VALUES (?, ?, ?)",
+                         [(i, f"u{i}", i * 1.5) for i in range(10)])
+        conn.commit()
+        conn.close()
+
+        ds = data.read_sql("SELECT * FROM users WHERE id >= 4",
+                           lambda: sqlite3.connect(db))
+        rows = sorted(ds.take_all(), key=lambda r: r["id"])
+        assert len(rows) == 6
+        assert rows[0] == {"id": 4, "name": "u4", "score": 6.0}
+
+    def test_aggregate_query(self, ray_init, tmp_path):
+        from ray_tpu import data
+
+        db = str(tmp_path / "agg.db")
+        conn = sqlite3.connect(db)
+        conn.execute("CREATE TABLE pts (grp TEXT, v REAL)")
+        conn.executemany("INSERT INTO pts VALUES (?, ?)",
+                         [("a", 1.0), ("a", 3.0), ("b", 10.0)])
+        conn.commit()
+        conn.close()
+        ds = data.read_sql(
+            "SELECT grp, AVG(v) AS mean_v FROM pts GROUP BY grp",
+            lambda: sqlite3.connect(db))
+        rows = {r["grp"]: r["mean_v"] for r in ds.take_all()}
+        assert rows == {"a": 2.0, "b": 10.0}
+
+
+def test_negative_int_roundtrip(ray_init, tmp_path):
+    """Negative int64 features must round-trip (proto two's-complement
+    varints), not hang the writer or decode as huge positives."""
+    from ray_tpu import data
+
+    ds = data.from_items([{"a": -1, "b": -123456789}])
+    out = str(tmp_path / "neg")
+    ds.write_tfrecords(out)
+    row = data.read_tfrecords(out).take_all()[0]
+    assert row["a"] == -1
+    assert row["b"] == -123456789
