@@ -6,9 +6,10 @@ EnvRunner/EnvRunnerGroup (sampling actors) + Algorithm drivers
 architecture mapping to the reference.
 """
 
-from ray_tpu.rllib.algorithms import (DQN, IMPALA, PPO, Algorithm,
-                                      AlgorithmConfig, DQNConfig,
-                                      IMPALAConfig, PPOConfig)
+from ray_tpu.rllib.algorithms import (BC, DQN, IMPALA, MARWIL, PPO, SAC,
+                                      Algorithm, AlgorithmConfig, BCConfig,
+                                      DQNConfig, IMPALAConfig, MARWILConfig,
+                                      PPOConfig, SACConfig)
 from ray_tpu.rllib.core.learner import Learner, LearnerGroup
 from ray_tpu.rllib.core.rl_module import RLModule, RLModuleSpec
 from ray_tpu.rllib.env.multi_agent_env import (MultiAgentEnv,
@@ -26,6 +27,12 @@ __all__ = [
     "IMPALAConfig",
     "DQN",
     "DQNConfig",
+    "SAC",
+    "SACConfig",
+    "BC",
+    "BCConfig",
+    "MARWIL",
+    "MARWILConfig",
     "Learner",
     "LearnerGroup",
     "RLModule",

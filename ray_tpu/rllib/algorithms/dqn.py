@@ -89,9 +89,9 @@ class DQN(Algorithm):
             module = self.learner_group._local.module \
                 if self.learner_group.is_local else None
             if module is None:
-                from ray_tpu.rllib.core.rl_module import RLModule
+                from ray_tpu.rllib.core.rl_module import make_module
 
-                module = RLModule(self.spec)
+                module = make_module(self.spec)
 
             def targets(online_params, target_params, next_obs, rewards,
                         dones):
@@ -198,9 +198,9 @@ class DQN(Algorithm):
         import jax
 
         if self._fwd_fn is None:
-            from ray_tpu.rllib.core.rl_module import RLModule
+            from ray_tpu.rllib.core.rl_module import make_module
 
-            self._fwd_fn = jax.jit(RLModule(self.spec).forward_train)
+            self._fwd_fn = jax.jit(make_module(self.spec).forward_train)
         q, v = self._fwd_fn(self.learner_group.get_weights(), obs)
         return np.asarray(q), np.asarray(v)
 

@@ -1,0 +1,418 @@
+"""SAC — Soft Actor-Critic for continuous control.
+
+Analog of `rllib/algorithms/sac/sac.py` (+ `sac_learner` losses) on the
+new-stack split, TPU-first: one params pytree (squashed-Gaussian actor,
+twin Q critics, log-alpha) trains under ONE jitted combined loss —
+stop-gradients route each term to its own weights, and the actor's
+reparameterized sample rides pre-drawn normal noise inside the batch so
+the Learner stays a pure (batch) -> (loss) machine. TD targets use
+driver-held polyak-averaged target critics, computed in a second jitted
+program (the DQN pattern at `dqn.py`).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner import LearnerGroup
+from ray_tpu.rllib.core.rl_module import RLModuleSpec, _init_linear
+from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
+
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+
+class SACModule:
+    """Continuous actor-critic: tanh-squashed Gaussian policy +
+    twin Q(s, a) heads. `spec.num_actions` is the action dimension."""
+
+    def __init__(self, spec: RLModuleSpec):
+        self.spec = spec
+
+    # -------------------------------------------------------------- params
+
+    def _mlp_params(self, key, sizes):
+        import jax
+
+        keys = jax.random.split(key, len(sizes) - 1)
+        return [_init_linear(k, sizes[i], sizes[i + 1],
+                             scale=1.0 if i < len(sizes) - 2 else 0.01)
+                for i, k in enumerate(keys)]
+
+    def init_params(self, key):
+        import jax
+        import jax.numpy as jnp
+
+        d, a = self.spec.obs_dim, self.spec.num_actions
+        h = list(self.spec.hiddens)
+        ka, k1, k2 = jax.random.split(key, 3)
+        return {
+            "actor": self._mlp_params(ka, [d] + h + [2 * a]),
+            "q1": self._mlp_params(k1, [d + a] + h + [1]),
+            "q2": self._mlp_params(k2, [d + a] + h + [1]),
+            "log_alpha": jnp.zeros(()),
+        }
+
+    # ------------------------------------------------------------- forward
+
+    @staticmethod
+    def _mlp(layers, x):
+        import jax
+
+        for i, lyr in enumerate(layers):
+            x = x @ lyr["w"] + lyr["b"]
+            if i < len(layers) - 1:
+                x = jax.nn.relu(x)
+        return x
+
+    def actor_dist(self, params, obs):
+        import jax.numpy as jnp
+
+        out = self._mlp(params["actor"], obs)
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        return mean, jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+
+    def sample_action(self, params, obs, noise):
+        """Reparameterized tanh-Gaussian sample -> (action, logp)."""
+        import jax.numpy as jnp
+
+        mean, log_std = self.actor_dist(params, obs)
+        std = jnp.exp(log_std)
+        pre = mean + std * noise
+        act = jnp.tanh(pre)
+        # N(pre; mean, std) log-density, then the tanh change of variables
+        logp = (-0.5 * jnp.square(noise) - log_std
+                - 0.5 * math.log(2 * math.pi)).sum(-1)
+        logp = logp - jnp.log(1.0 - jnp.square(act) + 1e-6).sum(-1)
+        return act, logp
+
+    def q_value(self, qlayers, obs, act):
+        import jax.numpy as jnp
+
+        return self._mlp(qlayers, jnp.concatenate([obs, act], -1))[:, 0]
+
+    # Learner-surface parity shims (get_weights paths treat params opaquely)
+    def forward_train(self, params, obs):  # pragma: no cover - parity only
+        return self.actor_dist(params, obs)
+
+
+class ContinuousEnvRunner:
+    """Box-action env sampler (gymnasium vector env + SACModule policy);
+    actions scaled from tanh's [-1, 1] to the env's bounds."""
+
+    def __init__(self, env_name: str, spec: RLModuleSpec, num_envs: int = 1,
+                 seed: int = 0, warmup_random_steps: int = 0,
+                 env_config: Optional[Dict[str, Any]] = None):
+        import gymnasium as gym
+        import jax
+
+        self._spec = spec
+        self.module = SACModule(spec)
+        self.envs = gym.vector.SyncVectorEnv(
+            [lambda: gym.make(env_name, **(env_config or {}))
+             for _ in range(num_envs)])
+        self.num_envs = num_envs
+        low = self.envs.single_action_space.low
+        high = self.envs.single_action_space.high
+        self._act_mid = (high + low) / 2.0
+        self._act_half = (high - low) / 2.0
+        self._obs, _ = self.envs.reset(seed=seed)
+        self._key = jax.random.PRNGKey(seed)
+        self.params = self.module.init_params(jax.random.PRNGKey(seed))
+        self._sample_fn = jax.jit(self.module.sample_action)
+        self._steps = 0
+        self._warmup = warmup_random_steps
+        self._rng = np.random.default_rng(seed)
+        self._ep_ret = np.zeros(num_envs)
+        self._ep_len = np.zeros(num_envs, np.int64)
+        self._finished_returns: List[float] = []
+        self._finished_lens: List[int] = []
+
+    def set_weights(self, weights) -> bool:
+        import jax
+        import jax.numpy as jnp
+
+        self.params = jax.tree.map(jnp.asarray, weights)
+        return True
+
+    def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
+        """Row-flat batch: [T*B] transitions for the replay buffer."""
+        import jax
+
+        a_dim = self._spec.num_actions
+        rows = {k: [] for k in ("obs", "actions", "rewards", "next_obs",
+                                "terminateds", "truncateds")}
+        for _ in range(num_steps):
+            if self._steps < self._warmup:
+                act = self._rng.uniform(-1, 1,
+                                        (self.num_envs, a_dim)).astype(
+                                            np.float32)
+            else:
+                self._key, sub = jax.random.split(self._key)
+                noise = jax.random.normal(sub, (self.num_envs, a_dim))
+                act, _ = self._sample_fn(
+                    self.params, self._obs.astype(np.float32), noise)
+                act = np.asarray(act)
+            env_act = self._act_mid + act * self._act_half
+            nxt, rew, term, trunc, _ = self.envs.step(
+                env_act.astype(np.float32))
+            rows["obs"].append(self._obs.astype(np.float32))
+            rows["actions"].append(act.astype(np.float32))
+            rows["rewards"].append(np.asarray(rew, np.float32))
+            rows["next_obs"].append(nxt.astype(np.float32))
+            rows["terminateds"].append(term)
+            rows["truncateds"].append(trunc)
+            self._ep_ret += rew
+            self._ep_len += 1
+            for i in np.nonzero(term | trunc)[0]:
+                self._finished_returns.append(float(self._ep_ret[i]))
+                self._finished_lens.append(int(self._ep_len[i]))
+                self._ep_ret[i] = 0.0
+                self._ep_len[i] = 0
+            self._obs = nxt
+            self._steps += self.num_envs
+        return {k: (np.concatenate(v) if np.ndim(v[0]) > 1
+                    else np.stack(v).reshape(-1))
+                for k, v in rows.items()}
+
+    def get_metrics(self) -> Dict[str, Any]:
+        out = {
+            "episode_return_mean": (float(np.mean(self._finished_returns))
+                                    if self._finished_returns else None),
+            "episode_len_mean": (float(np.mean(self._finished_lens))
+                                 if self._finished_lens else None),
+            "num_episodes": len(self._finished_returns),
+        }
+        self._finished_returns = []
+        self._finished_lens = []
+        return out
+
+    def stop(self) -> None:
+        self.envs.close()
+
+
+class _ContinuousRunnerGroup:
+    def __init__(self, env_name, spec, num_env_runners=0,
+                 num_envs_per_runner=1, seed=0, warmup=0, env_config=None):
+        self._local: Optional[ContinuousEnvRunner] = None
+        self._actors: List[Any] = []
+        if num_env_runners <= 0:
+            self._local = ContinuousEnvRunner(
+                env_name, spec, num_envs_per_runner, seed, warmup,
+                env_config)
+        else:
+            cls = ray_tpu.remote(ContinuousEnvRunner)
+            self._actors = [cls.options(num_cpus=1).remote(
+                env_name, spec, num_envs_per_runner, seed + 1000 * i,
+                warmup, env_config) for i in range(num_env_runners)]
+
+    def set_weights(self, w):
+        if self._local is not None:
+            self._local.set_weights(w)
+        else:
+            ray_tpu.get([a.set_weights.remote(w) for a in self._actors])
+
+    def sample(self, n):
+        if self._local is not None:
+            return [self._local.sample(n)]
+        return ray_tpu.get([a.sample.remote(n) for a in self._actors])
+
+    def get_metrics(self):
+        if self._local is not None:
+            return [self._local.get_metrics()]
+        return ray_tpu.get([a.get_metrics.remote() for a in self._actors])
+
+    def stop(self):
+        if self._local is not None:
+            self._local.stop()
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.tau: float = 0.005                   # polyak rate
+        self.initial_alpha: float = 1.0
+        self.target_entropy: Optional[float] = None  # None => -action_dim
+        self.replay_buffer_capacity: int = 100_000
+        self.num_steps_sampled_before_learning_starts: int = 1000
+        self.warmup_random_steps: int = 1000
+        self.train_batch_size: int = 256
+        self.updates_per_iteration: int = 32
+        self.lr = 3e-4
+        self.rollout_fragment_length = 32
+        self.num_envs_per_env_runner = 1
+
+    def rl_module_spec(self) -> RLModuleSpec:
+        obs_dim, act_dim = self.observation_dim, self.num_actions
+        if obs_dim is None or act_dim is None:
+            import gymnasium as gym
+
+            probe = gym.make(self.env, **self.env_config)
+            try:
+                obs_dim = obs_dim or int(probe.observation_space.shape[0])
+                act_dim = act_dim or int(probe.action_space.shape[0])
+            finally:
+                probe.close()
+        return RLModuleSpec(
+            obs_dim=obs_dim, num_actions=act_dim,
+            hiddens=tuple(self.model.get("hiddens", (256, 256))),
+            dist_type="gaussian", module_class=SACModule)
+
+
+class SAC(Algorithm):
+    def __init__(self, config: SACConfig):
+        import time as _time
+
+        # continuous env + custom module: bypass the discrete base wiring
+        self.config = config
+        self.iteration = 0
+        self._total_env_steps = 0
+        self._start = _time.time()
+        self.spec = config.rl_module_spec()
+        self.learner_groups = None
+        self.env_runner_group = _ContinuousRunnerGroup(
+            config.env, self.spec,
+            num_env_runners=config.num_env_runners,
+            num_envs_per_runner=config.num_envs_per_env_runner,
+            seed=config.seed, warmup=config.warmup_random_steps,
+            env_config=config.env_config)
+        self.learner_group = LearnerGroup(
+            self.spec, type(self).loss_fn,
+            optimizer_config={"lr": config.lr,
+                              "grad_clip": config.grad_clip},
+            num_learners=config.num_learners, seed=config.seed)
+        self.replay = ReplayBuffer(config.replay_buffer_capacity,
+                                   seed=config.seed)
+        self._target_q = self.learner_group.get_weights()
+        self._target_fn = None
+        self._rng = np.random.default_rng(config.seed)
+        self._sync_weights()
+
+    @classmethod
+    def get_default_config(cls) -> SACConfig:
+        return SACConfig()
+
+    # ------------------------------------------------------------------ loss
+
+    @staticmethod
+    def loss_fn(module, params, batch, cfg):
+        import jax
+        import jax.numpy as jnp
+
+        obs = batch["obs"]
+        # critic: twin Q vs driver-computed soft targets
+        q1 = module.q_value(params["q1"], obs, batch["actions"])
+        q2 = module.q_value(params["q2"], obs, batch["actions"])
+        critic_loss = (jnp.mean((q1 - batch["targets"]) ** 2)
+                       + jnp.mean((q2 - batch["targets"]) ** 2))
+
+        # actor: fresh reparameterized action; Q params frozen here
+        act, logp = module.sample_action(params, obs, batch["noise"])
+        qp1 = jax.lax.stop_gradient(params["q1"])
+        qp2 = jax.lax.stop_gradient(params["q2"])
+        q_min = jnp.minimum(module.q_value(qp1, obs, act),
+                            module.q_value(qp2, obs, act))
+        alpha = jnp.exp(jax.lax.stop_gradient(params["log_alpha"]))
+        actor_loss = jnp.mean(alpha * logp - q_min)
+
+        # temperature: match target entropy
+        alpha_loss = -jnp.mean(
+            params["log_alpha"]
+            * jax.lax.stop_gradient(logp + cfg["target_entropy"]))
+
+        total = critic_loss + actor_loss + alpha_loss
+        return total, {"critic_loss": critic_loss,
+                       "actor_loss": actor_loss,
+                       "alpha": alpha,
+                       "mean_q": jnp.mean(q_min),
+                       "entropy": -jnp.mean(logp)}
+
+    # ------------------------------------------------------------- training
+
+    def _compute_targets(self, batch, weights):
+        """Soft TD targets r + gamma (min target-Q(s', a') - alpha logp')."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._target_fn is None:
+            module = SACModule(self.spec)
+
+            def target(tq, actor_params, next_obs, rewards, done, noise,
+                       gamma):
+                act, logp = module.sample_action(actor_params, next_obs,
+                                                 noise)
+                tmin = jnp.minimum(
+                    module.q_value(tq["q1"], next_obs, act),
+                    module.q_value(tq["q2"], next_obs, act))
+                alpha = jnp.exp(actor_params["log_alpha"])
+                soft = tmin - alpha * logp
+                return rewards + gamma * (1.0 - done) * soft
+
+            self._target_fn = jax.jit(target, static_argnames=("gamma",))
+        noise = self._rng.standard_normal(
+            (len(batch["rewards"]), self.spec.num_actions)).astype(
+                np.float32)
+        done = batch["terminateds"].astype(np.float32)
+        return np.asarray(self._target_fn(
+            self._target_q, weights, batch["next_obs"], batch["rewards"],
+            done, noise, self.config.gamma))
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: SACConfig = self.config
+        for sample in self.env_runner_group.sample(
+                cfg.rollout_fragment_length):
+            n = len(sample["rewards"])
+            self._total_env_steps += n
+            self.replay.add(sample)
+
+        metrics: Dict[str, Any] = {}
+        if self._total_env_steps < (
+                cfg.num_steps_sampled_before_learning_starts):
+            self._sync_weights()
+            return {"learning": False}
+
+        target_entropy = (cfg.target_entropy
+                          if cfg.target_entropy is not None
+                          else -float(self.spec.num_actions))
+        weights = self.learner_group.get_weights()
+        for _ in range(cfg.updates_per_iteration):
+            batch = self.replay.sample(cfg.train_batch_size)
+            batch["targets"] = self._compute_targets(batch, weights)
+            batch["noise"] = self._rng.standard_normal(
+                (len(batch["rewards"]), self.spec.num_actions)).astype(
+                    np.float32)
+            metrics = self.learner_group.update_from_batch(
+                batch, {"target_entropy": target_entropy})
+            weights = self.learner_group.get_weights()
+            # polyak target update
+            import jax
+
+            tau = cfg.tau
+            self._target_q = jax.tree.map(
+                lambda t, w: (1 - tau) * t + tau * np.asarray(w),
+                self._target_q, weights)
+        self._sync_weights()
+        return metrics
+
+    def _extra_state(self):
+        return {"target_q": self._target_q,
+                "replay": self.replay.get_state()}
+
+    def _set_extra_state(self, extra):
+        if "target_q" in extra:
+            self._target_q = extra["target_q"]
+        if "replay" in extra:
+            self.replay.set_state(extra["replay"])
+
+
+SACConfig.algo_class = SAC

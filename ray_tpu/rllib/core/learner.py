@@ -34,7 +34,9 @@ class Learner:
         import jax
         import optax
 
-        self.module = RLModule(spec)
+        from ray_tpu.rllib.core.rl_module import make_module
+
+        self.module = make_module(spec)
         self.loss_fn = loss_fn
         cfg = dict(optimizer_config or {})
         lr = cfg.get("lr", 5e-4)

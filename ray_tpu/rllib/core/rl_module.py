@@ -35,6 +35,15 @@ class RLModuleSpec:
     obs_shape: Tuple[int, ...] = ()
     conv_filters: Tuple[Tuple[int, int, int], ...] = (
         (32, 8, 4), (64, 4, 2), (64, 3, 1))  # (out_ch, kernel, stride)
+    #: custom module class (e.g. SAC's continuous actor-critic); None uses
+    #: the default RLModule. Must accept (spec) and expose init_params().
+    module_class: Any = None
+
+
+def make_module(spec: "RLModuleSpec"):
+    """Module factory honoring spec.module_class (reference: RLModuleSpec
+    carries module_class + catalog)."""
+    return (spec.module_class or RLModule)(spec)
 
 
 def _init_linear(key, fan_in: int, fan_out: int, scale: float = 1.0):

@@ -21,7 +21,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rllib.core.rl_module import RLModule, RLModuleSpec
+from ray_tpu.rllib.core.rl_module import RLModuleSpec, make_module
 
 
 class MultiAgentEnv:
@@ -114,7 +114,8 @@ class MultiAgentEnvRunner:
         import jax
 
         self._specs = specs
-        self.modules = {pid: RLModule(spec) for pid, spec in specs.items()}
+        self.modules = {pid: make_module(spec)
+                        for pid, spec in specs.items()}
         self.params = {
             pid: m.init_params(jax.random.PRNGKey(seed + j))
             for j, (pid, m) in enumerate(self.modules.items())}

@@ -15,7 +15,8 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rllib.core.rl_module import RLModule, RLModuleSpec
+from ray_tpu.rllib.core.rl_module import (RLModule, RLModuleSpec,
+                                           make_module)
 
 
 class SingleAgentEnvRunner:
@@ -27,7 +28,7 @@ class SingleAgentEnvRunner:
         import jax
 
         self._spec = spec
-        self.module = RLModule(spec)
+        self.module = make_module(spec)
         kwargs = env_config or {}
         self.envs = gym.vector.SyncVectorEnv(
             [lambda: gym.make(env_name, **kwargs)

@@ -1,0 +1,223 @@
+"""SAC (continuous control, Pendulum) and offline RL (BC / MARWIL).
+Mirrors `rllib/algorithms/sac/tests/` + `rllib/algorithms/bc|marwil/tests/`
+coverage shape: unit checks on the distributions/losses plus small
+end-to-end learning runs."""
+
+import numpy as np
+import pytest
+
+
+class TestSACModule:
+    def test_tanh_gaussian_logp(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.algorithms.sac import SACModule
+        from ray_tpu.rllib.core.rl_module import RLModuleSpec
+
+        spec = RLModuleSpec(obs_dim=3, num_actions=2, hiddens=(16,))
+        m = SACModule(spec)
+        params = m.init_params(jax.random.PRNGKey(0))
+        obs = jnp.ones((5, 3))
+        noise = jax.random.normal(jax.random.PRNGKey(1), (5, 2))
+        act, logp = m.sample_action(params, obs, noise)
+        assert act.shape == (5, 2)
+        assert float(jnp.max(jnp.abs(act))) <= 1.0
+        assert np.all(np.isfinite(np.asarray(logp)))
+        # zero noise = mode; |mode| logp should exceed far-tail logp
+        act0, logp0 = m.sample_action(params, obs, jnp.zeros((5, 2)))
+        _, logp_far = m.sample_action(params, obs, 5.0 * jnp.ones((5, 2)))
+        assert float(jnp.mean(logp0)) > float(jnp.mean(logp_far))
+
+    def test_q_heads_differ(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.algorithms.sac import SACModule
+        from ray_tpu.rllib.core.rl_module import RLModuleSpec
+
+        m = SACModule(RLModuleSpec(obs_dim=3, num_actions=2, hiddens=(16,)))
+        params = m.init_params(jax.random.PRNGKey(0))
+        obs, act = jnp.ones((4, 3)), jnp.zeros((4, 2))
+        q1 = m.q_value(params["q1"], obs, act)
+        q2 = m.q_value(params["q2"], obs, act)
+        assert q1.shape == (4,)
+        assert not np.allclose(np.asarray(q1), np.asarray(q2))
+
+
+class TestSACPendulum:
+    def test_learns_pendulum(self, ray_init):
+        """Pendulum-v1 random policy sits near -1200..-1500 return; SAC
+        should clearly improve within a small budget."""
+        from ray_tpu.rllib.algorithms.sac import SACConfig
+
+        config = (SACConfig()
+                  .environment(env="Pendulum-v1")
+                  .env_runners(num_envs_per_env_runner=8,
+                               rollout_fragment_length=32)
+                  .training(lr=7e-4, train_batch_size=256,
+                            updates_per_iteration=128,
+                            warmup_random_steps=512,
+                            num_steps_sampled_before_learning_starts=512,
+                            tau=0.005,
+                            model={"hiddens": (64, 64)})
+                  .debugging(seed=0))
+        algo = config.build()
+        best = -np.inf
+        for i in range(55):
+            r = algo.train()
+            ret = r.get("episode_return_mean")
+            if ret is not None:
+                best = max(best, ret)
+            if best >= -400:
+                break
+        algo.stop()
+        # random policy sits near -1200..-1600; -400 is clearly learned
+        # (full solve is ~-150, reached by ~iter 45 in tuning runs)
+        assert best >= -400, best
+
+    def test_checkpoint_roundtrip(self, ray_init, tmp_path):
+        from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
+
+        config = (SACConfig()
+                  .environment(env="Pendulum-v1")
+                  .env_runners(num_envs_per_env_runner=2,
+                               rollout_fragment_length=8)
+                  .training(warmup_random_steps=0,
+                            num_steps_sampled_before_learning_starts=8,
+                            updates_per_iteration=2, train_batch_size=16,
+                            model={"hiddens": (8,)})
+                  .debugging(seed=0))
+        algo = config.build()
+        algo.train()
+        state = algo.get_state()
+        ckpt = algo.save_to_checkpoint(str(tmp_path / "sac"))
+        algo.stop()
+
+        algo2 = config.build()
+        algo2.restore_from_checkpoint(ckpt)
+        import jax
+
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(a, b),
+            state["learner"]["params"],
+            algo2.get_state()["learner"]["params"])
+        algo2.stop()
+
+
+def _make_offline_rows(n=2000, obs_dim=6, n_act=4, seed=0, with_return=False,
+                       noise_frac=0.0, biased_noise=False):
+    """obs one-hot-ish; optimal action = argmax(obs[:n_act]). With
+    noise_frac, that fraction of rows logs a wrong action; biased_noise
+    makes the wrong action deterministic ((best+1) % n) so plain BC faces
+    a 50/50 label conflict per state while the attached returns still
+    identify the good rows — the setting where MARWIL's advantage
+    weighting matters."""
+    rng = np.random.default_rng(seed)
+    rows = []
+    for _ in range(n):
+        obs = rng.normal(size=obs_dim).astype(np.float32)
+        best = int(np.argmax(obs[:n_act]))
+        if rng.random() < noise_frac:
+            a = ((best + 1) % n_act if biased_noise
+                 else int(rng.integers(n_act)))
+        else:
+            a = best
+        row = {"obs": obs, "action": a}
+        if with_return:
+            row["return"] = 1.0 if a == best else -1.0
+        rows.append(row)
+    return rows
+
+
+def _optimal_accuracy(algo, n=512, obs_dim=6, n_act=4, seed=99):
+    """Greedy-policy accuracy vs the TRUE optimal action on held-out
+    states (training `accuracy` is vs logged actions, which caps at the
+    behavior rate)."""
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.core.rl_module import RLModule
+
+    rng = np.random.default_rng(seed)
+    obs = rng.normal(size=(n, obs_dim)).astype(np.float32)
+    best = np.argmax(obs[:, :n_act], axis=1)
+    module = RLModule(algo.spec)
+    logits = module.forward_inference(algo.get_weights(), jnp.asarray(obs))
+    return float(np.mean(np.argmax(np.asarray(logits), -1) == best))
+
+
+class TestBC:
+    def test_learns_mapping(self, ray_init):
+        from ray_tpu.rllib.algorithms.marwil import BCConfig
+
+        config = (BCConfig()
+                  .environment(observation_dim=6, num_actions=4)
+                  .offline_data(input_=_make_offline_rows())
+                  .training(lr=3e-3, updates_per_iteration=24,
+                            model={"hiddens": (64,)})
+                  .debugging(seed=0))
+        algo = config.build()
+        acc = 0.0
+        for _ in range(12):
+            acc = algo.train().get("accuracy", 0.0)
+            if acc > 0.95:
+                break
+        algo.stop()
+        assert acc > 0.9, acc
+
+    def test_dataset_input(self, ray_init):
+        from ray_tpu import data
+        from ray_tpu.rllib.algorithms.marwil import BCConfig
+
+        ds = data.from_items(_make_offline_rows(n=200))
+        config = (BCConfig()
+                  .environment(observation_dim=6, num_actions=4)
+                  .offline_data(input_=ds)
+                  .training(model={"hiddens": (32,)}))
+        algo = config.build()
+        r = algo.train()
+        assert r["num_rows"] == 200
+        algo.stop()
+
+
+class TestMARWIL:
+    def test_beats_bc_on_mixed_data(self, ray_init):
+        """Half the logged actions are systematically wrong ((best+1)%n,
+        return -1): BC sees a 50/50 label conflict per state and cannot
+        resolve it; MARWIL's exp-advantage weighting suppresses the bad
+        rows and recovers the optimal mapping."""
+        from ray_tpu.rllib.algorithms.marwil import BCConfig, MARWILConfig
+
+        rows = _make_offline_rows(n=3000, with_return=True, noise_frac=0.5,
+                                  biased_noise=True)
+
+        def train_and_eval(cfg_cls, beta):
+            config = (cfg_cls()
+                      .environment(observation_dim=6, num_actions=4)
+                      .offline_data(input_=rows)
+                      .training(lr=3e-3, updates_per_iteration=24,
+                                model={"hiddens": (64,)})
+                      .debugging(seed=1))
+            if beta is not None:
+                config = config.training(beta=beta)
+            algo = config.build()
+            for _ in range(15):
+                algo.train()
+            acc = _optimal_accuracy(algo)
+            algo.stop()
+            return acc
+
+        marwil_acc = train_and_eval(MARWILConfig, 2.0)
+        bc_acc = train_and_eval(BCConfig, None)
+        assert marwil_acc > 0.85, (marwil_acc, bc_acc)
+        # BC splits the conflicted label mass ~50/50 per state
+        assert marwil_acc > bc_acc + 0.15, (marwil_acc, bc_acc)
+
+    def test_requires_returns(self, ray_init):
+        from ray_tpu.rllib.algorithms.marwil import MARWILConfig
+
+        config = (MARWILConfig()
+                  .environment(observation_dim=6, num_actions=4)
+                  .offline_data(input_=_make_offline_rows(n=50)))
+        with pytest.raises(ValueError, match="return"):
+            config.build()
