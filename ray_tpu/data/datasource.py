@@ -267,7 +267,7 @@ def _parse_example(buf: bytes):
                                 x, pos = _read_varint(lv, pos)
                                 values.append(_to_int64(x))
                         else:
-                            values.append(lv)
+                            values.append(_to_int64(lv))
             out[name] = values
     return out
 
@@ -337,6 +337,8 @@ def _encode_example(row: Dict[str, Any]) -> bytes:
 
     entries = b""
     for name, value in row.items():
+        if value is None:
+            continue  # sparse row: missing feature, matches reader semantics
         vals = value if isinstance(value, (list, tuple, np.ndarray)) else [
             value]
         if len(vals) and isinstance(vals[0], (bytes, str)):

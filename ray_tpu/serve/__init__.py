@@ -16,6 +16,10 @@ import ray_tpu
 from ray_tpu.serve._private.controller import CONTROLLER_NAME, ServeController
 from ray_tpu.serve.batching import batch  # noqa: F401
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse  # noqa: F401
+from ray_tpu.serve.multiplex import (  # noqa: F401
+    get_multiplexed_model_id,
+    multiplexed,
+)
 
 
 @dataclasses.dataclass

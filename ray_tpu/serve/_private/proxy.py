@@ -33,7 +33,11 @@ class ProxyActor:
 
     async def _start(self):
         if self._runner is not None:
+            # a concurrent first caller may still be mid-bind: wait until
+            # the real port is known before reporting it
+            await self._started_evt.wait()
             return
+        self._started_evt = asyncio.Event()
         from aiohttp import web
 
         app = web.Application()
@@ -42,6 +46,11 @@ class ProxyActor:
         await self._runner.setup()
         site = web.TCPSite(self._runner, "0.0.0.0", self._port)
         await site.start()
+        if self._port == 0:
+            # ephemeral bind: report the real port (tests and multi-tenant
+            # hosts use port 0 to avoid collisions)
+            self._port = site._server.sockets[0].getsockname()[1]
+        self._started_evt.set()
         asyncio.ensure_future(self._route_refresher())
         logger.info("serve proxy listening on :%d", self._port)
 

@@ -65,7 +65,18 @@ class ReplicaActor:
         self._stream_idle_ttl_s = 60.0
         self._stream_reaper_task = None
 
+    def multiplex_info(self) -> Dict[str, Any]:
+        """Model ids this replica has loaded (router affinity source)."""
+        from ray_tpu.serve.multiplex import loaded_model_ids
+
+        return {"model_ids": loaded_model_ids(self._callable)}
+
     async def handle_request(self, method_name: str, args, kwargs) -> Any:
+        from ray_tpu.serve.multiplex import _set_request_model_id
+
+        mux_token = None
+        if kwargs and "__serve_mux_id" in kwargs:
+            mux_token = _set_request_model_id(kwargs.pop("__serve_mux_id"))
         self._ongoing += 1
         self._total += 1
         try:
@@ -78,9 +89,13 @@ class ReplicaActor:
             else:
                 # sync callables (jitted decode steps, blocking compute)
                 # must not stall the actor loop — health checks and
-                # concurrent requests ride the same loop
+                # concurrent requests ride the same loop. copy_context so
+                # get_multiplexed_model_id() works off-loop too.
+                import contextvars as _cv
+
+                ctx = _cv.copy_context()
                 out = await asyncio.get_running_loop().run_in_executor(
-                    None, lambda: fn(*args, **(kwargs or {})))
+                    None, lambda: ctx.run(fn, *args, **(kwargs or {})))
             if inspect.isawaitable(out):
                 out = await out
             if inspect.isgenerator(out) or inspect.isasyncgen(out):
@@ -100,6 +115,10 @@ class ReplicaActor:
             return out
         finally:
             self._ongoing -= 1
+            if mux_token is not None:
+                from ray_tpu.serve.multiplex import _request_model_id
+
+                _request_model_id.reset(mux_token)
 
     async def _stream_reaper(self) -> None:
         """Abandoned streams (consumer gone mid-iteration) must not pump

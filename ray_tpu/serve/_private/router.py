@@ -37,6 +37,14 @@ class Router:
         self._update_event = threading.Event()
         self._stopped = False
         self._poll_thread: Optional[threading.Thread] = None
+        # multiplexing: model_id -> replica indices holding it; refreshed
+        # by a background poll only while multiplexed requests flow
+        self._mux_locations: Dict[str, set] = {}
+        self._mux_thread: Optional[threading.Thread] = None
+        # optimistic (model, idx) marks with timestamps: kept through
+        # refreshes while the model may still be loading on that replica
+        self._mux_marks: Dict[tuple, float] = {}
+        self._mux_last_request = 0.0
 
     def _ensure_polling(self) -> None:
         if self._poll_thread is None:
@@ -84,16 +92,25 @@ class Router:
                         i: 0 for i in range(len(self._replicas))}
                 self._update_event.set()
 
-    def _pick(self):
-        """Pow-2 choice under the lock; None if no replicas known."""
+    def _pick(self, multiplexed_model_id: str = ""):
+        """Pow-2 choice under the lock; None if no replicas known. With a
+        model id, restrict the pow-2 draw to replicas already holding that
+        model (reference `multiplex.py` routing affinity) when any do."""
         with self._lock:
             n = len(self._replicas)
             if not n:
                 return None
-            if n == 1:
-                idx = 0
+            candidates = list(range(n))
+            if multiplexed_model_id:
+                hot = self._mux_locations.get(multiplexed_model_id)
+                if hot:
+                    hot_idx = [i for i in candidates if i in hot]
+                    if hot_idx:
+                        candidates = hot_idx
+            if len(candidates) == 1:
+                idx = candidates[0]
             else:
-                a, b = random.sample(range(n), 2)
+                a, b = random.sample(candidates, 2)
                 idx = (a if self._inflight.get(a, 0)
                        <= self._inflight.get(b, 0) else b)
             self._inflight[idx] = self._inflight.get(idx, 0) + 1
@@ -104,17 +121,20 @@ class Router:
             method_name, args, kwargs)
         return ref
 
-    def assign_request_with_replica(self, method_name: str, args, kwargs):
+    def assign_request_with_replica(self, method_name: str, args, kwargs,
+                                    multiplexed_model_id: str = ""):
         """Returns (result_ref, replica_handle). The replica handle lets
         callers continue a streaming response on the same replica."""
         self._ensure_polling()
+        if multiplexed_model_id:
+            self._ensure_mux_refresh()
         deadline = time.monotonic() + 30
         while True:
             # clear BEFORE picking: a push landing between a failed pick
             # and clear() would otherwise be erased and stall us a full
             # wait interval
             self._update_event.clear()
-            picked = self._pick()
+            picked = self._pick(multiplexed_model_id)
             if picked is not None:
                 idx, replica = picked
                 break
@@ -124,9 +144,72 @@ class Router:
                     f"no replicas for {self._app}/{self._deployment}")
             # wait for the long-poll push, not an interval
             self._update_event.wait(timeout=min(remaining, 5.0))
+        if multiplexed_model_id:
+            # optimistic: the chosen replica will hold the model after this
+            # request, so siblings route there before the next poll lands
+            with self._lock:
+                self._mux_locations.setdefault(
+                    multiplexed_model_id, set()).add(idx)
+                self._mux_marks[(multiplexed_model_id, idx)] = (
+                    time.monotonic())
+                self._mux_last_request = time.monotonic()
         ref = replica.handle_request.remote(method_name, args, kwargs)
         self._watch_completion(ref, idx)
         return ref, replica
+
+    def _ensure_mux_refresh(self) -> None:
+        self._mux_last_request = time.monotonic()
+        if self._mux_thread is None:
+            with self._lock:
+                if self._mux_thread is None:
+                    t = threading.Thread(
+                        target=self._mux_refresh_loop,
+                        name=f"serve-mux-{self._deployment}", daemon=True)
+                    self._mux_thread = t
+                    t.start()
+
+    MUX_MARK_TTL_S = 30.0     # optimistic marks survive refreshes this long
+    MUX_IDLE_EXIT_S = 60.0    # refresh thread retires when mux traffic stops
+
+    def _mux_refresh_loop(self) -> None:
+        """Poll replicas' loaded-model sets so affinity reflects real LRU
+        state (evictions included). Recent optimistic marks and entries of
+        unreachable replicas are merged in, not wiped — a model mid-load
+        (or one slow poll) must not bounce the next request to a cold
+        replica. The thread retires itself once mux traffic stops."""
+        while not self._stopped:
+            time.sleep(1.0)
+            now = time.monotonic()
+            if now - self._mux_last_request > self.MUX_IDLE_EXIT_S:
+                with self._lock:
+                    self._mux_thread = None
+                return
+            with self._lock:
+                replicas = list(enumerate(self._replicas))
+            if not replicas:
+                continue
+            fresh: Dict[str, set] = {}
+            failed: set = set()
+            for idx, rep in replicas:
+                try:
+                    info = ray_tpu.get(rep.multiplex_info.remote(),
+                                       timeout=5)
+                except Exception:
+                    failed.add(idx)
+                    continue
+                for mid in info.get("model_ids", ()):
+                    fresh.setdefault(mid, set()).add(idx)
+            with self._lock:
+                for (mid, idx), ts in list(self._mux_marks.items()):
+                    if now - ts > self.MUX_MARK_TTL_S:
+                        del self._mux_marks[(mid, idx)]
+                    else:
+                        fresh.setdefault(mid, set()).add(idx)
+                for mid, idxs in self._mux_locations.items():
+                    keep = idxs & failed
+                    if keep:
+                        fresh.setdefault(mid, set()).update(keep)
+                self._mux_locations = fresh
 
     def _watch_completion(self, ref, idx: int):
         def done(_f):

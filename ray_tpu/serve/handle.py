@@ -64,11 +64,20 @@ class _BoundMethod:
 
 class DeploymentHandle:
     def __init__(self, app_name: str, deployment_name: str,
-                 controller=None):
+                 controller=None, multiplexed_model_id: str = ""):
         self._app = app_name
         self._deployment = deployment_name
         self._controller = controller
         self._router: Optional[Router] = None
+        self._mux_id = multiplexed_model_id
+
+    def options(self, *, multiplexed_model_id: str = "") -> "DeploymentHandle":
+        """≈ `serve.handle.DeploymentHandle.options`: a copy of this handle
+        whose requests carry (and route by) the multiplexed model id."""
+        h = DeploymentHandle(self._app, self._deployment, self._controller,
+                             multiplexed_model_id=multiplexed_model_id)
+        h._router = self._router  # share the router (and its replica view)
+        return h
 
     def _get_router(self) -> Router:
         if self._router is None:
@@ -90,8 +99,10 @@ class DeploymentHandle:
                      for a in args)
         kwargs = {k: (v._ref if isinstance(v, DeploymentResponse) else v)
                   for k, v in kwargs.items()}
+        if self._mux_id:
+            kwargs = dict(kwargs, __serve_mux_id=self._mux_id)
         ref, replica = self._get_router().assign_request_with_replica(
-            method, args, kwargs)
+            method, args, kwargs, multiplexed_model_id=self._mux_id)
         return DeploymentResponse(ref, replica=replica)
 
     def __getattr__(self, name: str) -> _BoundMethod:
@@ -100,4 +111,5 @@ class DeploymentHandle:
         return _BoundMethod(self, name)
 
     def __reduce__(self):
-        return (DeploymentHandle, (self._app, self._deployment))
+        return (DeploymentHandle,
+                (self._app, self._deployment, None, self._mux_id))

@@ -190,9 +190,9 @@ class TestHTTP:
                 return {"got": payload}
 
         serve.run(Echo.bind(), name="http", route_prefix="/echo")
-        port = serve.start(http_port=18642)
+        port = serve.start(http_port=0)  # ephemeral: no collisions
         base = f"http://127.0.0.1:{port}"
-        deadline = time.monotonic() + 15
+        deadline = time.monotonic() + 60
         while time.monotonic() < deadline:
             try:
                 r = httpx.get(base + "/-/healthz", timeout=2)
@@ -282,9 +282,9 @@ class TestLLMDecode:
         assert len({o["text"] for o in outs}) == 1
 
         # HTTP: non-streaming JSON, then chunked token streaming
-        port = serve.start(http_port=18643)
+        port = serve.start(http_port=0)  # ephemeral: no collisions
         base = f"http://127.0.0.1:{port}"
-        deadline = time.monotonic() + 15
+        deadline = time.monotonic() + 60
         while time.monotonic() < deadline:
             try:
                 if httpx.get(base + "/-/healthz", timeout=2).status_code == 200:
