@@ -1,0 +1,114 @@
+"""TPU pod discovery: GKE env vars + GCE metadata server.
+
+Analog of `python/ray/_private/accelerators/tpu.py:14-49`: figure out, from
+inside a TPU VM, (a) the pod's accelerator type (e.g. "v5p-64"), (b) this
+host's worker index within the pod, and (c) the chip count — then turn them
+into scheduler resources: per-host "TPU" chips, an "accelerator_type:TPU-<gen>"
+label, and the pod-wide `TPU-<type>-head` gang resource on worker 0 (the
+reference's convention for multi-host gang scheduling; our STRICT_SPREAD
+slice bundles in `parallel/slices.py` consume it).
+
+Sources, in priority order:
+  1. explicit env (TPU_ACCELERATOR_TYPE / TPU_WORKER_ID — set by the GKE
+     TPU webhook and by tests),
+  2. the GCE metadata server (guarded by a short timeout and the
+     RAY_TPU_DISABLE_METADATA kill-switch; a zero-egress box just falls
+     through in ~100ms).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+# reference: accelerators/tpu.py GKE_TPU_* / GCE metadata keys
+_GKE_ACCEL_ENV = "TPU_ACCELERATOR_TYPE"     # e.g. "v5p-64"
+_GKE_WORKER_ID_ENV = "TPU_WORKER_ID"        # "0".."n_hosts-1"
+_GKE_TOPOLOGY_ENV = "TPU_TOPOLOGY"          # e.g. "2x2x2"
+_GCE_METADATA_URL = (
+    "http://metadata.google.internal/computeMetadata/v1/instance/attributes")
+_METADATA_HEADERS = {"Metadata-Flavor": "Google"}
+_METADATA_TIMEOUT_S = 0.5
+
+
+def _metadata_get(key: str) -> Optional[str]:
+    """GCE metadata attribute, or None fast when unreachable/disabled."""
+    if os.environ.get("RAY_TPU_DISABLE_METADATA"):
+        return None
+    base = os.environ.get("RAY_TPU_METADATA_URL", _GCE_METADATA_URL)
+    try:
+        import urllib.request
+
+        req = urllib.request.Request(f"{base}/{key}",
+                                     headers=_METADATA_HEADERS)
+        with urllib.request.urlopen(req,
+                                    timeout=_METADATA_TIMEOUT_S) as resp:
+            return resp.read().decode().strip()
+    except Exception:
+        return None
+
+
+def get_current_pod_accelerator_type() -> Optional[str]:
+    """'v5p-64'-style type for the pod this host belongs to, or None off-TPU
+    (reference `tpu.py` GKE env first, GCE `accelerator-type` second)."""
+    accel = os.environ.get(_GKE_ACCEL_ENV)
+    if accel:
+        return accel
+    return _metadata_get("accelerator-type")
+
+
+def get_current_pod_worker_id() -> Optional[int]:
+    """This host's index within the pod slice (0 == slice head)."""
+    wid = os.environ.get(_GKE_WORKER_ID_ENV)
+    if wid is None:
+        wid = _metadata_get("agent-worker-number")
+    if wid is None:
+        return None
+    try:
+        return int(wid)
+    except ValueError:
+        return None
+
+
+def get_current_pod_name() -> Optional[str]:
+    """The TPU pod/instance name (detached-actor namespacing, logs)."""
+    return os.environ.get("TPU_NAME") or _metadata_get("instance-id")
+
+
+def tpu_pod_resources() -> Dict[str, float]:
+    """Scheduler resources this host contributes on account of its TPU pod
+    membership (empty off-TPU):
+
+      - ``accelerator_type:TPU-<gen>``: node-affinity label,
+      - ``TPU-<type>-head``: 1.0 on worker 0 only — the gang resource a
+        pod-wide job leases to claim the slice (reference tpu.py:44-49).
+
+    Per-host chip counts are detected separately (resources._detect_tpu_chips
+    — `TPU_VISIBLE_CHIPS` isolation must win over pod math).
+    """
+    accel = get_current_pod_accelerator_type()
+    if not accel:
+        return {}
+    out: Dict[str, float] = {}
+    gen = accel.split("-")[0]
+    out[f"accelerator_type:TPU-{gen}"] = 1.0
+    worker_id = get_current_pod_worker_id()
+    if worker_id == 0 or worker_id is None:
+        # single-host slices have no worker id; they are their own head
+        out[f"TPU-{accel}-head"] = 1.0
+    return out
+
+
+def chips_from_accelerator_type(accel: str) -> int:
+    """Per-host chip count implied by the pod type (fallback when the
+    runtime env vars are absent)."""
+    from ray_tpu.parallel.slices import SliceTopology
+
+    try:
+        topo = SliceTopology.parse(accel)
+    except ValueError:
+        return 0
+    return topo.chips_per_host if topo.num_hosts > 1 else topo.num_chips

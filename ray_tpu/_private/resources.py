@@ -98,6 +98,13 @@ def detect_node_resources(
     if custom:
         for k, v in custom.items():
             rs[k] = float(v)
+    # TPU pod membership (GKE env / GCE metadata): accelerator-type label +
+    # the slice-head gang resource on worker 0. Explicit custom resources win.
+    if rs.get(TPU):
+        from ray_tpu._private.accelerators import tpu_pod_resources
+
+        for k, v in tpu_pod_resources().items():
+            rs.setdefault(k, float(v))
     return rs
 
 
@@ -118,6 +125,14 @@ def _detect_tpu_chips() -> int:
             pass
     if os.environ.get("RAY_TPU_FORCE_TPU_CHIPS"):
         return int(os.environ["RAY_TPU_FORCE_TPU_CHIPS"])
+    # GKE sets the pod accelerator type but not per-host chip bounds:
+    # derive chips/host from the topology (accelerators.py discovery)
+    accel = os.environ.get("TPU_ACCELERATOR_TYPE")
+    if accel:
+        from ray_tpu._private.accelerators import (
+            chips_from_accelerator_type)
+
+        return chips_from_accelerator_type(accel)
     return 0
 
 

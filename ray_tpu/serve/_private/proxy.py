@@ -13,7 +13,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 logger = logging.getLogger(__name__)
 
@@ -25,7 +25,8 @@ class ProxyActor:
         self._routes: Dict[str, Any] = {}
         self._handles: Dict[str, Any] = {}
         self._runner = None
-        self._started = asyncio.Event()
+        self._started_evt = asyncio.Event()
+        self._start_error: Optional[str] = None
 
     async def ready(self) -> int:
         await self._start()
@@ -36,20 +37,31 @@ class ProxyActor:
             # a concurrent first caller may still be mid-bind: wait until
             # the real port is known before reporting it
             await self._started_evt.wait()
+            if self._start_error:
+                raise RuntimeError(self._start_error)
             return
-        self._started_evt = asyncio.Event()
         from aiohttp import web
 
         app = web.Application()
         app.router.add_route("*", "/{tail:.*}", self._handle)
         self._runner = web.AppRunner(app, access_log=None)
-        await self._runner.setup()
-        site = web.TCPSite(self._runner, "0.0.0.0", self._port)
-        await site.start()
+        try:
+            await self._runner.setup()
+            site = web.TCPSite(self._runner, "0.0.0.0", self._port)
+            await site.start()
+        except BaseException as e:
+            # a failed bind (port in use) must not wedge future ready()
+            # calls behind a never-set event
+            self._runner = None
+            self._start_error = f"proxy bind failed: {e}"
+            self._started_evt.set()
+            self._started_evt = asyncio.Event()  # fresh gate for retries
+            raise
         if self._port == 0:
             # ephemeral bind: report the real port (tests and multi-tenant
             # hosts use port 0 to avoid collisions)
             self._port = site._server.sockets[0].getsockname()[1]
+        self._start_error = None
         self._started_evt.set()
         asyncio.ensure_future(self._route_refresher())
         logger.info("serve proxy listening on :%d", self._port)

@@ -148,15 +148,23 @@ class ReplicaActor:
                         return
             else:
                 # a sync generator's body (e.g. a jitted decode step per
-                # token) must not block the actor loop: pump on a thread
+                # token) must not block the actor loop: pump on a thread —
+                # under the request's contextvars so the generator body
+                # still sees get_multiplexed_model_id()
+                import contextvars as _cv
+
                 loop = asyncio.get_running_loop()
+                ctx = _cv.copy_context()
 
                 def pump():
-                    for item in gen:
-                        if stream.cancelled:
-                            return
-                        loop.call_soon_threadsafe(
-                            q.put_nowait, ("item", item))
+                    def run():
+                        for item in gen:
+                            if stream.cancelled:
+                                return
+                            loop.call_soon_threadsafe(
+                                q.put_nowait, ("item", item))
+
+                    ctx.run(run)
 
                 await loop.run_in_executor(None, pump)
             await q.put(("end", None))

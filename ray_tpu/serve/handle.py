@@ -76,7 +76,10 @@ class DeploymentHandle:
         whose requests carry (and route by) the multiplexed model id."""
         h = DeploymentHandle(self._app, self._deployment, self._controller,
                              multiplexed_model_id=multiplexed_model_id)
-        h._router = self._router  # share the router (and its replica view)
+        # share ONE router (and its replica view + affinity state) across
+        # all options() copies — materialize it now so per-request
+        # h.options(...) calls don't each build a router + poll threads
+        h._router = self._get_router()
         return h
 
     def _get_router(self) -> Router:

@@ -54,19 +54,34 @@ def multiplexed(max_num_models_per_replica: int = 3) -> Callable:
             if cache is None:
                 cache = OrderedDict()
                 setattr(owner, MUX_ATTR, cache)
-                owner.__serve_mux_lock__ = asyncio.Lock()
-            async with owner.__serve_mux_lock__:
-                if model_id in cache:
-                    cache.move_to_end(model_id)
-                    return cache[model_id]
-                out = (load_fn(owner, model_id) if is_method
-                       else load_fn(model_id))
-                if inspect.isawaitable(out):
-                    out = await out
+                owner.__serve_mux_loading__ = {}
+            # fast path: hits never wait behind another model's cold load
+            if model_id in cache:
+                cache.move_to_end(model_id)
+                return cache[model_id]
+            # dedupe concurrent loads of the SAME model; different models
+            # load concurrently (reference _ModelMultiplexWrapper semantics)
+            loading: dict = owner.__serve_mux_loading__
+            fut = loading.get(model_id)
+            if fut is None:
+                async def do_load():
+                    out = (load_fn(owner, model_id) if is_method
+                           else load_fn(model_id))
+                    if inspect.isawaitable(out):
+                        out = await out
+                    return out
+
+                fut = asyncio.ensure_future(do_load())
+                loading[model_id] = fut
+                try:
+                    out = await fut
+                finally:
+                    loading.pop(model_id, None)
                 cache[model_id] = out
                 while len(cache) > max_num_models_per_replica:
                     cache.popitem(last=False)   # evict LRU; GC unloads
                 return out
+            return await asyncio.shield(fut)
 
         if is_method:
             @functools.wraps(load_fn)

@@ -1,0 +1,128 @@
+"""TPU pod discovery: GKE env vars, GCE metadata fallback, and the
+slice-head gang resource wiring into node resource detection. Mirrors
+`python/ray/tests/accelerators/test_tpu.py` coverage shape."""
+
+import http.server
+import threading
+
+import pytest
+
+from ray_tpu._private import accelerators
+from ray_tpu._private.resources import detect_node_resources
+
+
+@pytest.fixture(autouse=True)
+def _clean_tpu_env(monkeypatch):
+    for var in ("TPU_ACCELERATOR_TYPE", "TPU_WORKER_ID", "TPU_TOPOLOGY",
+                "TPU_NAME", "TPU_VISIBLE_CHIPS", "TPU_CHIPS_PER_HOST_BOUNDS",
+                "RAY_TPU_FORCE_TPU_CHIPS", "RAY_TPU_METADATA_URL"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("RAY_TPU_DISABLE_METADATA", "1")
+    yield
+
+
+class TestGKEEnvDiscovery:
+    def test_accelerator_type_and_worker_id(self, monkeypatch):
+        monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5p-64")
+        monkeypatch.setenv("TPU_WORKER_ID", "3")
+        assert accelerators.get_current_pod_accelerator_type() == "v5p-64"
+        assert accelerators.get_current_pod_worker_id() == 3
+
+    def test_off_tpu_is_empty(self):
+        assert accelerators.get_current_pod_accelerator_type() is None
+        assert accelerators.tpu_pod_resources() == {}
+
+    def test_head_resource_on_worker_zero_only(self, monkeypatch):
+        monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5p-64")
+        monkeypatch.setenv("TPU_WORKER_ID", "0")
+        r0 = accelerators.tpu_pod_resources()
+        assert r0.get("TPU-v5p-64-head") == 1.0
+        assert r0.get("accelerator_type:TPU-v5p") == 1.0
+
+        monkeypatch.setenv("TPU_WORKER_ID", "2")
+        r2 = accelerators.tpu_pod_resources()
+        assert "TPU-v5p-64-head" not in r2
+        assert r2.get("accelerator_type:TPU-v5p") == 1.0
+
+    def test_single_host_slice_is_its_own_head(self, monkeypatch):
+        monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-8")
+        assert accelerators.tpu_pod_resources().get(
+            "TPU-v5litepod-8-head") == 1.0
+
+    def test_chips_from_accelerator_type(self):
+        # v5p-64: 64 cores = 32 chips over 8 hosts -> 4 chips/host
+        assert accelerators.chips_from_accelerator_type("v5p-64") == 4
+        # v5e-8 single host: all 8 chips
+        assert accelerators.chips_from_accelerator_type(
+            "v5litepod-8") == 8
+        assert accelerators.chips_from_accelerator_type("garbage") == 0
+
+
+class TestMetadataFallback:
+    def test_metadata_server(self, monkeypatch):
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.headers.get("Metadata-Flavor") != "Google":
+                    self.send_response(403)
+                    self.end_headers()
+                    return
+                body = {"/accelerator-type": b"v4-16",
+                        "/agent-worker-number": b"1"}.get(self.path)
+                if body is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            monkeypatch.delenv("RAY_TPU_DISABLE_METADATA")
+            monkeypatch.setenv(
+                "RAY_TPU_METADATA_URL",
+                f"http://127.0.0.1:{srv.server_address[1]}")
+            assert (accelerators.get_current_pod_accelerator_type()
+                    == "v4-16")
+            assert accelerators.get_current_pod_worker_id() == 1
+            # worker 1: label but no head resource
+            res = accelerators.tpu_pod_resources()
+            assert "TPU-v4-16-head" not in res
+            assert res.get("accelerator_type:TPU-v4") == 1.0
+        finally:
+            srv.shutdown()
+
+    def test_unreachable_metadata_fails_fast(self, monkeypatch):
+        import time
+
+        monkeypatch.delenv("RAY_TPU_DISABLE_METADATA")
+        monkeypatch.setenv("RAY_TPU_METADATA_URL",
+                           "http://127.0.0.1:1/nope")
+        t0 = time.monotonic()
+        assert accelerators.get_current_pod_accelerator_type() is None
+        assert time.monotonic() - t0 < 2.0
+
+
+class TestNodeResourceWiring:
+    def test_gke_pod_host_resources(self, monkeypatch):
+        monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5p-64")
+        monkeypatch.setenv("TPU_WORKER_ID", "0")
+        rs = detect_node_resources()
+        assert rs["TPU"] == 4.0                   # chips/host from topology
+        assert rs["TPU-v5p-64-head"] == 1.0
+        assert rs["accelerator_type:TPU-v5p"] == 1.0
+
+    def test_visible_chips_isolation_wins(self, monkeypatch):
+        monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5p-64")
+        monkeypatch.setenv("TPU_VISIBLE_CHIPS", "0,1")
+        rs = detect_node_resources()
+        assert rs["TPU"] == 2.0
+
+    def test_no_tpu_no_pod_resources(self):
+        rs = detect_node_resources()
+        assert "TPU" not in rs
+        assert not any(k.startswith("TPU-") for k in rs)

@@ -19,7 +19,10 @@ def serve_shutdown(ray_init):
 @serve.deployment
 class MuxModel:
     def __init__(self):
+        import uuid
+
         self.loads = []
+        self.replica_tag = uuid.uuid4().hex[:8]
 
     @serve.multiplexed(max_num_models_per_replica=2)
     async def get_model(self, model_id: str):
@@ -30,7 +33,7 @@ class MuxModel:
         model_id = serve.get_multiplexed_model_id()
         model = await self.get_model(model_id)
         return {"model": model["id"], "y": x * model["scale"],
-                "loads": list(self.loads)}
+                "loads": list(self.loads), "replica": self.replica_tag}
 
 
 class TestMultiplex:
@@ -54,12 +57,12 @@ class TestMultiplex:
 
     def test_router_affinity(self, serve_shutdown):
         """With 2 replicas, all requests for one model id should land on
-        the replica that already loaded it (after the first)."""
+        the ONE replica that loaded it (optimistic affinity mark)."""
         h = serve.run(MuxModel.options(num_replicas=2).bind())
         outs = [h.options(multiplexed_model_id="hot").remote(1).result()
                 for _ in range(8)]
-        # every response saw a cache containing "hot" exactly once =>
-        # one replica took them all (the optimistic affinity mark)
+        assert len({o["replica"] for o in outs}) == 1, (
+            "requests scattered across replicas")
         assert all(o["loads"].count("hot") == 1 for o in outs)
 
     def test_plain_requests_unaffected(self, serve_shutdown):
