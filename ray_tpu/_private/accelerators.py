@@ -97,8 +97,17 @@ def tpu_pod_resources() -> Dict[str, float]:
     out[f"accelerator_type:TPU-{gen}"] = 1.0
     worker_id = get_current_pod_worker_id()
     if worker_id == 0 or worker_id is None:
-        # single-host slices have no worker id; they are their own head
-        out[f"TPU-{accel}-head"] = 1.0
+        # single-host slices have no worker id; they are their own head.
+        # The resource NAME must be the chip-normalized one slice placement
+        # groups demand (SliceTopology.head_resource) — the raw accelerator
+        # string counts cores on v2-v4/v5p and would never match.
+        from ray_tpu.parallel.slices import SliceTopology
+
+        try:
+            head = SliceTopology.parse(accel).head_resource
+        except ValueError:
+            head = f"TPU-{accel}-head"
+        out[head] = 1.0
     return out
 
 

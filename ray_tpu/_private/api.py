@@ -211,10 +211,13 @@ def init(
                     print(f"{tag} {line}", file=stream)
 
             core.subscribe("worker_logs", _print_worker_logs)
+        session_dir = getattr(_node_handle, "session_dir", "")
+        if session_dir:
+            os.environ["RAY_TPU_SESSION_DIR"] = session_dir
         return {
             "address": f"{controller_addr[0]}:{controller_addr[1]}",
             "node_id": core.node_id_hex,
-            "session_dir": getattr(_node_handle, "session_dir", ""),
+            "session_dir": session_dir,
         }
 
 

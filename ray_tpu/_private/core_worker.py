@@ -312,6 +312,9 @@ class CoreWorker:
             owner=self.address,
             runtime_env=runtime_env,
         )
+        from ray_tpu.util import tracing
+
+        spec.trace_ctx = tracing.context_for_submission()
         return_ids = spec.return_ids()
         self._run(self._async_submit(spec))
         return return_ids
@@ -1257,6 +1260,9 @@ class CoreWorker:
             method_name=method_name,
             max_retries=max_task_retries,
         )
+        from ray_tpu.util import tracing
+
+        spec.trace_ctx = tracing.context_for_submission()
         return_ids = spec.return_ids()
         self._run(self._async_submit_actor_task(spec))
         return return_ids

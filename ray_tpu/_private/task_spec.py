@@ -95,6 +95,9 @@ class TaskSpec:
     max_restarts: int = 0
     max_task_retries: int = 0
     is_async_actor: bool = False
+    # distributed tracing: caller's span context (util/tracing.py); the
+    # executing worker opens a child span around the user function
+    trace_ctx: Optional[Dict[str, str]] = None
 
     def return_ids(self) -> List[ObjectID]:
         return [

@@ -190,10 +190,12 @@ class Executor:
                 self.core._run(self._notify_actor_ready(spec))
                 self._report_results(spec, [None])
                 return
-            result = fn(*args, **kwargs)
-            if asyncio.iscoroutine(result):
-                # sync path hit an async def: run it to completion here
-                result = asyncio.new_event_loop().run_until_complete(result)
+            with self._task_span(spec):
+                result = fn(*args, **kwargs)
+                if asyncio.iscoroutine(result):
+                    # sync path hit an async def: run it to completion here
+                    result = asyncio.new_event_loop().run_until_complete(
+                        result)
             results = self._split_returns(spec, result)
             self._report_results(spec, results)
         except Exception as e:  # noqa: BLE001 — user exception crosses to owner
@@ -210,13 +212,27 @@ class Executor:
                 None, self._resolve_args, spec
             )
             fn = self._get_callable(spec)
-            result = fn(*args, **kwargs)
-            if asyncio.iscoroutine(result):
-                result = await result
+            with self._task_span(spec):
+                result = fn(*args, **kwargs)
+                if asyncio.iscoroutine(result):
+                    result = await result
             results = self._split_returns(spec, result)
             self._report_results(spec, results)
         except Exception as e:  # noqa: BLE001
             self._report_error(spec, TaskError.from_exception(spec.name, e), False)
+
+    @staticmethod
+    def _task_span(spec: TaskSpec):
+        """Child span continuing the caller's propagated trace context
+        (no-op nullcontext for untraced tasks)."""
+        import contextlib
+
+        if not spec.trace_ctx:
+            return contextlib.nullcontext()
+        from ray_tpu.util import tracing
+
+        kind = "actor" if spec.actor_id is not None else "task"
+        return tracing.remote_span(f"{kind}::{spec.name}", spec.trace_ctx)
 
     def _split_returns(self, spec: TaskSpec, result) -> list:
         if spec.num_returns == 1:
@@ -357,6 +373,11 @@ def main() -> None:
     parser.add_argument("--arena-size", type=int, required=True)
     parser.add_argument("--session-dir", default="")
     args = parser.parse_args()
+    if args.session_dir:
+        # span files, debug dumps etc. land next to the session's logs.
+        # The CLI arg is authoritative: a stale env inherited from an
+        # earlier session in the same shell must not win.
+        os.environ["RAY_TPU_SESSION_DIR"] = args.session_dir
 
     logging.basicConfig(
         level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),

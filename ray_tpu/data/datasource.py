@@ -166,29 +166,12 @@ def write_block(block: Block, path: str, index: int, fmt: str) -> str:
 # decodes bytes_list/float_list/int64_list features without the protobuf
 # runtime (the environment does not pin tensorflow).
 
-_CRC_TABLE = None
-
-
-def _crc32c(data: bytes) -> int:
-    global _CRC_TABLE
-    if _CRC_TABLE is None:
-        poly = 0x82F63B78
-        table = []
-        for i in range(256):
-            c = i
-            for _ in range(8):
-                c = (c >> 1) ^ poly if c & 1 else c >> 1
-            table.append(c)
-        _CRC_TABLE = table
-    crc = 0xFFFFFFFF
-    for b in data:
-        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
-    return crc ^ 0xFFFFFFFF
-
-
 def _masked_crc(data: bytes) -> int:
-    crc = _crc32c(data)
-    return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+    # native slice-by-8 CRC32C when the toolchain is present (MB-scale
+    # payload checksums are the write path's hot loop), python otherwise
+    from ray_tpu._native.codec import masked_crc32c
+
+    return masked_crc32c(data)
 
 
 def _read_varint(buf: bytes, pos: int):
@@ -262,10 +245,9 @@ def _parse_example(buf: bytes):
                             values.append(_struct.unpack("<f", lv)[0])
                     elif tf_ == 3:                # int64_list
                         if lw == 2:
-                            pos = 0
-                            while pos < len(lv):
-                                x, pos = _read_varint(lv, pos)
-                                values.append(_to_int64(x))
+                            from ray_tpu._native.codec import varint_decode
+
+                            values.extend(varint_decode(lv))
                         else:
                             values.append(_to_int64(lv))
             out[name] = values
@@ -353,7 +335,9 @@ def _encode_example(row: Dict[str, Any]) -> bytes:
             lst = _encode_field(1, 2, _encode_varint(len(packed)) + packed)
             feature = _encode_field(2, 2, _encode_varint(len(lst)) + lst)
         else:
-            packed = b"".join(_encode_varint(int(v)) for v in vals)
+            from ray_tpu._native.codec import varint_encode
+
+            packed = varint_encode([int(v) for v in vals])
             lst = _encode_field(1, 2, _encode_varint(len(packed)) + packed)
             feature = _encode_field(3, 2, _encode_varint(len(lst)) + lst)
         entry = (_encode_field(1, 2, _encode_varint(len(name.encode()))

@@ -6,7 +6,8 @@ EnvRunner/EnvRunnerGroup (sampling actors) + Algorithm drivers
 architecture mapping to the reference.
 """
 
-from ray_tpu.rllib.algorithms import (BC, DQN, IMPALA, MARWIL, PPO, SAC,
+from ray_tpu.rllib.algorithms import (APPO, BC, DQN, IMPALA, MARWIL, PPO,
+                                      SAC, APPOConfig,
                                       Algorithm, AlgorithmConfig, BCConfig,
                                       DQNConfig, IMPALAConfig, MARWILConfig,
                                       PPOConfig, SACConfig)
@@ -25,6 +26,8 @@ __all__ = [
     "PPOConfig",
     "IMPALA",
     "IMPALAConfig",
+    "APPO",
+    "APPOConfig",
     "DQN",
     "DQNConfig",
     "SAC",

@@ -2,6 +2,7 @@ from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.algorithms.marwil import BC, BCConfig, MARWIL, MARWILConfig
@@ -13,6 +14,8 @@ __all__ = [
     "PPOConfig",
     "IMPALA",
     "IMPALAConfig",
+    "APPO",
+    "APPOConfig",
     "DQN",
     "DQNConfig",
     "SAC",

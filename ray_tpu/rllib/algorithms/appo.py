@@ -1,0 +1,99 @@
+"""APPO — asynchronous PPO (IMPALA architecture + clipped surrogate).
+
+Analog of `rllib/algorithms/appo/appo.py`: keeps IMPALA's asynchronous
+actor-learner loop and V-trace off-policy correction, but replaces the
+plain policy-gradient term with PPO's clipped surrogate (ratio against the
+behavior policy that produced the rollout) plus an optional KL penalty
+toward the behavior distribution. The reference's periodically-updated
+target network is subsumed here by the behavior anchor carried in the
+batch (`logp`), which V-trace already requires — one anchor, not two.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self):
+        super().__init__()
+        self.clip_param: float = 0.2
+        self.use_kl_loss: bool = False
+        self.kl_coeff: float = 1.0
+        self.kl_target: float = 0.01
+        self.lr = 3e-4
+
+
+class APPO(IMPALA):
+    @classmethod
+    def get_default_config(cls) -> APPOConfig:
+        return APPOConfig()
+
+    @staticmethod
+    def loss_fn(module, params, batch, cfg):
+        """V-trace advantages under PPO's clipped surrogate
+        (`appo_torch_learner.py` parity, re-based on the jitted V-trace)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.utils.advantages import vtrace_returns
+
+        obs = batch["obs"]                      # [B, T, D]
+        B, T = obs.shape[0], obs.shape[1]
+        logits, values = module.forward_train(
+            params, obs.reshape(B * T, -1))
+        logp_all = jax.nn.log_softmax(logits)
+        actions = batch["actions"].reshape(B * T)
+        logp = jnp.take_along_axis(
+            logp_all, actions[:, None], axis=-1)[:, 0]
+
+        tm = lambda x: x.reshape(B, T).T
+        target_logp_tm = tm(logp)
+        behavior_logp_tm = tm(batch["logp"])
+        values_tm = tm(values)
+        _, bootstrap_value = module.forward_train(
+            params, batch["bootstrap_obs"])
+
+        vs, pg_adv = vtrace_returns(
+            behavior_logp_tm, target_logp_tm,
+            tm(batch["rewards"]).astype(jnp.float32), values_tm,
+            bootstrap_value, tm(batch["terminateds"]),
+            tm(batch["truncateds"]),
+            gamma=cfg["gamma"], clip_rho=cfg["clip_rho"],
+            clip_c=cfg["clip_c"])
+        vs = jax.lax.stop_gradient(vs)
+        pg_adv = jax.lax.stop_gradient(pg_adv)
+
+        # PPO clipped surrogate with the behavior policy as the anchor
+        ratio = jnp.exp(target_logp_tm - behavior_logp_tm)
+        clip = cfg["clip_param"]
+        surrogate = jnp.minimum(
+            ratio * pg_adv,
+            jnp.clip(ratio, 1.0 - clip, 1.0 + clip) * pg_adv)
+        pi_loss = -jnp.mean(surrogate)
+
+        vf_loss = 0.5 * jnp.mean((values_tm - vs) ** 2)
+        probs = jax.nn.softmax(logits)
+        entropy = -jnp.mean(jnp.sum(probs * logp_all, axis=-1))
+        # K3 KL estimator vs the behavior policy
+        kl = jnp.mean(jnp.exp(behavior_logp_tm - target_logp_tm)
+                      - (behavior_logp_tm - target_logp_tm) - 1.0)
+        total = (pi_loss + cfg["vf_loss_coeff"] * vf_loss
+                 - cfg["entropy_coeff"] * entropy)
+        if cfg["use_kl_loss"]:
+            total = total + cfg["kl_coeff"] * kl
+        return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                       "entropy": entropy, "mean_kl": kl}
+
+    def _loss_cfg(self) -> Dict[str, float]:
+        cfg: APPOConfig = self.config
+        out = super()._loss_cfg()
+        out.update({"clip_param": cfg.clip_param,
+                    "use_kl_loss": cfg.use_kl_loss,
+                    "kl_coeff": cfg.kl_coeff})
+        return out
+
+
+APPOConfig.algo_class = APPO

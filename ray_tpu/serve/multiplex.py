@@ -65,22 +65,24 @@ def multiplexed(max_num_models_per_replica: int = 3) -> Callable:
             fut = loading.get(model_id)
             if fut is None:
                 async def do_load():
-                    out = (load_fn(owner, model_id) if is_method
-                           else load_fn(model_id))
-                    if inspect.isawaitable(out):
-                        out = await out
-                    return out
+                    try:
+                        out = (load_fn(owner, model_id) if is_method
+                               else load_fn(model_id))
+                        if inspect.isawaitable(out):
+                            out = await out
+                        # cache inside the load task: the result must land
+                        # even if every waiter was cancelled meanwhile
+                        cache[model_id] = out
+                        while len(cache) > max_num_models_per_replica:
+                            cache.popitem(last=False)  # evict LRU; GC unloads
+                        return out
+                    finally:
+                        loading.pop(model_id, None)
 
                 fut = asyncio.ensure_future(do_load())
                 loading[model_id] = fut
-                try:
-                    out = await fut
-                finally:
-                    loading.pop(model_id, None)
-                cache[model_id] = out
-                while len(cache) > max_num_models_per_replica:
-                    cache.popitem(last=False)   # evict LRU; GC unloads
-                return out
+            # every waiter (leader included) shields: one cancelled request
+            # must not cancel the shared load out from under the others
             return await asyncio.shield(fut)
 
         if is_method:

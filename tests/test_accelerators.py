@@ -36,12 +36,14 @@ class TestGKEEnvDiscovery:
         monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5p-64")
         monkeypatch.setenv("TPU_WORKER_ID", "0")
         r0 = accelerators.tpu_pod_resources()
-        assert r0.get("TPU-v5p-64-head") == 1.0
+        # chip-normalized name (v5p-64 = 64 cores = 32 chips) — must match
+        # SliceTopology.head_resource, the name slice gangs demand
+        assert r0.get("TPU-v5p-32-head") == 1.0
         assert r0.get("accelerator_type:TPU-v5p") == 1.0
 
         monkeypatch.setenv("TPU_WORKER_ID", "2")
         r2 = accelerators.tpu_pod_resources()
-        assert "TPU-v5p-64-head" not in r2
+        assert not any(k.endswith("-head") for k in r2)
         assert r2.get("accelerator_type:TPU-v5p") == 1.0
 
     def test_single_host_slice_is_its_own_head(self, monkeypatch):
@@ -91,7 +93,7 @@ class TestMetadataFallback:
             assert accelerators.get_current_pod_worker_id() == 1
             # worker 1: label but no head resource
             res = accelerators.tpu_pod_resources()
-            assert "TPU-v4-16-head" not in res
+            assert not any(k.endswith("-head") for k in res)
             assert res.get("accelerator_type:TPU-v4") == 1.0
         finally:
             srv.shutdown()
@@ -113,7 +115,7 @@ class TestNodeResourceWiring:
         monkeypatch.setenv("TPU_WORKER_ID", "0")
         rs = detect_node_resources()
         assert rs["TPU"] == 4.0                   # chips/host from topology
-        assert rs["TPU-v5p-64-head"] == 1.0
+        assert rs["TPU-v5p-32-head"] == 1.0       # SliceTopology naming
         assert rs["accelerator_type:TPU-v5p"] == 1.0
 
     def test_visible_chips_isolation_wins(self, monkeypatch):
@@ -126,3 +128,16 @@ class TestNodeResourceWiring:
         rs = detect_node_resources()
         assert "TPU" not in rs
         assert not any(k.startswith("TPU-") for k in rs)
+
+
+def test_head_resource_matches_slice_topology(monkeypatch):
+    """The discovery-side gang resource must be the exact name slice
+    placement groups demand (cross-module contract with parallel/slices)."""
+    from ray_tpu.parallel.slices import SliceTopology
+
+    for accel in ("v5p-64", "v4-8", "v5litepod-8", "v5litepod-16"):
+        monkeypatch.setenv("TPU_ACCELERATOR_TYPE", accel)
+        monkeypatch.setenv("TPU_WORKER_ID", "0")
+        res = accelerators.tpu_pod_resources()
+        expected = SliceTopology.parse(accel).head_resource
+        assert res.get(expected) == 1.0, (accel, res)

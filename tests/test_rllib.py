@@ -335,3 +335,66 @@ class TestAtariShapedPPO:
         assert out["metric"] == "ppo_atari_env_steps_per_sec"
         assert out["value"] > 0
         assert out["detail"]["total_steps"] == 2 * 8 * 4
+
+
+class TestAPPO:
+    def test_learns_cartpole_local(self):
+        """APPO (IMPALA loop + clipped surrogate) should learn CartPole
+        at least as reliably as plain IMPALA."""
+        from ray_tpu.rllib import APPOConfig
+
+        algo = (APPOConfig()
+                .environment("CartPole-v1")
+                .env_runners(num_env_runners=0,
+                             num_envs_per_env_runner=8,
+                             rollout_fragment_length=64)
+                .training(num_batches_per_iteration=8,
+                          entropy_coeff=0.005)
+                .debugging(seed=0)
+                .build())
+        try:
+            best = 0.0
+            for _ in range(60):
+                r = algo.train()
+                ret = r.get("episode_return_mean")
+                if ret is not None:
+                    best = max(best, ret)
+                if best >= 150:
+                    break
+            assert best >= 150, f"best return {best}"
+            assert "mean_kl" in r
+        finally:
+            algo.stop()
+
+    def test_clip_anchors_update(self):
+        """With an adversarially large advantage, the clipped ratio must
+        bound the surrogate (the PPO-over-IMPALA difference)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ray_tpu.rllib import APPO
+        from ray_tpu.rllib.core.rl_module import RLModule, RLModuleSpec
+
+        spec = RLModuleSpec(obs_dim=4, num_actions=2, hiddens=(8,))
+        module = RLModule(spec)
+        params = module.init_params(jax.random.PRNGKey(0))
+        B, T = 2, 4
+        rng = np.random.default_rng(0)
+        batch = {
+            "obs": rng.normal(size=(B, T, 4)).astype(np.float32),
+            "actions": rng.integers(0, 2, (B, T)),
+            # behavior logp far below current policy: ratio >> 1+clip
+            "logp": np.full((B, T), -10.0, np.float32),
+            "rewards": np.ones((B, T), np.float32),
+            "terminateds": np.zeros((B, T), bool),
+            "truncateds": np.zeros((B, T), bool),
+            "bootstrap_obs": rng.normal(size=(B, 4)).astype(np.float32),
+        }
+        cfg = {"gamma": 0.99, "clip_rho": 1.0, "clip_c": 1.0,
+               "vf_loss_coeff": 0.5, "entropy_coeff": 0.0,
+               "clip_param": 0.2, "use_kl_loss": False, "kl_coeff": 1.0}
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        loss, metrics = APPO.loss_fn(module, params, batch, cfg)
+        assert np.isfinite(float(loss))
+        assert float(metrics["mean_kl"]) >= 0.0

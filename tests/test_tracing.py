@@ -1,0 +1,101 @@
+"""Distributed tracing: span context propagation across task/actor
+boundaries and the cross-process span collection. Mirrors the role of
+`python/ray/tests/test_tracing.py`."""
+
+import json
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import tracing
+
+
+@pytest.fixture
+def traced(ray_init):
+    tracing.enable()
+    yield
+    tracing.disable()
+
+
+class TestLocalSpans:
+    def test_nested_spans_share_trace(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_SESSION_DIR", str(tmp_path))
+        captured = []
+        tracing.enable(exporter=captured.append)
+        try:
+            with tracing.span("outer"):
+                with tracing.span("inner"):
+                    pass
+        finally:
+            tracing.disable()
+        assert [s["name"] for s in captured] == ["inner", "outer"]
+        inner, outer = captured
+        assert inner["trace_id"] == outer["trace_id"]
+        assert inner["parent_id"] == outer["span_id"]
+        assert outer["parent_id"] is None
+
+    def test_disabled_is_noop(self):
+        tracing.disable()
+        with tracing.span("nothing") as ctx:
+            assert ctx is None
+        assert tracing.context_for_submission() is None
+
+
+class TestCrossProcess:
+    def test_task_spans_stitch_to_driver_trace(self, traced):
+        session_dir = os.environ.get("RAY_TPU_SESSION_DIR", "")
+        assert session_dir
+
+        @ray_tpu.remote
+        def leaf(x):
+            return x + 1
+
+        @ray_tpu.remote
+        def mid(x):
+            # nested submission inside a worker: grandchild spans
+            return ray_tpu.get(leaf.remote(x)) + 1
+
+        with tracing.span("driver_op") as ctx:
+            out = ray_tpu.get(mid.remote(1))
+        assert out == 3
+
+        spans = tracing.collect_spans(session_dir)
+        trace = [s for s in spans if s["trace_id"] == ctx["trace_id"]]
+        # task span names carry the function qualname
+        mid_span = next(s for s in trace
+                        if s["name"].startswith("task::")
+                        and s["name"].endswith("mid"))
+        leaf_span = next(s for s in trace
+                         if s["name"].startswith("task::")
+                         and s["name"].endswith("leaf"))
+        assert mid_span["parent_id"] == ctx["span_id"]
+        assert leaf_span["parent_id"] == mid_span["span_id"]
+
+    def test_actor_method_spans(self, traced):
+        session_dir = os.environ["RAY_TPU_SESSION_DIR"]
+
+        @ray_tpu.remote
+        class A:
+            def hit(self):
+                return "ok"
+
+        a = A.remote()
+        with tracing.span("actor_call") as ctx:
+            assert ray_tpu.get(a.hit.remote()) == "ok"
+        spans = tracing.collect_spans(session_dir)
+        mine = [s for s in spans if s["trace_id"] == ctx["trace_id"]]
+        assert any(s["name"] == "actor::hit" for s in mine)
+        ray_tpu.kill(a)
+
+    def test_chrome_trace_export(self, traced):
+        @ray_tpu.remote
+        def t():
+            return 1
+
+        with tracing.span("root"):
+            ray_tpu.get(t.remote())
+        spans = tracing.collect_spans(os.environ["RAY_TPU_SESSION_DIR"])
+        events = tracing.to_chrome_trace(spans)
+        assert events and all(e["ph"] == "X" for e in events)
+        json.dumps(events)  # must serialize cleanly
