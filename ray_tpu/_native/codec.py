@@ -77,7 +77,10 @@ def varint_decode(buf: bytes, max_count: Optional[int] = None) -> List[int]:
         return list(out[:n])
     vals, pos = [], 0
     while pos < len(buf) and (max_count is None or len(vals) < max_count):
-        x, pos = _py_read_varint(buf, pos)
+        try:
+            x, pos = _py_read_varint(buf, pos)
+        except (IndexError, OverflowError):
+            raise ValueError("truncated varint stream") from None
         if x >= 1 << 63:
             x -= 1 << 64
         vals.append(x)
@@ -129,3 +132,5 @@ def _py_read_varint(buf: bytes, pos: int):
         if not b & 0x80:
             return result, pos
         shift += 7
+        if shift >= 64:  # overlong: reject like the native path
+            raise OverflowError("varint exceeds 64 bits")

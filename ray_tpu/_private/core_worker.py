@@ -154,6 +154,9 @@ class CoreWorker:
         self._task_queues: Dict[str, deque] = {}
         self._inflight_tasks: Dict[TaskID, _PendingTask] = {}
         self._actor_states: Dict[str, ActorHandleState] = {}
+        # per-actor FIFO locks ordering seqno assignment (see
+        # _async_submit_actor_task)
+        self._actor_submit_locks: Dict[str, asyncio.Lock] = {}
         self._actor_events: Dict[str, asyncio.Event] = {}
         self._pub_handlers: Dict[str, List[Callable]] = {}
         self._task_events: deque = deque()
@@ -226,6 +229,27 @@ class CoreWorker:
         """Run a coroutine on the IO loop from any user thread."""
         fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
         return fut.result(timeout)
+
+    def _run_nowait(self, coro) -> None:
+        """Fire a coroutine onto the IO loop WITHOUT blocking the caller.
+
+        Submission latency is the core throughput ceiling: a blocking
+        round trip per `.remote()` costs two thread hops (~8ms measured)
+        and serializes bursts. Ordering stays safe: any later `get`/`wait`
+        on the returned refs also enters the loop via
+        run_coroutine_threadsafe, whose ready-queue is FIFO, so the
+        submission coroutine runs first."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+        def _surface(f):
+            try:
+                exc = f.exception()
+            except asyncio.CancelledError:
+                return
+            if exc is not None:
+                logger.error("async submission failed: %r", exc)
+
+        fut.add_done_callback(_surface)
 
     # ------------------------------------------------------------- functions
 
@@ -316,8 +340,22 @@ class CoreWorker:
 
         spec.trace_ctx = tracing.context_for_submission()
         return_ids = spec.return_ids()
-        self._run(self._async_submit(spec))
+        self._run_nowait(self._guarded_submit(spec, self._async_submit(spec)))
         return return_ids
+
+    async def _guarded_submit(self, spec: TaskSpec, coro) -> None:
+        """Submission runs detached from the caller (`_run_nowait`), so a
+        failure must fail the task's return refs — the caller already holds
+        them, and a swallowed exception would turn get() into a hang."""
+        try:
+            await coro
+        except Exception as e:  # noqa: BLE001 — surfaces via the refs
+            logger.error("submission of %s failed: %r", spec.name, e)
+            for oid in spec.return_ids():
+                self._ensure_entry(oid)
+            self._fail_task(spec, RuntimeError(
+                f"task submission failed: {e!r}"))
+            self._inflight_tasks.pop(spec.task_id, None)
 
     async def _async_submit(self, spec: TaskSpec) -> None:
         for oid in spec.return_ids():
@@ -1264,7 +1302,8 @@ class CoreWorker:
 
         spec.trace_ctx = tracing.context_for_submission()
         return_ids = spec.return_ids()
-        self._run(self._async_submit_actor_task(spec))
+        self._run_nowait(
+            self._guarded_submit(spec, self._async_submit_actor_task(spec)))
         return return_ids
 
     async def _async_submit_actor_task(self, spec: TaskSpec) -> None:
@@ -1272,9 +1311,18 @@ class CoreWorker:
         for oid in spec.return_ids():
             self._ensure_entry(oid)
         self._pin_arg_refs(spec)
-        state = await self.actor_state(spec.actor_id)
-        spec.seqno = state.seqno
-        state.seqno += 1
+        # seqno assignment must follow submission order even though the
+        # first actor_state() call suspends (controller subscribe RPC):
+        # asyncio.Lock is FIFO-fair, and submission coroutines start in
+        # .remote() order, so the lock hands out seqnos in that order.
+        lock = self._actor_submit_locks.get(spec.actor_id.hex())
+        if lock is None:
+            lock = self._actor_submit_locks[spec.actor_id.hex()] = (
+                asyncio.Lock())
+        async with lock:
+            state = await self.actor_state(spec.actor_id)
+            spec.seqno = state.seqno
+            state.seqno += 1
         pending = _PendingTask(spec, retries_left=spec.max_retries)
         self._inflight_tasks[spec.task_id] = pending
         asyncio.get_running_loop().create_task(self._actor_push(pending, state))

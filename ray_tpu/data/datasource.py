@@ -175,14 +175,10 @@ def _masked_crc(data: bytes) -> int:
 
 
 def _read_varint(buf: bytes, pos: int):
-    result = shift = 0
-    while True:
-        b = buf[pos]
-        pos += 1
-        result |= (b & 0x7F) << shift
-        if not b & 0x80:
-            return result, pos
-        shift += 7
+    # shared primitive (native codec's python fallback) — one copy
+    from ray_tpu._native.codec import _py_read_varint
+
+    return _py_read_varint(buf, pos)
 
 
 def _parse_proto_fields(buf: bytes):
@@ -296,17 +292,9 @@ def tfrecord_tasks(paths) -> List[Callable[[], Block]]:
 
 
 def _encode_varint(x: int) -> bytes:
-    if x < 0:
-        x += 1 << 64  # proto int64: two's-complement as unsigned varint
-    out = bytearray()
-    while True:
-        b = x & 0x7F
-        x >>= 7
-        if x:
-            out.append(b | 0x80)
-        else:
-            out.append(b)
-            return bytes(out)
+    from ray_tpu._native.codec import _py_encode_varint
+
+    return _py_encode_varint(x)
 
 
 def _encode_field(field: int, wire: int, payload: bytes) -> bytes:

@@ -27,9 +27,33 @@ class APPOConfig(IMPALAConfig):
 
 
 class APPO(IMPALA):
+    def __init__(self, config: APPOConfig):
+        super().__init__(config)
+        self._kl_coeff = float(config.kl_coeff)
+
     @classmethod
     def get_default_config(cls) -> APPOConfig:
         return APPOConfig()
+
+    def training_step(self):
+        metrics = super().training_step()
+        # adaptive KL toward kl_target (reference APPO.update_kl), only
+        # meaningful when the KL penalty is in the loss
+        cfg: APPOConfig = self.config
+        if cfg.use_kl_loss and metrics:
+            kl = metrics.get("mean_kl", 0.0)
+            if kl > 2.0 * cfg.kl_target:
+                self._kl_coeff *= 1.5
+            elif kl < 0.5 * cfg.kl_target:
+                self._kl_coeff *= 0.5
+            metrics["kl_coeff"] = self._kl_coeff
+        return metrics
+
+    def _extra_state(self):
+        return {"kl_coeff": self._kl_coeff}
+
+    def _set_extra_state(self, extra):
+        self._kl_coeff = float(extra.get("kl_coeff", self._kl_coeff))
 
     @staticmethod
     def loss_fn(module, params, batch, cfg):
@@ -83,7 +107,9 @@ class APPO(IMPALA):
         total = (pi_loss + cfg["vf_loss_coeff"] * vf_loss
                  - cfg["entropy_coeff"] * entropy)
         if cfg["use_kl_loss"]:
-            total = total + cfg["kl_coeff"] * kl
+            # adaptive coefficient rides in the batch (PPO pattern): a
+            # changing scalar in cfg would re-key the jit cache
+            total = total + jnp.mean(batch["kl_coeff"]) * kl
         return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
                        "entropy": entropy, "mean_kl": kl}
 
@@ -91,9 +117,17 @@ class APPO(IMPALA):
         cfg: APPOConfig = self.config
         out = super()._loss_cfg()
         out.update({"clip_param": cfg.clip_param,
-                    "use_kl_loss": cfg.use_kl_loss,
-                    "kl_coeff": cfg.kl_coeff})
+                    "use_kl_loss": cfg.use_kl_loss})
         return out
+
+    def _to_column_major(self, s):
+        batch = super()._to_column_major(s)
+        if self.config.use_kl_loss:
+            import numpy as np
+
+            batch["kl_coeff"] = np.full(
+                batch["rewards"].shape[0], self._kl_coeff, np.float32)
+        return batch
 
 
 APPOConfig.algo_class = APPO

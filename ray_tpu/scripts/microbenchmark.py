@@ -1,0 +1,161 @@
+"""Core-runtime microbenchmark suite.
+
+Analog of `ray microbenchmark` (`python/ray/_private/ray_perf.py:93-180`):
+ops/s for the hot core paths — put/get of small objects, large-object
+store throughput, sync/async task submission, sync/async actor calls, and
+`wait` over a thousand refs. Run against a live cluster:
+
+    python -m ray_tpu.scripts.microbenchmark [--num-cpus N] [--json]
+
+Each benchmark runs for a fixed wall budget and reports ops/s; `--json`
+prints one machine-readable line per benchmark (the driver-side record
+for BENCH artifacts).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Callable, Dict, List
+
+import numpy as np
+
+
+def _rate(fn: Callable[[], int], budget_s: float = 2.0,
+          warmup: int = 1) -> float:
+    """ops/s of fn() (which returns how many ops it performed)."""
+    for _ in range(warmup):
+        fn()
+    done = 0
+    t0 = time.perf_counter()
+    while True:
+        done += fn()
+        dt = time.perf_counter() - t0
+        if dt >= budget_s:
+            return done / dt
+
+
+def run_all(budget_s: float = 2.0) -> List[Dict[str, float]]:
+    import ray_tpu
+
+    results: List[Dict[str, float]] = []
+
+    def record(name: str, ops_s: float, unit: str = "ops/s"):
+        results.append({"benchmark": name, "value": round(ops_s, 1),
+                        "unit": unit})
+
+    # -- single client put, small objects
+    def put_small():
+        for _ in range(100):
+            ray_tpu.put(b"x" * 100)
+        return 100
+
+    record("single_client_put_small", _rate(put_small, budget_s))
+
+    # -- single client get, small objects
+    refs = [ray_tpu.put(b"y" * 100) for _ in range(100)]
+
+    def get_small():
+        for r in refs:
+            ray_tpu.get(r)
+        return 100
+
+    record("single_client_get_small", _rate(get_small, budget_s))
+
+    # -- put gigabytes/s (10MB numpy through the shm arena)
+    big = np.random.bytes(10 * 1024 * 1024)
+
+    def put_big():
+        for _ in range(4):
+            ray_tpu.put(big)
+        return 4
+
+    gbs = _rate(put_big, budget_s) * 10 / 1024
+    results.append({"benchmark": "single_client_put_gigabytes",
+                    "value": round(gbs, 3), "unit": "GiB/s"})
+
+    # -- tasks, synchronous round-trips
+    @ray_tpu.remote
+    def nop():
+        return 0
+
+    def tasks_sync():
+        for _ in range(20):
+            ray_tpu.get(nop.remote())
+        return 20
+
+    record("single_client_tasks_sync", _rate(tasks_sync, budget_s))
+
+    # -- tasks, pipelined (batch submit then drain)
+    def tasks_async():
+        ray_tpu.get([nop.remote() for _ in range(200)])
+        return 200
+
+    record("single_client_tasks_async", _rate(tasks_async, budget_s))
+
+    # -- actor calls, synchronous
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return 0
+
+    a = A.remote()
+    ray_tpu.get(a.m.remote())
+
+    def actor_sync():
+        for _ in range(20):
+            ray_tpu.get(a.m.remote())
+        return 20
+
+    record("single_client_actor_calls_sync", _rate(actor_sync, budget_s))
+
+    # -- actor calls, pipelined
+    def actor_async():
+        ray_tpu.get([a.m.remote() for _ in range(200)])
+        return 200
+
+    record("single_client_actor_calls_async", _rate(actor_async, budget_s))
+
+    # -- wait over 1k plasma refs (the reference's scalability probe)
+    refs_1k = [ray_tpu.put(i) for i in range(1000)]
+
+    def wait_1k():
+        ready, _ = ray_tpu.wait(refs_1k, num_returns=1000, timeout=30)
+        assert len(ready) == 1000
+        return 1
+
+    record("single_client_wait_1k_refs", _rate(wait_1k, budget_s),
+           unit="waits/s")
+
+    ray_tpu.kill(a)
+    return results
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description="core microbenchmarks")
+    parser.add_argument("--num-cpus", type=int, default=8)
+    parser.add_argument("--budget-s", type=float, default=2.0)
+    parser.add_argument("--json", action="store_true")
+    args = parser.parse_args(argv)
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=args.num_cpus,
+                 object_store_memory=512 * 1024 * 1024)
+    try:
+        results = run_all(args.budget_s)
+    finally:
+        ray_tpu.shutdown()
+    if args.json:
+        for r in results:
+            print(json.dumps(r))
+    else:
+        width = max(len(r["benchmark"]) for r in results)
+        for r in results:
+            print(f"{r['benchmark']:<{width}}  {r['value']:>12,.1f} "
+                  f"{r['unit']}")
+
+
+if __name__ == "__main__":
+    main()

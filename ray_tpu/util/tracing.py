@@ -37,6 +37,7 @@ _enabled = False
 _exporter: Optional[Callable[[Dict[str, Any]], None]] = None
 _lock = threading.Lock()
 _file = None
+_file_path: Optional[str] = None
 
 
 def enable(exporter: Optional[Callable[[Dict[str, Any]], None]] = None,
@@ -66,13 +67,20 @@ def _spans_path() -> str:
 
 
 def _emit(span: Dict[str, Any]) -> None:
-    global _file
+    global _file, _file_path
     if _exporter is not None:
         _exporter(span)
         return
     with _lock:
-        if _file is None:
-            _file = open(_spans_path(), "a", buffering=1)
+        # the session dir can change after init() (spans emitted before
+        # init land in the default location) — follow it, don't cache the
+        # first resolution forever
+        path = _spans_path()
+        if _file is None or path != _file_path:
+            if _file is not None:
+                _file.close()
+            _file = open(path, "a", buffering=1)
+            _file_path = path
         _file.write(json.dumps(span) + "\n")
 
 

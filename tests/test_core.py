@@ -199,3 +199,48 @@ class TestClusterInfo:
 
         wid, nid = ray_tpu.get(whoami.remote())
         assert wid and nid
+
+
+def test_microbenchmark_smoke(ray_init):
+    """The microbenchmark harness runs every probe and returns sane rates
+    (full runs are `python -m ray_tpu.scripts.microbenchmark`)."""
+    from ray_tpu.scripts.microbenchmark import run_all
+
+    results = run_all(budget_s=0.2)
+    names = {r["benchmark"] for r in results}
+    assert "single_client_tasks_async" in names
+    assert "single_client_wait_1k_refs" in names
+    assert all(r["value"] > 0 for r in results), results
+
+
+def test_actor_order_from_fresh_handle_burst(ray_init):
+    """Rapid .remote() calls on a freshly-deserialized actor handle must
+    execute in submission order even though the first submission suspends
+    on the actor-state subscribe RPC (regression: fire-and-forget
+    submission could let call #2 grab seqno 0)."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    class Log:
+        def __init__(self):
+            self.seen = []
+
+        def add(self, v):
+            self.seen.append(v)
+            return v
+
+        def get_seen(self):
+            return list(self.seen)
+
+    @ray_tpu.remote
+    def burst(handle):
+        # inside the worker the handle is fresh: actor_state() must
+        # round-trip to the controller on the first call
+        refs = [handle.add.remote(i) for i in range(20)]
+        ray_tpu.get(refs)
+        return ray_tpu.get(handle.get_seen.remote())
+
+    a = Log.remote()
+    seen = ray_tpu.get(burst.remote(a))
+    assert seen == list(range(20)), seen
+    ray_tpu.kill(a)
