@@ -22,6 +22,14 @@ from ray_tpu.serve.multiplex import (  # noqa: F401
 )
 
 
+def start_rpc_ingress(port: int = 0) -> int:
+    """Start the binary RPC ingress (the reference's gRPC-ingress role over
+    the framework's native framing); returns the bound port."""
+    from ray_tpu.serve._private.rpc_ingress import start_rpc_ingress as _s
+
+    return _s(port)
+
+
 @dataclasses.dataclass
 class AutoscalingConfig:
     """Analog of `ray.serve.config.AutoscalingConfig`."""
@@ -225,7 +233,7 @@ def shutdown() -> None:
         ray_tpu.get(controller.graceful_shutdown.remote())
     except Exception:
         pass
-    for actor_name in ("SERVE_PROXY", CONTROLLER_NAME):
+    for actor_name in ("SERVE_PROXY", "SERVE_RPC_INGRESS", CONTROLLER_NAME):
         try:
             ray_tpu.kill(ray_tpu.get_actor(actor_name))
         except Exception:

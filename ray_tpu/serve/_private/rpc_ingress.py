@@ -1,0 +1,116 @@
+"""Binary RPC ingress — the second (non-HTTP) ingress protocol.
+
+Role-parity with the reference's gRPC ingress (`python/ray/serve/
+grpc_util.py` + proxy gRPC service): a length-prefixed binary protocol for
+low-overhead programmatic clients, speaking the framework's native RPC
+framing (`_private/rpc.py`) instead of gRPC — the control plane's stance
+(no proto toolchain; this image ships no grpcio) applied to the ingress.
+
+Server: an actor that routes `invoke` frames to applications by name and
+streams chunked responses for generator endpoints.
+
+Client:
+    from ray_tpu.serve.rpc_ingress_client import ServeRpcClient
+    c = ServeRpcClient("host:port")
+    c.invoke("default", {"prompt": "hi"})        # -> result
+    for tok in c.invoke_stream("llm", {...}):    # -> chunks
+        ...
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Dict, Optional
+
+import ray_tpu
+
+logger = logging.getLogger(__name__)
+
+
+class RpcIngressActor:
+    """Async actor hosting an RpcServer; `invoke` routes to app handles
+    through the same pow-2 router as every other caller."""
+
+    def __init__(self, controller, port: int = 0):
+        self._controller = controller
+        self._port = port
+        self._server = None
+        self._handles: Dict[str, Any] = {}
+        self._started = asyncio.Event()
+
+    async def ready(self) -> int:
+        if self._server is None:
+            from ray_tpu._private.rpc import RpcServer
+
+            self._server = RpcServer(host="0.0.0.0", port=self._port)
+            self._server.register("invoke", self._invoke)
+            self._server.register("stream_next", self._stream_next)
+            addr = await self._server.start()
+            self._port = addr[1]
+            self._started.set()
+            logger.info("serve rpc ingress on :%d", self._port)
+        else:
+            await self._started.wait()
+        return self._port
+
+    async def _handle_for(self, app: str):
+        h = self._handles.get(app)
+        if h is None:
+            routes = await self._controller.get_routes.remote()
+            target = None
+            for dest in routes.values():
+                app_name, dep = dest.split("/", 1)
+                if app_name == app:
+                    target = (app_name, dep)
+                    break
+            if target is None:
+                raise ValueError(f"no application named {app!r}")
+            from ray_tpu.serve.handle import DeploymentHandle
+
+            h = DeploymentHandle(target[0], target[1], self._controller)
+            self._handles[app] = h
+        return h
+
+    async def _invoke(self, body: Dict[str, Any]):
+        from ray_tpu.serve.handle import STREAM_MARKER
+
+        h = await self._handle_for(body["app"])
+        if body.get("multiplexed_model_id"):
+            h = h.options(
+                multiplexed_model_id=body["multiplexed_model_id"])
+        method = body.get("method") or "__call__"
+        args = body.get("args") or [body.get("payload")]
+        # router does blocking controller lookups: keep them off this loop
+        resp = await asyncio.to_thread(
+            lambda: h._call(method, tuple(args), body.get("kwargs") or {}))
+        out = await resp
+        if isinstance(out, dict) and STREAM_MARKER in out:
+            sid = out[STREAM_MARKER]
+            self._handles[f"__stream_{sid}"] = resp._replica
+            return {"stream": sid}
+        return {"result": out}
+
+    async def _stream_next(self, body: Dict[str, Any]):
+        replica = self._handles.get(f"__stream_{body['stream']}")
+        if replica is None:
+            return {"items": [], "done": True}
+        chunk = await replica.stream_next.remote(body["stream"])
+        if chunk.get("done"):
+            self._handles.pop(f"__stream_{body['stream']}", None)
+        return chunk
+
+
+def start_rpc_ingress(port: int = 0) -> int:
+    """Start (or find) the cluster's RPC ingress; returns the bound port.
+    ≈ `serve.start(grpc_options=...)` in the reference."""
+    from ray_tpu.serve import _get_or_create_controller
+
+    controller = _get_or_create_controller()
+    try:
+        actor = ray_tpu.get_actor("SERVE_RPC_INGRESS")
+    except Exception:
+        actor = ray_tpu.remote(RpcIngressActor).options(
+            name="SERVE_RPC_INGRESS", lifetime="detached", num_cpus=0.1,
+            max_concurrency=256).remote(controller, port)
+    return ray_tpu.get(actor.ready.remote())

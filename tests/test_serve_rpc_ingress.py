@@ -1,0 +1,69 @@
+"""Binary RPC ingress: unary + streaming invocation and multiplexed
+routing through the native-framing protocol (the reference's gRPC-ingress
+role; `serve/_private/rpc_ingress.py`)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve.rpc_ingress_client import ServeRpcClient
+
+
+@pytest.fixture
+def serve_shutdown(ray_init):
+    yield
+    serve.shutdown()
+
+
+class TestRpcIngress:
+    def test_unary_invoke(self, serve_shutdown):
+        @serve.deployment
+        def double(x):
+            return {"y": x["v"] * 2}
+
+        serve.run(double.bind(), name="calc")
+        port = serve.start_rpc_ingress()
+        c = ServeRpcClient(f"127.0.0.1:{port}")
+        try:
+            assert c.invoke("calc", {"v": 21}) == {"y": 42}
+            with pytest.raises(Exception, match="no application"):
+                c.invoke("missing", {})
+        finally:
+            c.close()
+
+    def test_streaming_invoke(self, serve_shutdown):
+        @serve.deployment
+        def tokens(req):
+            def gen():
+                for i in range(int(req["n"])):
+                    yield f"tok{i} "
+            return gen()
+
+        serve.run(tokens.bind(), name="stream")
+        port = serve.start_rpc_ingress()
+        c = ServeRpcClient(f"127.0.0.1:{port}")
+        try:
+            out = list(c.invoke_stream("stream", {"n": 5}))
+            assert out == [f"tok{i} " for i in range(5)]
+        finally:
+            c.close()
+
+    def test_multiplexed_invoke(self, serve_shutdown):
+        @serve.deployment
+        class Mux:
+            @serve.multiplexed(max_num_models_per_replica=2)
+            async def get(self, mid):
+                return mid.upper()
+
+            async def __call__(self, x):
+                m = await self.get(serve.get_multiplexed_model_id())
+                return {"model": m}
+
+        serve.run(Mux.bind(), name="mux")
+        port = serve.start_rpc_ingress()
+        c = ServeRpcClient(f"127.0.0.1:{port}")
+        try:
+            out = c.invoke("mux", {}, multiplexed_model_id="gemma")
+            assert out == {"model": "GEMMA"}
+        finally:
+            c.close()
