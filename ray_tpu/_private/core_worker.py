@@ -340,13 +340,21 @@ class CoreWorker:
 
         spec.trace_ctx = tracing.context_for_submission()
         return_ids = spec.return_ids()
-        self._run_nowait(self._guarded_submit(spec, self._async_submit(spec)))
+        self._run_nowait(self._guarded_submit(
+            spec, self._async_submit(spec), (tuple(args), kwargs)))
         return return_ids
 
-    async def _guarded_submit(self, spec: TaskSpec, coro) -> None:
+    async def _guarded_submit(self, spec: TaskSpec, coro,
+                              arg_holders=None) -> None:
         """Submission runs detached from the caller (`_run_nowait`), so a
         failure must fail the task's return refs — the caller already holds
-        them, and a swallowed exception would turn get() into a hang."""
+        them, and a swallowed exception would turn get() into a hang.
+
+        `arg_holders` keeps the caller's ObjectRef arguments alive until
+        the submission coroutine has pinned them (`_pin_arg_refs` runs
+        before its first await): without it, a caller that drops its last
+        reference right after `.remote()` races the deferred pin and the
+        owner frees the object first ("owner does not know this object")."""
         try:
             await coro
         except Exception as e:  # noqa: BLE001 — surfaces via the refs
@@ -356,6 +364,8 @@ class CoreWorker:
             self._fail_task(spec, RuntimeError(
                 f"task submission failed: {e!r}"))
             self._inflight_tasks.pop(spec.task_id, None)
+        finally:
+            del arg_holders
 
     async def _async_submit(self, spec: TaskSpec) -> None:
         for oid in spec.return_ids():
@@ -1302,8 +1312,9 @@ class CoreWorker:
 
         spec.trace_ctx = tracing.context_for_submission()
         return_ids = spec.return_ids()
-        self._run_nowait(
-            self._guarded_submit(spec, self._async_submit_actor_task(spec)))
+        self._run_nowait(self._guarded_submit(
+            spec, self._async_submit_actor_task(spec),
+            (tuple(args), kwargs)))
         return return_ids
 
     async def _async_submit_actor_task(self, spec: TaskSpec) -> None:

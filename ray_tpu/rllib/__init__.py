@@ -11,6 +11,9 @@ from ray_tpu.rllib.algorithms import (APPO, BC, DQN, IMPALA, MARWIL, PPO,
                                       Algorithm, AlgorithmConfig, BCConfig,
                                       DQNConfig, IMPALAConfig, MARWILConfig,
                                       PPOConfig, SACConfig)
+from ray_tpu.rllib.connectors import (CastObs, ClipRewards, Connector,
+                                      ConnectorPipeline, FlattenObs,
+                                      NormalizeObs)
 from ray_tpu.rllib.core.learner import Learner, LearnerGroup
 from ray_tpu.rllib.core.rl_module import RLModule, RLModuleSpec
 from ray_tpu.rllib.env.multi_agent_env import (MultiAgentEnv,
@@ -36,6 +39,12 @@ __all__ = [
     "BCConfig",
     "MARWIL",
     "MARWILConfig",
+    "Connector",
+    "ConnectorPipeline",
+    "NormalizeObs",
+    "ClipRewards",
+    "CastObs",
+    "FlattenObs",
     "Learner",
     "LearnerGroup",
     "RLModule",

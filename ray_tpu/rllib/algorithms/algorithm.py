@@ -43,6 +43,12 @@ class Algorithm:
         self._start = time.time()
         opt_cfg = {"lr": config.lr, "grad_clip": config.grad_clip}
         if config.is_multi_agent:
+            if (config.env_to_module_connector is not None
+                    or config.learner_connector is not None):
+                raise ValueError(
+                    "connector pipelines are not wired into the "
+                    "multi-agent runner yet; configure them per-policy "
+                    "inside the env/module instead")
             # one module + learner group per policy; agents batch onto
             # policies inside the multi-agent runner
             from ray_tpu.rllib.env.multi_agent_env import (
@@ -68,11 +74,13 @@ class Algorithm:
                 config.env, self.spec,
                 num_env_runners=config.num_env_runners,
                 num_envs_per_runner=config.num_envs_per_env_runner,
-                seed=config.seed, env_config=config.env_config)
+                seed=config.seed, env_config=config.env_config,
+                obs_connector=config.env_to_module_connector)
             self.learner_group = LearnerGroup(
                 self.spec, type(self).loss_fn,
                 optimizer_config=opt_cfg,
-                num_learners=config.num_learners, seed=config.seed)
+                num_learners=config.num_learners, seed=config.seed,
+                batch_connector=config.learner_connector)
             self.learner_groups = None
         self._sync_weights()
 

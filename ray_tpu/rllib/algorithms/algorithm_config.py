@@ -42,6 +42,11 @@ class AlgorithmConfig:
         # hiddens); env must then be a MultiAgentEnv factory/class
         self.policies: Optional[Dict[str, Dict[str, Any]]] = None
         self.policy_mapping_fn: Optional[Any] = None
+        # connector pipelines (rllib/connectors.py; ≈ ConnectorV2):
+        # env_to_module runs on host obs before the jitted forward in each
+        # env runner; learner_connector transforms every train batch
+        self.env_to_module_connector: Optional[Any] = None
+        self.learner_connector: Optional[Any] = None
 
     # ------------------------------------------------------- fluent setters
 
@@ -65,12 +70,14 @@ class AlgorithmConfig:
 
     def env_runners(self, *, num_env_runners: Optional[int] = None,
                     num_envs_per_env_runner: Optional[int] = None,
-                    rollout_fragment_length: Optional[int] = None
+                    rollout_fragment_length: Optional[int] = None,
+                    env_to_module_connector: Optional[Any] = None
                     ) -> "AlgorithmConfig":
         return self._apply(dict(
             num_env_runners=num_env_runners,
             num_envs_per_env_runner=num_envs_per_env_runner,
-            rollout_fragment_length=rollout_fragment_length))
+            rollout_fragment_length=rollout_fragment_length,
+            env_to_module_connector=env_to_module_connector))
 
     def learners(self, *, num_learners: Optional[int] = None
                  ) -> "AlgorithmConfig":

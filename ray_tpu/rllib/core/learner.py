@@ -142,7 +142,11 @@ class LearnerGroup:
 
     def __init__(self, spec: RLModuleSpec, loss_fn: Callable,
                  optimizer_config: Optional[Dict[str, Any]] = None,
-                 num_learners: int = 0, seed: int = 0):
+                 num_learners: int = 0, seed: int = 0,
+                 batch_connector=None):
+        # learner connector (rllib/connectors.py): host-side batch
+        # transform applied once, before row-sharding to learner actors
+        self._batch_connector = batch_connector
         self._local: Optional[Learner] = None
         self._actors: List[Any] = []
         if num_learners <= 0:
@@ -169,6 +173,8 @@ class LearnerGroup:
 
     def update_from_batch(self, batch: Dict[str, np.ndarray],
                           loss_cfg: Dict[str, Any]) -> Dict[str, float]:
+        if self._batch_connector is not None:
+            batch = self._batch_connector(dict(batch))
         if self._local is not None:
             return self._local.update_from_batch(batch, loss_cfg)
         n = len(self._actors)

@@ -23,7 +23,8 @@ class SingleAgentEnvRunner:
     def __init__(self, env_name: str, spec: RLModuleSpec,
                  num_envs: int = 4, seed: int = 0,
                  explore: bool = True,
-                 env_config: Optional[Dict[str, Any]] = None):
+                 env_config: Optional[Dict[str, Any]] = None,
+                 obs_connector=None):
         import gymnasium as gym
         import jax
 
@@ -44,6 +45,9 @@ class SingleAgentEnvRunner:
         self._finished_returns: List[float] = []
         self._finished_lens: List[int] = []
         self._explore = explore
+        # env-to-module connector (rllib/connectors.py): host-side obs
+        # transform ahead of the jitted forward
+        self._obs_connector = obs_connector
 
     def set_weights(self, weights) -> bool:
         import jax
@@ -56,6 +60,8 @@ class SingleAgentEnvRunner:
     def _prep_obs(self, obs):
         """uint8 image obs stay uint8 (the CNN stem normalizes by /255);
         everything else is float32 for the torso."""
+        if self._obs_connector is not None:
+            obs = np.asarray(self._obs_connector(obs))
         if len(self._spec.obs_shape) == 3 and obs.dtype == np.uint8:
             return obs
         return obs.astype(np.float32)
@@ -71,10 +77,16 @@ class SingleAgentEnvRunner:
 
         T, B = num_steps, self.num_envs
         # uint8 image envs keep raw (H, W, C) frames; anything else
-        # (flat specs, float-valued image envs) buffers as float32
-        obs_shape = tuple(self._spec.obs_shape) or (self._spec.obs_dim,)
-        obs_dtype = (np.uint8 if len(obs_shape) == 3
-                     and self._obs.dtype == np.uint8 else np.float32)
+        # (flat specs, float-valued image envs) buffers as float32.
+        # With an obs connector, the batch stores the CONNECTED obs — the
+        # learner must train on exactly what the module saw. The boundary
+        # obs is prepped ONCE across sample() calls (cached): re-prepping
+        # would double-count it in stateful connectors (NormalizeObs).
+        cur_prepped = getattr(self, "_boundary_prepped", None)
+        if cur_prepped is None:
+            cur_prepped = self._prep_obs(self._obs)
+        obs_shape = tuple(cur_prepped.shape[1:])
+        obs_dtype = cur_prepped.dtype
         obs_buf = np.empty((T, B) + obs_shape, obs_dtype)
         act_buf = np.empty((T, B), np.int64)
         logp_buf = np.empty((T, B), np.float32)
@@ -87,14 +99,13 @@ class SingleAgentEnvRunner:
         for t in range(T):
             self._key, sub = jax.random.split(self._key)
             if greedy:
-                logits = self._infer_fn(self.params,
-                                        self._prep_obs(self._obs))
+                logits = self._infer_fn(self.params, cur_prepped)
                 action = np.asarray(logits).argmax(-1)
                 logp = np.zeros(B, np.float32)
                 value = np.zeros(B, np.float32)
             else:
                 action, logp, value = self._explore_fn(
-                    self.params, self._prep_obs(self._obs), sub)
+                    self.params, cur_prepped, sub)
             action = np.asarray(action)
             if epsilon is not None and epsilon > 0:
                 rand_mask = np.random.random(B) < epsilon
@@ -102,14 +113,15 @@ class SingleAgentEnvRunner:
                     0, self._spec.num_actions, B)
                 action = np.where(rand_mask, rand_actions, action)
             next_obs, reward, term, trunc, _info = self.envs.step(action)
-            obs_buf[t] = self._obs
+            next_prepped = self._prep_obs(next_obs)
+            obs_buf[t] = cur_prepped
             act_buf[t] = action
             logp_buf[t] = np.asarray(logp)
             val_buf[t] = np.asarray(value)
             rew_buf[t] = reward
             term_buf[t] = term
             trunc_buf[t] = trunc
-            next_obs_buf[t] = next_obs
+            next_obs_buf[t] = next_prepped
             self._episode_returns += reward
             self._episode_lens += 1
             done = term | trunc
@@ -120,12 +132,14 @@ class SingleAgentEnvRunner:
                 self._episode_returns[i] = 0.0
                 self._episode_lens[i] = 0
             self._obs = next_obs
+            cur_prepped = next_prepped
+        self._boundary_prepped = cur_prepped
 
         # bootstrap value for the final observation of every column
         import jax.numpy as jnp
 
         _, last_val = self.module.forward_train(
-            self.params, jnp.asarray(self._prep_obs(self._obs)))
+            self.params, jnp.asarray(cur_prepped))
         return {
             "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
             "values": val_buf, "rewards": rew_buf,
@@ -156,19 +170,20 @@ class EnvRunnerGroup:
     def __init__(self, env_name: str, spec: RLModuleSpec,
                  num_env_runners: int = 0, num_envs_per_runner: int = 4,
                  seed: int = 0,
-                 env_config: Optional[Dict[str, Any]] = None):
+                 env_config: Optional[Dict[str, Any]] = None,
+                 obs_connector=None):
         self._local: Optional[SingleAgentEnvRunner] = None
         self._actors: List[Any] = []
         if num_env_runners <= 0:
             self._local = SingleAgentEnvRunner(
                 env_name, spec, num_envs_per_runner, seed,
-                env_config=env_config)
+                env_config=env_config, obs_connector=obs_connector)
         else:
             cls = ray_tpu.remote(SingleAgentEnvRunner)
             self._actors = [
                 cls.options(num_cpus=1).remote(
                     env_name, spec, num_envs_per_runner, seed + 1000 * i,
-                    env_config=env_config)
+                    env_config=env_config, obs_connector=obs_connector)
                 for i in range(num_env_runners)
             ]
 

@@ -32,12 +32,19 @@ class RpcIngressActor:
     """Async actor hosting an RpcServer; `invoke` routes to app handles
     through the same pow-2 router as every other caller."""
 
+    STREAM_IDLE_TTL_S = 60.0
+
     def __init__(self, controller, port: int = 0):
         self._controller = controller
         self._port = port
         self._server = None
         self._handles: Dict[str, Any] = {}
+        # ingress stream key (uuid) -> (replica_handle, replica_sid,
+        # last_pull_ts): replica sids are per-replica counters and collide
+        # across replicas, so the ingress mints its own ids
+        self._streams: Dict[str, list] = {}
         self._started = asyncio.Event()
+        self._janitor = None
 
     async def ready(self) -> int:
         if self._server is None:
@@ -48,11 +55,36 @@ class RpcIngressActor:
             self._server.register("stream_next", self._stream_next)
             addr = await self._server.start()
             self._port = addr[1]
+            self._janitor = asyncio.ensure_future(self._janitor_loop())
             self._started.set()
             logger.info("serve rpc ingress on :%d", self._port)
         else:
             await self._started.wait()
         return self._port
+
+    async def _janitor_loop(self):
+        """Reap abandoned streams (the replica reaps its side after its
+        own idle TTL; the ingress must not leak its mapping) and drop
+        cached app handles whose route target changed (redeploys)."""
+        import time
+
+        while True:
+            await asyncio.sleep(5.0)
+            now = time.monotonic()
+            for key, rec in list(self._streams.items()):
+                if now - rec[2] > self.STREAM_IDLE_TTL_S:
+                    self._streams.pop(key, None)
+            try:
+                routes = await self._controller.get_routes.remote()
+            except Exception:
+                continue
+            targets = {}
+            for dest in routes.values():
+                app_name, dep = dest.split("/", 1)
+                targets[app_name] = dep
+            for app, h in list(self._handles.items()):
+                if targets.get(app) != h._deployment:
+                    self._handles.pop(app, None)
 
     async def _handle_for(self, app: str):
         h = self._handles.get(app)
@@ -73,6 +105,9 @@ class RpcIngressActor:
         return h
 
     async def _invoke(self, body: Dict[str, Any]):
+        import time
+        import uuid
+
         from ray_tpu.serve.handle import STREAM_MARKER
 
         h = await self._handle_for(body["app"])
@@ -80,24 +115,32 @@ class RpcIngressActor:
             h = h.options(
                 multiplexed_model_id=body["multiplexed_model_id"])
         method = body.get("method") or "__call__"
-        args = body.get("args") or [body.get("payload")]
+        # an explicit empty args list means a zero-arg call, not f(None)
+        args = (body["args"] if body.get("args") is not None
+                else [body.get("payload")])
         # router does blocking controller lookups: keep them off this loop
         resp = await asyncio.to_thread(
             lambda: h._call(method, tuple(args), body.get("kwargs") or {}))
         out = await resp
         if isinstance(out, dict) and STREAM_MARKER in out:
-            sid = out[STREAM_MARKER]
-            self._handles[f"__stream_{sid}"] = resp._replica
-            return {"stream": sid}
+            # ingress-unique key: replica sids are per-replica counters
+            key = uuid.uuid4().hex[:16]
+            self._streams[key] = [resp._replica, out[STREAM_MARKER],
+                                  time.monotonic()]
+            return {"stream": key}
         return {"result": out}
 
     async def _stream_next(self, body: Dict[str, Any]):
-        replica = self._handles.get(f"__stream_{body['stream']}")
-        if replica is None:
+        import time
+
+        rec = self._streams.get(body["stream"])
+        if rec is None:
             return {"items": [], "done": True}
-        chunk = await replica.stream_next.remote(body["stream"])
+        replica, sid, _ = rec
+        rec[2] = time.monotonic()
+        chunk = await replica.stream_next.remote(sid)
         if chunk.get("done"):
-            self._handles.pop(f"__stream_{body['stream']}", None)
+            self._streams.pop(body["stream"], None)
         return chunk
 
 
