@@ -91,6 +91,14 @@ class Executor:
         self._pool.submit(self._execute_guarded, spec)
         return "ok"
 
+    async def push_task_batch(self, body) -> str:
+        """Coalesced delivery: one frame, many specs (owner-side outbox
+        batching). Each spec takes the exact same path as a single push —
+        ordering still comes from seqnos, dedupe from task ids."""
+        for blob in body["specs"]:
+            await self.push_task({"spec": blob})
+        return "ok"
+
     async def cancel(self, body) -> bool:
         self._cancelled.add(TaskID(body["task_id"]))
         return True
@@ -407,6 +415,7 @@ def main() -> None:
 
     executor = Executor(core)
     core.server.register("push_task", executor.push_task)
+    core.server.register("push_task_batch", executor.push_task_batch)
     core.server.register("cancel", executor.cancel)
 
     # make the worker-side public API work inside tasks

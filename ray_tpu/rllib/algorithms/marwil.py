@@ -77,6 +77,10 @@ def _load_offline_rows(input_) -> Dict[str, np.ndarray]:
 class MARWIL(Algorithm):
     def __init__(self, config: MARWILConfig):
         # offline: no env runners at all
+        if config.env_to_module_connector is not None:
+            raise ValueError(
+                "offline algorithms have no env runners; preprocess the "
+                "offline rows instead of setting env_to_module_connector")
         self.config = config
         self.iteration = 0
         self._total_env_steps = 0
@@ -88,7 +92,8 @@ class MARWIL(Algorithm):
             self.spec, type(self).loss_fn,
             optimizer_config={"lr": config.lr,
                               "grad_clip": config.grad_clip},
-            num_learners=config.num_learners, seed=config.seed)
+            num_learners=config.num_learners, seed=config.seed,
+            batch_connector=config.learner_connector)
         self._data = _load_offline_rows(config.input_)
         if config.beta != 0.0 and "returns" not in self._data:
             raise ValueError(

@@ -274,6 +274,11 @@ class SAC(Algorithm):
         import time as _time
 
         # continuous env + custom module: bypass the discrete base wiring
+        if (config.env_to_module_connector is not None
+                or config.learner_connector is not None):
+            raise ValueError(
+                "connector pipelines are not wired into SAC's continuous "
+                "runner/learner yet")
         self.config = config
         self.iteration = 0
         self._total_env_steps = 0

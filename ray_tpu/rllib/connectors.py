@@ -122,12 +122,18 @@ class ClipRewards(Connector):
 
 
 class FlattenObs(Connector):
-    """[..., *obs_shape] -> [..., prod(obs_shape)] for MLP torsos."""
+    """Flatten trailing obs dims into one feature axis, keeping
+    `keep_dims` leading axes (default 1: the env-runner's [B, *obs]
+    batches; use 2 for time-major [T, B, *obs] learner batches)."""
+
+    def __init__(self, keep_dims: int = 1):
+        self.keep_dims = keep_dims
 
     def __call__(self, obs: np.ndarray, ctx=None):
         obs = np.asarray(obs)
-        lead = obs.shape[:1]
-        return obs.reshape(lead + (-1,)) if obs.ndim > 2 else obs
+        if obs.ndim <= self.keep_dims + 1:
+            return obs
+        return obs.reshape(obs.shape[:self.keep_dims] + (-1,))
 
 
 class CastObs(Connector):

@@ -85,6 +85,13 @@ class RpcIngressActor:
             for app, h in list(self._handles.items()):
                 if targets.get(app) != h._deployment:
                     self._handles.pop(app, None)
+                    # retire the evicted handle's router, or its long-poll
+                    # thread keeps polling the dead deployment forever
+                    if h._router is not None:
+                        try:
+                            h._router.stop()
+                        except Exception:
+                            pass
 
     async def _handle_for(self, app: str):
         h = self._handles.get(app)
