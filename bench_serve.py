@@ -1,0 +1,93 @@
+"""Serve-decode throughput harness: batched autoregressive decode on the
+local chip (the BASELINE "Serve-equivalent LLM deployment ... batched
+replica throughput" row).
+
+Measures the jitted prefill + per-token decode loop from
+`ray_tpu.models.decode` — the exact program a Serve LLM replica runs per
+`@serve.batch` flush (serve/llm.py) — across batch sizes, and prints ONE
+JSON line with the peak batched decode rate:
+
+    python bench_serve.py [--preset gpt2_small] [--prompt-len 128]
+                          [--new-tokens 64]
+
+vs_baseline is decode tokens/s at the best batch divided by 1000 (a
+single-GPU 7B-class continuous-batching serving rate is O(1000) tok/s;
+the debug-size model here is smaller, so treat it as a scale probe, not
+a model-for-model comparison).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def bench_decode(preset: str, prompt_len: int, new_tokens: int,
+                 batches=(1, 8, 32)) -> dict:
+    import functools
+
+    import jax
+
+    from ray_tpu.models import presets
+    from ray_tpu.models.decode import generate
+    from ray_tpu.models.transformer import init_params
+
+    cfg = getattr(presets, preset)()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    # one compiled program per batch size: prefill + lax.scan over decode
+    # steps — the replica-side program shape (per-token host dispatch
+    # through the test tunnel would measure the tunnel, not the chip)
+    gen = jax.jit(functools.partial(generate, cfg,
+                                    max_new_tokens=new_tokens),
+                  static_argnames=())
+
+    results = []
+    for batch in batches:
+        tokens = jax.random.randint(jax.random.PRNGKey(1),
+                                    (batch, prompt_len), 0, cfg.vocab_size)
+        key = jax.random.PRNGKey(2)
+        toks = gen(params, tokens, key)
+        float(toks.sum())  # compile + warmup, host sync
+        t0 = time.perf_counter()
+        iters = 5
+        for _ in range(iters):
+            toks = gen(params, tokens, key)
+        float(toks.sum())
+        dt = (time.perf_counter() - t0) / iters
+        decode_tps = batch * new_tokens / dt
+        results.append({
+            "batch": batch,
+            "decode_tokens_per_sec": round(decode_tps, 1),
+            "latency_ms_per_token": round(dt / new_tokens * 1e3, 2),
+            "end_to_end_s": round(dt, 3),
+        })
+    return {"per_batch": results, "preset": preset,
+            "prompt_len": prompt_len, "new_tokens": new_tokens}
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", default="gpt2_small")
+    ap.add_argument("--prompt-len", type=int, default=128)
+    ap.add_argument("--new-tokens", type=int, default=64)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    detail = bench_decode(args.preset, args.prompt_len, args.new_tokens)
+    best = max(detail["per_batch"],
+               key=lambda r: r["decode_tokens_per_sec"])
+    print(json.dumps({
+        "metric": "llm_decode_tokens_per_sec",
+        "value": best["decode_tokens_per_sec"],
+        "unit": "tokens/s",
+        "vs_baseline": round(best["decode_tokens_per_sec"] / 1000.0, 4),
+        "detail": dict(detail,
+                       device=str(getattr(jax.devices()[0], "device_kind",
+                                          "cpu"))),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1377,7 +1377,14 @@ class CoreWorker:
                 addr = state.address
                 batch = []
                 while state.outbox and len(batch) < 64:
-                    batch.append(state.outbox.popleft())
+                    p = state.outbox.popleft()
+                    # same guard as push_or_fail: tasks already failed by
+                    # actor-death fan-out must not reach the restarted
+                    # actor (double execution + stale seqnos)
+                    if p.spec.task_id in self._inflight_tasks:
+                        batch.append(p)
+                if not batch:
+                    continue
                 if len(batch) == 1:
                     await push_or_fail(batch[0])
                     continue

@@ -124,7 +124,9 @@ class ClipRewards(Connector):
 class FlattenObs(Connector):
     """Flatten trailing obs dims into one feature axis, keeping
     `keep_dims` leading axes (default 1: the env-runner's [B, *obs]
-    batches; use 2 for time-major [T, B, *obs] learner batches)."""
+    batches). Operates on ARRAYS — in a learner pipeline (which passes
+    the batch dict) wrap it per column, e.g.
+    ``lambda b, ctx=None: {**b, "obs": FlattenObs(2)(b["obs"])}``."""
 
     def __init__(self, keep_dims: int = 1):
         self.keep_dims = keep_dims

@@ -356,3 +356,21 @@ class TestActorPoolCompute:
         with pytest.raises(TypeError, match="class UDF"):
             ray_tpu.data.range(4).map_batches(
                 lambda b: b, compute=ActorPoolStrategy(size=2))
+
+
+def test_iter_torch_batches(ray_init):
+    """Torch interop (≈ iter_torch_batches): numpy batches become torch
+    tensors with optional per-column dtypes."""
+    import torch
+
+    from ray_tpu import data
+
+    ds = data.range(100).map_batches(
+        lambda b: {"x": b["id"], "y": b["id"] * 2.0})
+    total = 0
+    for batch in ds.iter_torch_batches(batch_size=32,
+                                       dtypes={"y": torch.float64}):
+        assert isinstance(batch["x"], torch.Tensor)
+        assert batch["y"].dtype == torch.float64
+        total += int(batch["x"].sum())
+    assert total == sum(range(100))
