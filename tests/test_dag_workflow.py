@@ -146,3 +146,33 @@ class TestWorkflow:
                      storage=str(tmp_path / "wf"))
         workflow.delete("wf-del", storage=str(tmp_path / "wf"))
         assert workflow.list_all(storage=str(tmp_path / "wf")) == []
+
+
+def test_experimental_compile(ray_init):
+    """Compiled DAGs freeze the topology once and run repeatedly with the
+    same results as eager execute()."""
+    import ray_tpu
+    from ray_tpu.dag import InputNode, MultiOutputNode
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    with InputNode() as inp:
+        d = double.bind(inp)
+        dag = MultiOutputNode([add.bind(d, inp), double.bind(d)])
+
+    compiled = dag.experimental_compile()
+    for i in range(5):
+        out = ray_tpu.get(compiled.execute(i))
+        assert out == [i * 2 + i, i * 4]
+    # arity validation survives compilation
+    import pytest
+
+    with pytest.raises(ValueError, match="expects 1"):
+        compiled.execute(1, 2)
+    compiled.teardown()
