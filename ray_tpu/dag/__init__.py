@@ -55,9 +55,19 @@ class CompiledDAG:
 
     def __init__(self, root: DAGNode):
         self._root = root
-        # input arity computed once (it walks the whole graph, validating
-        # node types along the way); _resolve already runs
-        # children-before-parents, so no separate order is kept
+        # walk once: compute input arity AND reject unsupported node types
+        # now, not at the first execute()
+        known = (InputNode, MultiOutputNode, FunctionNode, ClassMethodNode)
+        stack, seen = [root], set()
+        while stack:
+            node = stack.pop()
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            if not isinstance(node, known):
+                raise TypeError(
+                    f"cannot compile DAG containing {type(node).__name__}")
+            stack.extend(_children(node))
         self._n_inputs = _count_inputs(root)
 
     def execute(self, *input_values) -> Any:

@@ -1,3 +1,4 @@
+from ray_tpu.util.actor_pool import ActorPool  # noqa: F401
 from ray_tpu.util.placement_group import (  # noqa: F401
     PlacementGroup,
     placement_group,

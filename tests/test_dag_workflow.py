@@ -176,3 +176,10 @@ def test_experimental_compile(ray_init):
     with pytest.raises(ValueError, match="expects 1"):
         compiled.execute(1, 2)
     compiled.teardown()
+
+
+def test_compile_rejects_unknown_nodes(ray_init):
+    from ray_tpu.dag import DAGNode
+
+    with pytest.raises(TypeError, match="cannot compile"):
+        DAGNode().experimental_compile()

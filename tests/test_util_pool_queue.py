@@ -1,0 +1,128 @@
+"""ray_tpu.util.ActorPool + ray_tpu.util.queue.Queue — the common
+fan-out/coordination utilities (≈ `python/ray/tests/test_actor_pool.py` +
+`test_queue.py` coverage shape)."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import ActorPool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+@ray_tpu.remote
+class Worker:
+    def __init__(self, tag):
+        self.tag = tag
+
+    def work(self, x):
+        return x * 2
+
+    def slow(self, x):
+        time.sleep(0.4 if x == 0 else 0.05)
+        return x
+
+
+class TestActorPool:
+    def test_map_ordered(self, ray_init):
+        pool = ActorPool([Worker.remote(i) for i in range(3)])
+        out = list(pool.map(lambda a, v: a.work.remote(v), range(10)))
+        assert out == [v * 2 for v in range(10)]
+
+    def test_map_unordered_completion_order(self, ray_init):
+        pool = ActorPool([Worker.remote(i) for i in range(2)])
+        out = list(pool.map_unordered(lambda a, v: a.slow.remote(v),
+                                      [0, 1, 2, 3]))
+        assert sorted(out) == [0, 1, 2, 3]
+        # the slow task (x=0) must NOT block faster completions
+        assert out[0] != 0
+
+    def test_submit_get_next(self, ray_init):
+        pool = ActorPool([Worker.remote(0)])
+        pool.submit(lambda a, v: a.work.remote(v), 1)
+        pool.submit(lambda a, v: a.work.remote(v), 2)
+        assert pool.has_next()
+        assert pool.get_next() == 2
+        assert pool.get_next() == 4
+        assert not pool.has_next()
+        with pytest.raises(StopIteration):
+            pool.get_next()
+
+    def test_push_pop_idle(self, ray_init):
+        a, b = Worker.remote(0), Worker.remote(1)
+        pool = ActorPool([a])
+        assert pool.has_free()
+        popped = pool.pop_idle()
+        assert popped is not None
+        assert not pool.has_free()
+        pool.push(b)
+        out = list(pool.map(lambda w, v: w.work.remote(v), [5]))
+        assert out == [10]
+
+
+class TestQueue:
+    def test_fifo_roundtrip(self, ray_init):
+        q = Queue()
+        for i in range(5):
+            q.put(i)
+        assert q.qsize() == 5
+        assert [q.get() for _ in range(5)] == list(range(5))
+        assert q.empty()
+        q.shutdown()
+
+    def test_nonblocking_and_timeouts(self, ray_init):
+        q = Queue(maxsize=2)
+        q.put(1)
+        q.put(2)
+        assert q.full()
+        with pytest.raises(Full):
+            q.put_nowait(3)
+        assert q.get(timeout=1) == 1
+        q.get()
+        with pytest.raises(Empty):
+            q.get_nowait()
+        t0 = time.monotonic()
+        with pytest.raises(Empty):
+            q.get(timeout=0.3)
+        assert time.monotonic() - t0 < 5
+        q.shutdown()
+
+    def test_batch_ops(self, ray_init):
+        q = Queue()
+        assert q.put_nowait_batch(list(range(7))) == 7
+        assert q.get_nowait_batch(3) == [0, 1, 2]
+        assert q.get_nowait_batch(100) == [3, 4, 5, 6]
+        q.shutdown()
+
+    def test_crosses_task_boundary(self, ray_init):
+        """The queue handle pickles to the same actor (producer task /
+        consumer driver see one queue)."""
+        q = Queue()
+
+        @ray_tpu.remote
+        def producer(queue, n):
+            for i in range(n):
+                queue.put(i * 10)
+            return n
+
+        assert ray_tpu.get(producer.remote(q, 4)) == 4
+        got = sorted(q.get() for _ in range(4))
+        assert got == [0, 10, 20, 30]
+        q.shutdown()
+
+    def test_blocking_get_wakes_on_put(self, ray_init):
+        q = Queue()
+        out = []
+
+        def consumer():
+            out.append(q.get(timeout=10))
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        time.sleep(0.2)
+        q.put("wake")
+        t.join(timeout=10)
+        assert out == ["wake"]
+        q.shutdown()
