@@ -15,7 +15,7 @@ offline input_ API surface.
 from __future__ import annotations
 
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict
 
 import numpy as np
 

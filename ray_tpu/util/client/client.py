@@ -13,7 +13,7 @@ from __future__ import annotations
 import asyncio
 import threading
 import uuid
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence
 
 from ray_tpu.util.client.common import (ACTOR_PID, REF_PID, ClientActorHandle,
                                         ClientObjectRef, dumps_with_ids,
