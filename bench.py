@@ -1,16 +1,32 @@
-"""Benchmark: causal-LM training MFU on the local chip.
+"""Benchmark: causal-LM training MFU on the local chip (+ a 1B-class
+second config when memory allows).
 
 Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
 Baseline (BASELINE.md): the reference delegates device math to torch; our
 target band is 45% MFU for the Train-equivalent path, so vs_baseline is
 measured MFU / 0.45.
+
+Wedge-proofing (round-3 postmortem): the parent process NEVER imports
+jax. It first reaps stale ray_tpu daemons + /dev/shm arenas from dead
+sessions (a leaked worker holding the single-client TPU tunnel wedged
+both round-3 driver artifacts), then runs the measurement in a killable
+child with a hard timeout, retries once after a second sweep, and falls
+back to a CPU smoke measurement so a dead tunnel degrades the metric
+instead of zeroing the round.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import signal
+import subprocess
+import sys
 import time
 
+TPU_ATTEMPTS = 2
+TPU_TIMEOUT_S = 900.0
+CPU_TIMEOUT_S = 600.0
 
 PEAK_FLOPS = {
     # bf16 peak per chip
@@ -43,7 +59,7 @@ def _run_config(cfg, batch: int, seq: int, steps: int):
 
     ocfg = OptimizerConfig(warmup_steps=10, decay_steps=1000)
     state, tx = init_train_state(cfg, ocfg, jax.random.PRNGKey(0))
-    # grad_norm logging costs a full extra pass over 124M grads; clipping
+    # grad_norm logging costs a full extra pass over the grads; clipping
     # inside the optimizer still sees the norm
     step = make_train_step(cfg, tx, log_grad_norm=False)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
@@ -60,13 +76,54 @@ def _run_config(cfg, batch: int, seq: int, steps: int):
     return dt, count_params(state.params)
 
 
-def main() -> None:
+def _measure(candidates, batch, seq, steps):
+    """Try configs in order, falling back only on memory pressure."""
+    for i, cand in enumerate(candidates):
+        try:
+            dt, n_params = _run_config(cand, batch, seq, steps)
+            return dt, n_params, cand
+        except Exception as e:
+            if i == len(candidates) - 1:
+                raise
+            # fall back only for memory pressure; any other failure in the
+            # lighter-remat paths is a real bug that must surface
+            msg = f"{type(e).__name__}: {e}"
+            if "RESOURCE_EXHAUSTED" not in msg and "memory" not in msg.lower():
+                raise
+            print(f"bench: candidate {i} OOM, falling back ({msg[:200]})",
+                  file=sys.stderr)
+
+
+def _mfu_record(metric, dt, n_params, cfg, batch, seq, peak):
+    tokens_per_step = batch * seq
+    # Model FLOPs only (MFU convention — remat recompute excluded):
+    # fwd+bwd ≈ 6 flops/param/token + attention 12*L*S*E per token.
+    flops_per_token = 6 * n_params + 12 * cfg.num_layers * seq * cfg.embed_dim
+    mfu = flops_per_token * tokens_per_step / dt / peak
+    return {
+        "metric": metric,
+        "value": round(mfu, 4),
+        "unit": "fraction_of_peak",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "detail": {
+            "tokens_per_sec": round(tokens_per_step / dt),
+            "step_time_ms": round(dt * 1e3, 2),
+            "params": n_params,
+            "remat": cfg.remat_policy if cfg.remat else "none",
+        },
+    }
+
+
+def child_main() -> None:
+    """Runs in a killable subprocess; the only code path importing jax."""
     import jax
     import jax.numpy as jnp
 
     from ray_tpu.models import gpt2_small
 
     on_tpu = jax.default_backend() == "tpu"
+    device = jax.devices()[0]
+    peak = _peak_flops(device)
     if on_tpu:
         batch, seq, steps = 16, 1024, 20
         # MFU counts model flops only, so full remat's ~2N recompute
@@ -85,44 +142,107 @@ def main() -> None:
         candidates = [gpt2_small(num_layers=2, embed_dim=128, num_heads=4,
                                  vocab_size=1024, dtype=jnp.float32)]
 
-    dt = n_params = cfg = None
-    for i, cand in enumerate(candidates):
-        try:
-            dt, n_params = _run_config(cand, batch, seq, steps)
-            cfg = cand
-            break
-        except Exception as e:
-            if i == len(candidates) - 1:
-                raise
-            # fall back only for memory pressure; any other failure in the
-            # lighter-remat paths is a real bug that must surface
-            msg = f"{type(e).__name__}: {e}"
-            if "RESOURCE_EXHAUSTED" not in msg and "memory" not in msg.lower():
-                raise
-            import sys
-            print(f"bench: candidate {i} OOM, falling back ({msg[:200]})",
-                  file=sys.stderr)
-    tokens_per_step = batch * seq
-    # Model FLOPs only (MFU convention — remat recompute excluded):
-    # fwd+bwd ≈ 6 flops/param/token + attention 12*L*S*E per token.
-    flops_per_token = 6 * n_params + 12 * cfg.num_layers * seq * cfg.embed_dim
-    achieved = flops_per_token * tokens_per_step / dt
-    mfu = achieved / _peak_flops(jax.devices()[0])
+    dt, n_params, cfg = _measure(candidates, batch, seq, steps)
+    rec = _mfu_record(
+        "gpt2s_train_mfu" if on_tpu else "gpt2s_train_mfu_cpu_smoke",
+        dt, n_params, cfg, batch, seq, peak)
+    rec["detail"]["device"] = str(getattr(device, "device_kind", "cpu"))
+    # Emit the primary result NOW: if the optional 1B measurement below
+    # wedges (the hang class this harness defends against), the parent
+    # salvages this line from the killed child's buffered output.
+    print(json.dumps(rec), flush=True)
 
-    print(json.dumps({
-        "metric": "gpt2s_train_mfu" if on_tpu else "gpt2s_train_mfu_cpu_smoke",
-        "value": round(mfu, 4),
-        "unit": "fraction_of_peak",
-        "vs_baseline": round(mfu / 0.45, 4),
-        "detail": {
-            "tokens_per_sec": round(tokens_per_step / dt),
-            "step_time_ms": round(dt * 1e3, 2),
-            "params": n_params,
-            "remat": cfg.remat_policy if cfg.remat else "none",
-            "device": str(getattr(jax.devices()[0], "device_kind", "cpu")),
-        },
-    }))
+    if on_tpu:
+        # Second perf point: a ~1B-param GPT config (VERDICT r3 weak #4) —
+        # the bridge toward the Llama-8B FSDP target. Remat candidates in
+        # order of decreasing speed; 16GB HBM decides which one sticks.
+        try:
+            from ray_tpu.models import gpt_1b
+
+            b1, s1 = 4, 1024
+            cands_1b = [gpt_1b(remat_policy="dots", scan_layers=False,
+                               ce_chunk=8192),
+                        gpt_1b(ce_chunk=8192),
+                        gpt_1b()]
+            dt1, n1, cfg1 = _measure(cands_1b, b1, s1, steps=10)
+            rec["detail"]["gpt1b_mfu"] = _mfu_record(
+                "gpt1b_train_mfu", dt1, n1, cfg1, b1, s1, peak)
+            # enriched record supersedes the primary (parent keeps the
+            # LAST valid JSON line)
+            print(json.dumps(rec), flush=True)
+        except Exception as e:  # second point must not kill the first
+            print(f"bench: 1B config failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+
+
+def main() -> None:
+    """Parent orchestrator: reap, run child with timeout, retry, fall back."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, repo)
+    from ray_tpu._private.reaper import reap_all
+
+    swept = reap_all()
+    if any(swept.values()):
+        print(f"bench: pre-flight sweep {swept}", file=sys.stderr)
+
+    def attempt(env_extra, timeout):
+        env = dict(os.environ)
+        env.update(env_extra)
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            env=env, cwd=repo, stdout=subprocess.PIPE,
+            start_new_session=True)  # killable with its tpu helper procs
+        timed_out = False
+        try:
+            out, _ = proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except OSError:
+                proc.kill()
+            # second communicate() collects whatever the child flushed
+            # before it wedged — the primary record is emitted early
+            # exactly so it can be salvaged here
+            out, _ = proc.communicate()
+            print(f"bench: child timed out after {timeout}s", file=sys.stderr)
+        if not timed_out and proc.returncode != 0:
+            # do NOT bail yet: a crash (TPU runtime abort, OOM-kill,
+            # segfault) during the optional second measurement must not
+            # discard an already-emitted primary record — fall through
+            # to the salvage scan
+            print(f"bench: child failed rc={proc.returncode}", file=sys.stderr)
+        # last valid JSON line wins (the child may emit a primary record
+        # then an enriched one)
+        for line in reversed(out.decode().strip().splitlines() if out else []):
+            try:
+                json.loads(line)
+                return line
+            except Exception:
+                continue
+        print("bench: child emitted no JSON", file=sys.stderr)
+        return None
+
+    line = None
+    for i in range(TPU_ATTEMPTS):
+        line = attempt({}, TPU_TIMEOUT_S)
+        if line:
+            break
+        if i + 1 < TPU_ATTEMPTS:  # re-sweep only between TPU attempts
+            reap_all()  # the failed attempt may itself have left debris
+            time.sleep(5)
+    if not line:
+        print("bench: TPU attempts exhausted; falling back to CPU smoke",
+              file=sys.stderr)
+        line = attempt({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
+                       CPU_TIMEOUT_S)
+    if not line:
+        sys.exit(1)
+    print(line)
 
 
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        child_main()
+    else:
+        main()

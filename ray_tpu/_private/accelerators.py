@@ -95,18 +95,22 @@ def tpu_pod_resources() -> Dict[str, float]:
     out: Dict[str, float] = {}
     gen = accel.split("-")[0]
     out[f"accelerator_type:TPU-{gen}"] = 1.0
-    worker_id = get_current_pod_worker_id()
-    if worker_id == 0 or worker_id is None:
-        # single-host slices have no worker id; they are their own head.
-        # The resource NAME must be the chip-normalized one slice placement
-        # groups demand (SliceTopology.head_resource) — the raw accelerator
-        # string counts cores on v2-v4/v5p and would never match.
-        from ray_tpu.parallel.slices import SliceTopology
+    # The resource NAME must be the chip-normalized one slice placement
+    # groups demand (SliceTopology.head_resource) — the raw accelerator
+    # string counts cores on v2-v4/v5p and would never match.
+    from ray_tpu.parallel.slices import SliceTopology
 
-        try:
-            head = SliceTopology.parse(accel).head_resource
-        except ValueError:
-            head = f"TPU-{accel}-head"
+    try:
+        topo = SliceTopology.parse(accel)
+        head, multi_host = topo.head_resource, topo.num_hosts > 1
+    except ValueError:
+        head, multi_host = f"TPU-{accel}-head", False
+    worker_id = get_current_pod_worker_id()
+    # Worker 0 is the head. A missing worker id only implies head-ness on a
+    # single-host slice; on a multi-host pod where TPU_WORKER_ID is unset
+    # and the metadata lookup failed, granting head on every host would let
+    # slice placement groups gang-schedule multiple jobs onto one slice.
+    if worker_id == 0 or (worker_id is None and not multi_host):
         out[head] = 1.0
     return out
 

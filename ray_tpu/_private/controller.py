@@ -1038,6 +1038,9 @@ def main() -> None:
         level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
         format="[controller] %(asctime)s %(levelname)s %(message)s",
     )
+    from ray_tpu._private.watchdog import start_owner_watchdog_from_env
+
+    start_owner_watchdog_from_env("controller")
 
     async def run():
         snapshot = args.snapshot_path

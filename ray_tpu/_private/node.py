@@ -27,7 +27,9 @@ Address = Tuple[str, int]
 
 
 def _daemon_env(extra: Optional[Dict[str, str]] = None) -> Dict[str, str]:
-    env = dict(os.environ)
+    from ray_tpu._private.watchdog import owner_env
+
+    env = owner_env(dict(os.environ))  # daemon dies with this process
     env.setdefault("RAY_TPU_AXON_ORIG", env.get("PALLAS_AXON_POOL_IPS", ""))
     env["PALLAS_AXON_POOL_IPS"] = ""  # no TPU plugin in control daemons
     # make ray_tpu importable in daemons/workers regardless of cwd

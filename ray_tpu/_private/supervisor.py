@@ -636,7 +636,9 @@ class Supervisor:
         return await self._spawn_worker(spec, env_key)
 
     async def _spawn_worker(self, spec: TaskSpec, env_key: str) -> WorkerHandle:
-        env = self._worker_env(spec)
+        from ray_tpu._private.watchdog import owner_env
+
+        env = owner_env(self._worker_env(spec))  # workers die with us
         env["RAY_TPU_WORKER_ENV_KEY"] = env_key
         env_spec = await self.runtime_envs.setup(spec.runtime_env)
         extra_pp = env_spec.env_vars.pop("RAY_TPU_RUNTIME_ENV_PYTHONPATH", "")
@@ -1124,6 +1126,9 @@ def main() -> None:
         level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
         format="[supervisor] %(asctime)s %(levelname)s %(message)s",
     )
+    from ray_tpu._private.watchdog import start_owner_watchdog_from_env
+
+    start_owner_watchdog_from_env("supervisor")
     host, port = args.controller.rsplit(":", 1)
     resources = json.loads(args.resources) if args.resources else None
 

@@ -402,6 +402,12 @@ def main() -> None:
         name="supervisor-liveness",
         daemon=True,
     ).start()
+    # belt over the ppid watch: the supervisor stamps RAY_TPU_OWNER_PID
+    # into our env (supervisor.py _spawn_worker); the env watchdog adds
+    # the pid-reuse start-time guard the ppid check lacks
+    from ray_tpu._private.watchdog import start_owner_watchdog_from_env
+
+    start_owner_watchdog_from_env("worker")
 
     config = Config.from_env()
     core = CoreWorker(

@@ -12,6 +12,7 @@ from ray_tpu.models.transformer import (  # noqa: F401
 from ray_tpu.models.presets import (  # noqa: F401
     gpt2_small,
     gpt2_medium,
+    gpt_1b,
     llama3_8b,
     llama_debug,
     moe_debug,

@@ -29,6 +29,21 @@ def gpt2_medium(**overrides) -> TransformerConfig:
     return TransformerConfig(**kw)
 
 
+def gpt_1b(**overrides) -> TransformerConfig:
+    """~0.9B-param LLaMA-style config (RMSNorm, RoPE, SwiGLU, tied
+    embeddings): the single-chip bridge toward the llama3_8b FSDP target
+    (BASELINE.md) — big enough that MFU reflects MXU behavior at depth,
+    small enough that params+adam+grads fit a 16GB v5e with remat."""
+    kw = dict(
+        vocab_size=32000, num_layers=16, embed_dim=2048, num_heads=16,
+        num_kv_heads=8, mlp_dim=5632, max_seq_len=2048, norm="rmsnorm",
+        pos="rope", mlp="swiglu", rope_theta=10000.0, tie_embeddings=True,
+        norm_eps=1e-5,
+    )
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
 def llama3_8b(**overrides) -> TransformerConfig:
     """Llama-3-8B: RoPE(theta=500k), RMSNorm, SwiGLU, GQA 32/8, vocab 128256."""
     kw = dict(

@@ -41,11 +41,15 @@ class APPO(IMPALA):
         # meaningful when the KL penalty is in the loss
         cfg: APPOConfig = self.config
         if cfg.use_kl_loss and metrics:
-            kl = metrics.get("mean_kl", 0.0)
-            if kl > 2.0 * cfg.kl_target:
-                self._kl_coeff *= 1.5
-            elif kl < 0.5 * cfg.kl_target:
-                self._kl_coeff *= 0.5
+            # adapt only on iterations that actually measured KL — a step
+            # with no learner update has no mean_kl, and reading it as 0
+            # would spuriously decay the penalty toward zero
+            kl = metrics.get("mean_kl")
+            if kl is not None:
+                if kl > 2.0 * cfg.kl_target:
+                    self._kl_coeff *= 1.5
+                elif kl < 0.5 * cfg.kl_target:
+                    self._kl_coeff *= 0.5
             metrics["kl_coeff"] = self._kl_coeff
         return metrics
 

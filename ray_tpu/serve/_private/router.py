@@ -37,14 +37,24 @@ class Router:
         self._update_event = threading.Event()
         self._stopped = False
         self._poll_thread: Optional[threading.Thread] = None
-        # multiplexing: model_id -> replica indices holding it; refreshed
-        # by a background poll only while multiplexed requests flow
+        # multiplexing: model_id -> STABLE replica keys (actor ids, not
+        # list indices — a long-poll update reorders/replaces the replica
+        # list and index-keyed marks would silently point at different
+        # replicas, routing to cold ones until the next mux poll) holding
+        # it; refreshed by a background poll only while multiplexed
+        # requests flow. Keys translate to indices at pick time.
         self._mux_locations: Dict[str, set] = {}
+        self._key_to_idx: Dict[str, int] = {}
         self._mux_thread: Optional[threading.Thread] = None
-        # optimistic (model, idx) marks with timestamps: kept through
+        # optimistic (model, key) marks with timestamps: kept through
         # refreshes while the model may still be loading on that replica
         self._mux_marks: Dict[tuple, float] = {}
         self._mux_last_request = 0.0
+
+    @staticmethod
+    def _replica_key(rep) -> str:
+        aid = getattr(rep, "_actor_id", None)
+        return aid.hex() if aid is not None else repr(rep)
 
     def _ensure_polling(self) -> None:
         if self._poll_thread is None:
@@ -90,6 +100,9 @@ class Router:
                     self._version = info["version"]
                     self._inflight = {
                         i: 0 for i in range(len(self._replicas))}
+                    self._key_to_idx = {
+                        self._replica_key(r): i
+                        for i, r in enumerate(self._replicas)}
                 self._update_event.set()
 
     def _pick(self, multiplexed_model_id: str = ""):
@@ -104,7 +117,8 @@ class Router:
             if multiplexed_model_id:
                 hot = self._mux_locations.get(multiplexed_model_id)
                 if hot:
-                    hot_idx = [i for i in candidates if i in hot]
+                    hot_idx = [self._key_to_idx[k] for k in hot
+                               if k in self._key_to_idx]
                     if hot_idx:
                         candidates = hot_idx
             if len(candidates) == 1:
@@ -147,10 +161,11 @@ class Router:
         if multiplexed_model_id:
             # optimistic: the chosen replica will hold the model after this
             # request, so siblings route there before the next poll lands
+            key = self._replica_key(replica)
             with self._lock:
                 self._mux_locations.setdefault(
-                    multiplexed_model_id, set()).add(idx)
-                self._mux_marks[(multiplexed_model_id, idx)] = (
+                    multiplexed_model_id, set()).add(key)
+                self._mux_marks[(multiplexed_model_id, key)] = (
                     time.monotonic())
                 self._mux_last_request = time.monotonic()
         ref = replica.handle_request.remote(method_name, args, kwargs)
@@ -190,21 +205,22 @@ class Router:
                 continue
             fresh: Dict[str, set] = {}
             failed: set = set()
-            for idx, rep in replicas:
+            for _idx, rep in replicas:
+                key = self._replica_key(rep)
                 try:
                     info = ray_tpu.get(rep.multiplex_info.remote(),
                                        timeout=5)
                 except Exception:
-                    failed.add(idx)
+                    failed.add(key)
                     continue
                 for mid in info.get("model_ids", ()):
-                    fresh.setdefault(mid, set()).add(idx)
+                    fresh.setdefault(mid, set()).add(key)
             with self._lock:
-                for (mid, idx), ts in list(self._mux_marks.items()):
+                for (mid, key), ts in list(self._mux_marks.items()):
                     if now - ts > self.MUX_MARK_TTL_S:
-                        del self._mux_marks[(mid, idx)]
+                        del self._mux_marks[(mid, key)]
                     else:
-                        fresh.setdefault(mid, set()).add(idx)
+                        fresh.setdefault(mid, set()).add(key)
                 for mid, idxs in self._mux_locations.items():
                     keep = idxs & failed
                     if keep:

@@ -71,16 +71,24 @@ class ActorPool:
         return bool(self._index_to_future)
 
     def get_next(self, timeout: Optional[float] = None) -> Any:
-        """Next result in SUBMISSION order."""
+        """Next result in SUBMISSION order.
+
+        On timeout raises TimeoutError WITHOUT consuming the task: the
+        cursor and mappings are only advanced once the result is ready, so
+        the caller can retry and the result is never dropped.
+        """
         if not self.has_next():
             raise StopIteration("no more results")
         idx = self._next_return_index
-        future = self._index_to_future.pop(idx)
+        future = self._index_to_future[idx]
+        ready, _ = ray_tpu.wait([future], num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError(f"result {idx} not ready within {timeout}s")
+        self._index_to_future.pop(idx)
         self._future_to_index.pop(future, None)
         self._next_return_index += 1
-        out = ray_tpu.get(future, timeout=timeout)
         self._return_actor(future)
-        return out
+        return ray_tpu.get(future)
 
     def get_next_unordered(self, timeout: Optional[float] = None) -> Any:
         """Next result in COMPLETION order."""
