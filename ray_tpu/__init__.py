@@ -11,6 +11,7 @@ __version__ = "0.1.0"
 
 from ray_tpu._private.api import (  # noqa: F401
     ObjectRef,
+    ObjectRefGenerator,
     available_resources,
     cancel,
     cluster_resources,

@@ -99,6 +99,80 @@ class ObjectRef:
         )
 
 
+class ObjectRefGenerator:
+    """Iterator over a streaming generator task's yielded items
+    (≈ ray.ObjectRefGenerator, `python/ray/_raylet.pyx:273`). Each
+    ``next()`` blocks until the executor reports the next item and yields
+    an ordinary ObjectRef (pass it to get/wait/tasks as usual). Iteration
+    raises the task's error after the last successfully yielded item, and
+    StopIteration at exhaustion. Usable from async code via ``async for``.
+
+    Not serializable: the stream state lives in the owner process (the
+    reference has the same restriction for the plain generator type)."""
+
+    def __init__(self, task_id, owner_addr):
+        self._task_id = task_id
+        self._owner_addr = tuple(owner_addr)
+        self._cursor = 0
+        self._released = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ObjectRef:
+        return self._next(timeout=None)
+
+    def _next(self, timeout: Optional[float] = None) -> ObjectRef:
+        core = _require_core()
+        oid = core.stream_next(self._task_id, self._cursor, timeout)
+        self._cursor += 1
+        return ObjectRef(oid, self._owner_addr)
+
+    next = _next  # explicit-timeout spelling: gen.next(timeout=...)
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> ObjectRef:
+        import asyncio
+
+        _end = object()  # StopIteration cannot be raised into a Future
+
+        def step():
+            try:
+                return self.__next__()
+            except StopIteration:
+                return _end
+
+        loop = asyncio.get_running_loop()
+        out = await loop.run_in_executor(None, step)
+        if out is _end:
+            raise StopAsyncIteration
+        return out
+
+    def completed(self) -> bool:
+        core = _require_core()
+        stream = core._streams.get(self._task_id)
+        return stream is None or (stream.finished
+                                  and self._cursor >= len(stream.items))
+
+    def task_id(self):
+        return self._task_id
+
+    def __reduce__(self):
+        raise TypeError("ObjectRefGenerator is not serializable; consume it "
+                        "in the owner process and pass the yielded "
+                        "ObjectRefs instead")
+
+    def __del__(self):
+        if not self._released and _core is not None:
+            try:
+                _core.stream_released(self._task_id)
+            except Exception:
+                pass
+            self._released = True
+
+
 def _deserialize_ref(raw: bytes, owner) -> ObjectRef:
     ref = ObjectRef(ObjectID(raw), tuple(owner))
     # register as borrower with the owner (best-effort distributed refcount)
@@ -375,19 +449,34 @@ def kill(actor: "ActorHandle", *, no_restart: bool = True) -> None:
     _require_core().kill_actor(actor._actor_id, no_restart=no_restart)
 
 
-def cancel(ref: ObjectRef, *, force: bool = False) -> None:
-    """Best-effort cancellation of a queued task."""
+def cancel(ref, *, force: bool = False) -> None:
+    """Best-effort cancellation of a queued task (ObjectRef or
+    ObjectRefGenerator — cancelling a generator stops the stream between
+    yields; items already yielded stay consumable)."""
     if _client is not None:
         _client.cancel(ref, force=force)
         return
     core = _require_core()
-    task = core._inflight_tasks.get(ref._object_id.task_id())
-    if task is not None and task.lease is not None:
+    if isinstance(ref, ObjectRefGenerator):
+        task_id = ref._task_id
+    else:
+        task_id = ref._object_id.task_id()
+    task = core._inflight_tasks.get(task_id)
+    if task is None:
+        return
+    addr = None
+    if task.lease is not None:
+        addr = task.lease.worker_addr
+    elif task.spec.actor_id is not None:
+        # actor tasks ride the handle's push channel, not a lease
+        state = core._actor_states.get(task.spec.actor_id.hex())
+        addr = state.address if state is not None else None
+    if addr is not None:
         import asyncio
 
         asyncio.run_coroutine_threadsafe(
-            core.clients.get(task.lease.worker_addr).call(
-                "cancel", {"task_id": ref._object_id.task_id().binary()}
+            core.clients.get(tuple(addr)).call(
+                "cancel", {"task_id": task_id.binary()}
             ),
             core.loop,
         )
@@ -494,8 +583,8 @@ class RemoteFunction:
         opts = self._options
         key, blob = self._materialize()
         resources = _resources_from_options(opts)
-        num_returns = opts.get("num_returns", 1)
-        oids = core.submit_task(
+        num_returns = _norm_num_returns(opts.get("num_returns", 1))
+        out = core.submit_task(
             None,
             args,
             kwargs,
@@ -508,8 +597,11 @@ class RemoteFunction:
             runtime_env=_resolve_runtime_env(opts.get("runtime_env"), core),
             function_key=key,
             function_blob=blob,
+            backpressure=_backpressure_from_options(opts),
         )
-        refs = [ObjectRef(oid, core.address) for oid in oids]
+        if num_returns < 0:
+            return ObjectRefGenerator(out, core.address)
+        refs = [ObjectRef(oid, core.address) for oid in out]
         return refs[0] if num_returns == 1 else refs
 
     def bind(self, *args, **kwargs):
@@ -533,6 +625,21 @@ def _resolve_runtime_env(env, core):
     from ray_tpu._private.runtime_env import resolve_runtime_env
 
     return resolve_runtime_env(env, core)
+
+
+def _norm_num_returns(v) -> int:
+    """"streaming"/"dynamic" -> -1 (generator task); ints pass through."""
+    if v in ("streaming", "dynamic"):
+        return -1
+    return int(v)
+
+
+def _backpressure_from_options(opts: Dict[str, Any]) -> int:
+    """Generator backpressure window; accepts our name and the
+    reference's `_generator_backpressure_num_objects`."""
+    v = opts.get("generator_backpressure",
+                 opts.get("_generator_backpressure_num_objects", 0))
+    return max(0, int(v or 0))
 
 
 def _resources_from_options(opts: Dict[str, Any]) -> Optional[Dict[str, float]]:
@@ -570,13 +677,16 @@ def _strategy_from_options(opts: Dict[str, Any]) -> SchedulingStrategy:
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", name: str, num_returns=1,
+                 backpressure: int = 0):
         self._handle = handle
         self._name = name
-        self._num_returns = num_returns
+        self._num_returns = _norm_num_returns(num_returns)
+        self._backpressure = backpressure
 
-    def options(self, num_returns: int = 1) -> "ActorMethod":
-        return ActorMethod(self._handle, self._name, num_returns)
+    def options(self, num_returns=1, **kw) -> "ActorMethod":
+        return ActorMethod(self._handle, self._name, num_returns,
+                           backpressure=_backpressure_from_options(kw))
 
     def bind(self, *args, **kwargs):
         """Build a lazy DAG node for this actor method (ray.dag analog)."""
@@ -586,15 +696,18 @@ class ActorMethod:
 
     def remote(self, *args, **kwargs):
         core = _require_core()
-        oids = core.submit_actor_task(
+        out = core.submit_actor_task(
             self._handle._actor_id,
             self._name,
             args,
             kwargs,
             num_returns=self._num_returns,
             max_task_retries=self._handle._max_task_retries,
+            backpressure=self._backpressure,
         )
-        refs = [ObjectRef(oid, core.address) for oid in oids]
+        if self._num_returns < 0:
+            return ObjectRefGenerator(out, core.address)
+        refs = [ObjectRef(oid, core.address) for oid in out]
         return refs[0] if self._num_returns == 1 else refs
 
     def __call__(self, *a, **k):
@@ -643,7 +756,7 @@ class ActorClass:
         opts = self._options
         resources = _resources_from_options(opts)
         is_async = any(
-            inspect.iscoroutinefunction(m)
+            inspect.iscoroutinefunction(m) or inspect.isasyncgenfunction(m)
             for _, m in inspect.getmembers(self._cls, inspect.isfunction)
         )
         actor_id, _ = core.create_actor(

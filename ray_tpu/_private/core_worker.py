@@ -112,6 +112,31 @@ class _PendingTask:
     lease: Optional[_Lease] = None
 
 
+class _StreamEnd(Exception):
+    """Internal end-of-stream marker (StopIteration cannot cross
+    coroutine boundaries, PEP 479)."""
+
+
+class _StreamState:
+    """Owner-side state of one streaming generator task
+    (≈ the reference's task-manager stream bookkeeping behind
+    ObjectRefGenerator, `_raylet.pyx:273` / item reporting
+    `core_worker.cc:3260`). Items land here as the executor yields them;
+    consumers block on `event` for the next item, total count, or error."""
+
+    __slots__ = ("items", "total", "error", "event", "consumed",
+                 "consumed_event", "finished")
+
+    def __init__(self):
+        self.items: List[ObjectID] = []  # yield order; entries in .objects
+        self.total: Optional[int] = None  # item count once exhausted
+        self.error: Optional[Exception] = None
+        self.event = asyncio.Event()
+        self.consumed = 0  # high-water mark acked to the executor
+        self.consumed_event = asyncio.Event()  # backpressure long-poll
+        self.finished = False
+
+
 class ActorHandleState:
     """Client-side state for one actor handle lineage (shared across copies)."""
 
@@ -172,6 +197,8 @@ class CoreWorker:
         # lineage accounting in task_manager.h:215)
         self._lineage: "OrderedDict[TaskID, Tuple[TaskSpec, int]]" = OrderedDict()
         self._lineage_bytes = 0
+        # streaming generator tasks: task_id -> owner-side stream state
+        self._streams: Dict[TaskID, _StreamState] = {}
 
         self.loop = asyncio.new_event_loop()
         self._loop_thread = threading.Thread(
@@ -320,7 +347,11 @@ class CoreWorker:
         runtime_env: Optional[Dict[str, Any]] = None,
         function_key: Optional[str] = None,
         function_blob: Optional[bytes] = None,
-    ) -> List[ObjectID]:
+        backpressure: int = 0,
+    ):
+        """Returns the task's return ObjectIDs — or, for a streaming task
+        (num_returns=-1), its TaskID (the handle the ObjectRefGenerator
+        consumes the stream through)."""
         if function_key is None:
             function_blob = serialization.dumps(function)
             function_key = hashlib.sha256(function_blob).hexdigest()
@@ -340,14 +371,17 @@ class CoreWorker:
             retry_exceptions=retry_exceptions,
             owner=self.address,
             runtime_env=runtime_env,
+            backpressure=backpressure,
         )
         from ray_tpu.util import tracing
 
         spec.trace_ctx = tracing.context_for_submission()
+        if spec.is_streaming:
+            self._streams[spec.task_id] = _StreamState()
         return_ids = spec.return_ids()
         self._run_nowait(self._guarded_submit(
             spec, self._async_submit(spec), (tuple(args), kwargs)))
-        return return_ids
+        return spec.task_id if spec.is_streaming else return_ids
 
     async def _guarded_submit(self, spec: TaskSpec, coro,
                               arg_holders=None) -> None:
@@ -625,6 +659,17 @@ class CoreWorker:
                     entry.location = tuple(payload["node_addr"])
                     any_shared = True
                 self._wake(entry)
+            if "stream_count" in body:
+                # streaming task exhausted: seal the stream at this count
+                stream = self._streams.get(task_id)
+                if stream is not None:
+                    stream.total = body["stream_count"]
+                    stream.finished = True
+                    stream.event.set()
+                    if stream.consumed >= (1 << 31):
+                        # reconstruction replay (no live consumer): done
+                        self._streams.pop(task_id, None)
+                any_shared = any_shared or body.get("stream_any_shared", False)
             if spec is not None:
                 self._record_event(spec, "FINISHED")
                 if any_shared:
@@ -638,6 +683,134 @@ class CoreWorker:
                 await self._pump_shape(lease.shape_key, spec)
                 if lease.in_flight == 0 and not self._task_queues.get(lease.shape_key):
                     asyncio.get_running_loop().create_task(self._maybe_release(lease))
+
+    # ----------------------------------------------------------- streaming
+
+    async def rpc_stream_item(self, body) -> dict:
+        """Executor reports one yielded item of a streaming generator task
+        (≈ ReportGeneratorItemReturns, core_worker.cc:3260). The item
+        becomes an owned object immediately — ownership rests with the
+        caller from the moment of the report, which is the worker→owner
+        transfer the reference does for dynamically created returns.
+        Returns the consumption watermark (executor-side backpressure)."""
+        task_id = TaskID(body["task_id"])
+        stream = self._streams.get(task_id)
+        if stream is None:
+            # consumer released the stream (lineage reconstruction always
+            # recreates state first, so None really means released): do
+            # NOT store the item — nothing would ever free it
+            return {"consumed": 0, "stop": True}
+        if stream.finished and stream.error is not None:
+            return {"consumed": stream.consumed, "stop": True}
+        index = body["index"]
+        oid = ObjectID(body["object_id"])
+        entry = self._ensure_entry(oid)
+        if body["kind"] == "inline":
+            self.in_process.put(oid, body["payload"])
+            entry.state = INLINE
+            entry.size = len(body["payload"])
+        else:
+            entry.state = SHARED
+            entry.size = body["payload"]["size"]
+            entry.location = tuple(body["payload"]["node_addr"])
+        self._wake(entry)
+        if index == len(stream.items):
+            stream.items.append(oid)
+        elif index > len(stream.items):
+            # executor reports strictly in order; a gap means a protocol
+            # bug — fail loudly rather than hand out wrong items, and
+            # stop the producer
+            stream.error = RuntimeError(
+                f"stream item gap: got index {index}, "
+                f"have {len(stream.items)}")
+            stream.finished = True
+            stream.event.set()
+            return {"consumed": stream.consumed, "stop": True}
+        # index < len(items): re-execution replay after a worker death —
+        # same deterministic id, entry refreshed above
+        stream.event.set()
+        return {"consumed": stream.consumed, "stop": False}
+
+    async def rpc_stream_state(self, body) -> dict:
+        """Backpressure wait: block (bounded) until the consumer has
+        advanced to `wait_for` items, so a paused producer holds ONE
+        long-poll RPC instead of hammering the owner's IO loop."""
+        stream = self._streams.get(TaskID(body["task_id"]))
+        if stream is None:
+            return {"consumed": 0, "stop": True}
+        wait_for = body.get("wait_for", 0)
+        deadline = time.monotonic() + min(
+            float(body.get("timeout", 5.0)), 30.0)
+        while (stream.consumed < wait_for
+               and time.monotonic() < deadline):
+            stream.consumed_event.clear()
+            try:
+                await asyncio.wait_for(
+                    stream.consumed_event.wait(),
+                    max(0.0, deadline - time.monotonic()))
+            except asyncio.TimeoutError:
+                break
+            if self._streams.get(TaskID(body["task_id"])) is not stream:
+                return {"consumed": stream.consumed, "stop": True}
+        return {"consumed": stream.consumed, "stop": False}
+
+    async def _async_stream_next(self, task_id: TaskID, index: int,
+                                 deadline: Optional[float]):
+        # _StreamEnd (not StopIteration): PEP 479 turns a StopIteration
+        # escaping a coroutine into RuntimeError
+        stream = self._streams.get(task_id)
+        if stream is None:
+            raise _StreamEnd  # released
+        while True:
+            if index < len(stream.items):
+                if index + 1 > stream.consumed:
+                    stream.consumed = index + 1
+                    stream.consumed_event.set()  # wake backpressure waiters
+                return stream.items[index]
+            if stream.error is not None:
+                raise stream.error
+            if stream.total is not None and index >= stream.total:
+                raise _StreamEnd
+            stream.event.clear()
+            timeout = None
+            if deadline is not None:
+                timeout = deadline - time.monotonic()
+                if timeout <= 0:
+                    raise TimeoutError(
+                        f"stream item {index} not ready in time")
+            try:
+                await asyncio.wait_for(stream.event.wait(), timeout)
+            except asyncio.TimeoutError:
+                raise TimeoutError(
+                    f"stream item {index} not ready in time") from None
+
+    def stream_next(self, task_id: TaskID, index: int,
+                    timeout: Optional[float] = None) -> ObjectID:
+        """Blocking fetch of the index-th item's ObjectID; raises
+        StopIteration at end-of-stream, the task's error after its last
+        yielded item, or TimeoutError."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        try:
+            return self._run(
+                self._async_stream_next(task_id, index, deadline))
+        except _StreamEnd:
+            raise StopIteration from None
+
+    def stream_released(self, task_id: TaskID) -> None:
+        """Consumer dropped the generator: free unconsumed items and the
+        stream state (ref accounting: consumed items live on through the
+        ObjectRefs handed to the user; unconsumed ones die here)."""
+        self._run_nowait(self._async_stream_release(task_id))
+
+    async def _async_stream_release(self, task_id: TaskID) -> None:
+        stream = self._streams.pop(task_id, None)
+        if stream is None:
+            return
+        stream.consumed_event.set()  # unblock any backpressure long-poll
+        for oid in stream.items[stream.consumed:]:
+            entry = self.objects.get(oid)
+            if entry is not None:
+                self._maybe_free(entry)
 
     # ------------------------------------------------------------- lineage
 
@@ -685,7 +858,17 @@ class CoreWorker:
             return False
         spec, _ = rec
         _trace(f"reconstruct {spec.name} for {oid.hex()[:12]}")
-        for rid in spec.return_ids():
+        reset_ids = spec.return_ids()
+        if spec.is_streaming:
+            # the lost item is the one to resurrect; recreate stream state
+            # (consumer may have released it) with an unbounded consumed
+            # watermark so the replay is never backpressured or stopped
+            reset_ids = [oid]
+            if spec.task_id not in self._streams:
+                stream = _StreamState()
+                stream.consumed = 1 << 31
+                self._streams[spec.task_id] = stream
+        for rid in reset_ids:
             entry = self._ensure_entry(rid)
             entry.state = PENDING
             entry.error = None
@@ -828,6 +1011,15 @@ class CoreWorker:
             entry.state = FAILED
             entry.error = err
             self._wake(entry)
+        if spec.is_streaming:
+            stream = self._streams.get(spec.task_id)
+            if stream is not None and not stream.finished:
+                # items yielded before the failure stay consumable; the
+                # error surfaces after the last of them (reference
+                # generator semantics)
+                stream.error = err
+                stream.finished = True
+                stream.event.set()
         self._unpin_arg_refs(spec)
 
     def _pin_arg_refs(self, spec: TaskSpec) -> None:
@@ -1299,7 +1491,8 @@ class CoreWorker:
         *,
         num_returns: int = 1,
         max_task_retries: int = 0,
-    ) -> List[ObjectID]:
+        backpressure: int = 0,
+    ):
         spec = TaskSpec(
             task_id=TaskID.from_random(),
             job_id=self.job_id,
@@ -1312,15 +1505,18 @@ class CoreWorker:
             actor_id=actor_id,
             method_name=method_name,
             max_retries=max_task_retries,
+            backpressure=backpressure,
         )
         from ray_tpu.util import tracing
 
         spec.trace_ctx = tracing.context_for_submission()
+        if spec.is_streaming:
+            self._streams[spec.task_id] = _StreamState()
         return_ids = spec.return_ids()
         self._run_nowait(self._guarded_submit(
             spec, self._async_submit_actor_task(spec),
             (tuple(args), kwargs)))
-        return return_ids
+        return spec.task_id if spec.is_streaming else return_ids
 
     async def _async_submit_actor_task(self, spec: TaskSpec) -> None:
         _trace(f"submit_actor_task {spec.name} seq? actor={spec.actor_id.hex()[:8]}")

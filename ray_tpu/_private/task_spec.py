@@ -77,6 +77,9 @@ class TaskSpec:
     name: str  # human-readable, for errors/observability
     function_key: str  # controller function-table key (sha256 of pickled fn)
     args: List[TaskArg]
+    # -1 = streaming generator task (`num_returns="streaming"`): the task
+    # yields a dynamic number of items, each reported to the owner as it
+    # is produced (≈ reference ObjectRefGenerator, _raylet.pyx:273)
     num_returns: int = 1
     # None = unspecified (defaults to 1 CPU for normal tasks); {} = explicitly
     # zero-resource (schedulable anywhere, like the reference's num_cpus=0)
@@ -98,8 +101,20 @@ class TaskSpec:
     # distributed tracing: caller's span context (util/tracing.py); the
     # executing worker opens a child span around the user function
     trace_ctx: Optional[Dict[str, str]] = None
+    # streaming only: executor pauses when this many yielded items are
+    # unconsumed at the owner (0 = unbounded), ≈ the reference's
+    # _generator_backpressure_num_objects
+    backpressure: int = 0
+
+    @property
+    def is_streaming(self) -> bool:
+        return self.num_returns < 0
 
     def return_ids(self) -> List[ObjectID]:
+        if self.is_streaming:
+            # item ids are minted per yield (ObjectID.for_task_return with
+            # the yield index); the owner's stream state tracks them
+            return []
         return [
             ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)
         ]

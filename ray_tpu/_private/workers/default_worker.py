@@ -60,6 +60,8 @@ class Executor:
         # and retry elsewhere/again — a task id must execute at most once
         # here (bounded LRU)
         self._seen_pushes: "OrderedDict[TaskID, bool]" = OrderedDict()
+        # streaming: last consumption watermark the owner told us, per task
+        self._stream_consumed: Dict[TaskID, int] = {}
         self._tpu_env_set = False
         self._lock = threading.Lock()
 
@@ -204,6 +206,9 @@ class Executor:
                     # sync path hit an async def: run it to completion here
                     result = asyncio.new_event_loop().run_until_complete(
                         result)
+                if spec.is_streaming:
+                    self._run_generator(spec, result)
+                    return
             results = self._split_returns(spec, result)
             self._report_results(spec, results)
         except Exception as e:  # noqa: BLE001 — user exception crosses to owner
@@ -224,6 +229,9 @@ class Executor:
                 result = fn(*args, **kwargs)
                 if asyncio.iscoroutine(result):
                     result = await result
+                if spec.is_streaming:
+                    await self._run_async_generator(spec, result)
+                    return
             results = self._split_returns(spec, result)
             self._report_results(spec, results)
         except Exception as e:  # noqa: BLE001
@@ -251,6 +259,140 @@ class Executor:
                 f"returned {type(result).__name__}"
             )
         return list(result)
+
+    # -- streaming generator tasks (num_returns="streaming") --
+
+    def _run_generator(self, spec: TaskSpec, gen) -> None:
+        """Drive a sync generator, reporting each yielded item to the
+        owner as it is produced (≈ executor-side item reporting,
+        core_worker.cc:3260). Item ids are deterministic
+        (task_id + yield index) so a retried execution after a worker
+        death replays onto the same ids."""
+        if hasattr(gen, "__anext__"):
+            # async generator reached the sync executor (e.g. a task
+            # function defined async): drive it on a private loop
+            asyncio.new_event_loop().run_until_complete(
+                self._run_async_generator(spec, gen))
+            return
+        if not hasattr(gen, "__next__"):
+            raise TypeError(
+                f"task {spec.name} declared num_returns='streaming' but "
+                f"returned {type(gen).__name__}, not a generator")
+        from ray_tpu._private.exceptions import TaskCancelledError
+
+        index = 0
+        any_shared = False
+        try:
+            for item in gen:
+                if spec.task_id in self._cancelled:
+                    self._report_error(
+                        spec, TaskCancelledError(spec.name), retryable=False)
+                    return
+                any_shared |= self._report_stream_item(spec, index, item)
+                index += 1
+                self._stream_backpressure(spec, index)
+        except Exception as e:  # noqa: BLE001 — user generator raised
+            self._report_error(spec, TaskError.from_exception(spec.name, e),
+                               spec.retry_exceptions)
+            return
+        finally:
+            self._stream_cleanup(spec)
+        self._send_done(spec, {
+            "task_id": spec.task_id.binary(), "results": [],
+            "stream_count": index, "stream_any_shared": any_shared})
+
+    def _stream_cleanup(self, spec: TaskSpec) -> None:
+        """Per-stream executor state must not outlive the stream — a
+        long-lived replica serves millions of them (the adjacent
+        _seen_pushes cache is bounded for the same reason)."""
+        self._stream_consumed.pop(spec.task_id, None)
+        self._cancelled.discard(spec.task_id)
+
+    async def _run_async_generator(self, spec: TaskSpec, agen) -> None:
+        """Async-actor variant: drive an async generator on the actor's
+        event loop (items interleave with other concurrent methods)."""
+        if not hasattr(agen, "__anext__"):
+            # plain generator from an async actor: drive it OFF the actor
+            # loop — per-item report RPCs and backpressure sleeps would
+            # otherwise stall every concurrent method and health ping
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._run_generator, spec, agen)
+            return
+        from ray_tpu._private.exceptions import TaskCancelledError
+
+        index = 0
+        any_shared = False
+        loop = asyncio.get_running_loop()
+        try:
+            async for item in agen:
+                if spec.task_id in self._cancelled:
+                    self._report_error(
+                        spec, TaskCancelledError(spec.name), retryable=False)
+                    return
+                any_shared |= await loop.run_in_executor(
+                    None, self._report_stream_item, spec, index, item)
+                index += 1
+                await loop.run_in_executor(
+                    None, self._stream_backpressure, spec, index)
+        except Exception as e:  # noqa: BLE001
+            self._report_error(spec, TaskError.from_exception(spec.name, e),
+                               spec.retry_exceptions)
+            return
+        finally:
+            self._stream_cleanup(spec)
+        self._send_done(spec, {
+            "task_id": spec.task_id.binary(), "results": [],
+            "stream_count": index, "stream_any_shared": any_shared})
+
+    def _report_stream_item(self, spec: TaskSpec, index: int, item) -> bool:
+        """Ship one yielded item to the owner; returns True if it went to
+        the shared arena (size-routed exactly like normal returns)."""
+        oid = ObjectID.for_task_return(spec.task_id, index)
+        packed = serialization.pack(item)
+        body = {"task_id": spec.task_id.binary(), "index": index,
+                "object_id": oid.binary()}
+        shared = len(packed) > self.core.config.max_direct_call_object_size
+        if shared:
+            self.core._run(self._store_shared(oid, packed))
+            body["kind"] = "shared"
+            body["payload"] = {"size": len(packed),
+                               "node_addr": self.core.supervisor_addr}
+        else:
+            body["kind"] = "inline"
+            body["payload"] = packed
+        reply = self.core._run(
+            self.core.clients.get(tuple(spec.owner)).call("stream_item", body))
+        self._stream_consumed[spec.task_id] = reply.get("consumed", 0)
+        if reply.get("stop"):
+            self._cancelled.add(spec.task_id)  # consumer released the stream
+        return shared
+
+    def _stream_backpressure(self, spec: TaskSpec, produced: int) -> None:
+        """Pause when the owner's consumer lags more than the configured
+        window (spec.backpressure, 0 = unbounded) — ≈ the reference's
+        _generator_backpressure_num_objects."""
+        if spec.backpressure <= 0:
+            return
+        while (produced - self._stream_consumed.get(spec.task_id, 0)
+               >= spec.backpressure
+               and spec.task_id not in self._cancelled):
+            # owner-side long-poll: ONE rpc blocks until the consumer
+            # reaches the watermark (or 5s passes) instead of hammering
+            # the owner's IO loop with 20ms polls
+            wait_for = produced - spec.backpressure + 1
+            try:
+                reply = self.core._run(
+                    self.core.clients.get(tuple(spec.owner)).call(
+                        "stream_state",
+                        {"task_id": spec.task_id.binary(),
+                         "wait_for": wait_for, "timeout": 5.0},
+                        timeout=30.0))
+            except Exception:
+                return  # owner gone: stop pausing, let the report fail
+            self._stream_consumed[spec.task_id] = reply.get("consumed", 0)
+            if reply.get("stop"):
+                self._cancelled.add(spec.task_id)
+                return
 
     # -- result reporting (owner is the submitter) --
 

@@ -120,6 +120,60 @@ class ReplicaActor:
 
                 _request_model_id.reset(mux_token)
 
+    async def handle_request_streaming(self, method_name: str, args, kwargs):
+        """Streaming entry: an async generator the handle invokes with
+        ``num_returns="streaming"`` — each yielded chunk becomes an
+        owner-owned object the instant it is produced (native generator
+        transport; ≈ the reference's handle.options(stream=True) riding
+        ObjectRefGenerator instead of the chunk-pull `stream_next` path
+        below, which remains for un-optioned callers)."""
+        from ray_tpu.serve.multiplex import _set_request_model_id
+
+        mux_token = None
+        if kwargs and "__serve_mux_id" in kwargs:
+            mux_token = _set_request_model_id(kwargs.pop("__serve_mux_id"))
+        self._ongoing += 1
+        self._total += 1
+        try:
+            if self._is_function:
+                fn = self._callable
+            else:
+                fn = getattr(self._callable, method_name or "__call__")
+            out = fn(*args, **(kwargs or {}))
+            if inspect.isawaitable(out):
+                out = await out
+            if inspect.isasyncgen(out):
+                async for item in out:
+                    yield item
+            elif inspect.isgenerator(out):
+                # sync generator (e.g. a jitted decode step per token):
+                # step it off-loop so health checks keep flowing
+                import contextvars as _cv
+
+                loop = asyncio.get_running_loop()
+                ctx = _cv.copy_context()
+                _end = object()
+
+                def step():
+                    try:
+                        return ctx.run(next, out)
+                    except StopIteration:
+                        return _end
+
+                while True:
+                    item = await loop.run_in_executor(None, step)
+                    if item is _end:
+                        break
+                    yield item
+            else:
+                yield out  # non-streaming callable: single-chunk stream
+        finally:
+            self._ongoing -= 1
+            if mux_token is not None:
+                from ray_tpu.serve.multiplex import _request_model_id
+
+                _request_model_id.reset(mux_token)
+
     async def _stream_reaper(self) -> None:
         """Abandoned streams (consumer gone mid-iteration) must not pump
         the generator, hold buffered chunks, or count as ongoing work for

@@ -136,9 +136,14 @@ class Router:
         return ref
 
     def assign_request_with_replica(self, method_name: str, args, kwargs,
-                                    multiplexed_model_id: str = ""):
-        """Returns (result_ref, replica_handle). The replica handle lets
-        callers continue a streaming response on the same replica."""
+                                    multiplexed_model_id: str = "",
+                                    streaming: bool = False):
+        """Returns (result_ref, replica_handle) — or, with streaming=True,
+        (ObjectRefGenerator, replica_handle): the request rides the native
+        generator transport (replica.handle_request_streaming) and chunks
+        arrive as owner-owned ObjectRefs as they are produced. The replica
+        handle lets callers continue a chunk-pull streaming response on
+        the same replica (legacy path)."""
         self._ensure_polling()
         if multiplexed_model_id:
             self._ensure_mux_refresh()
@@ -168,6 +173,17 @@ class Router:
                 self._mux_marks[(multiplexed_model_id, key)] = (
                     time.monotonic())
                 self._mux_last_request = time.monotonic()
+        if streaming:
+            gen = replica.handle_request_streaming.options(
+                num_returns="streaming").remote(method_name, args, kwargs)
+            # in-flight accounting: count the submit only — stream
+            # lifetime is tracked replica-side (_active_streams feeds
+            # autoscaling), and a long-lived stream must not permanently
+            # skew the pow-2 counter
+            with self._lock:
+                if idx in self._inflight and self._inflight[idx] > 0:
+                    self._inflight[idx] -= 1
+            return gen, replica
         ref = replica.handle_request.remote(method_name, args, kwargs)
         self._watch_completion(ref, idx)
         return ref, replica

@@ -25,10 +25,33 @@ class DeploymentResponse:
         self._replica = replica
 
     def result(self, timeout: Optional[float] = None) -> Any:
+        if isinstance(self._ref, ray_tpu.ObjectRefGenerator):
+            raise TypeError(
+                "streaming response (handle.options(stream=True)): iterate "
+                "it instead of calling .result()")
         return ray_tpu.get(self._ref, timeout=timeout)
 
     def __await__(self):
+        if isinstance(self._ref, ray_tpu.ObjectRefGenerator):
+            raise TypeError(
+                "streaming response (handle.options(stream=True)): use "
+                "'async for' instead of awaiting it")
         return self._ref.__await__()
+
+    def __aiter__(self):
+        """Async streaming: async-for over chunks (each awaited get)."""
+        async def agen():
+            if isinstance(self._ref, ray_tpu.ObjectRefGenerator):
+                async for chunk_ref in self._ref:
+                    yield await chunk_ref
+                return
+            out = await self._ref
+            if isinstance(out, dict) and STREAM_MARKER in out:
+                raise TypeError("chunk-pull streams are sync-iterate only; "
+                                "use handle.options(stream=True) for async")
+            yield out
+
+        return agen()
 
     @property
     def ref(self):
@@ -36,6 +59,12 @@ class DeploymentResponse:
 
     def __iter__(self) -> Iterator[Any]:
         """Stream the response. Non-streaming results yield once."""
+        if isinstance(self._ref, ray_tpu.ObjectRefGenerator):
+            # native generator transport (handle.options(stream=True)):
+            # chunks are owner-owned refs arriving as produced
+            for chunk_ref in self._ref:
+                yield ray_tpu.get(chunk_ref)
+            return
         out = self.result()
         if not (isinstance(out, dict) and STREAM_MARKER in out):
             yield out
@@ -64,18 +93,28 @@ class _BoundMethod:
 
 class DeploymentHandle:
     def __init__(self, app_name: str, deployment_name: str,
-                 controller=None, multiplexed_model_id: str = ""):
+                 controller=None, multiplexed_model_id: str = "",
+                 stream: bool = False):
         self._app = app_name
         self._deployment = deployment_name
         self._controller = controller
         self._router: Optional[Router] = None
         self._mux_id = multiplexed_model_id
+        self._stream = stream
 
-    def options(self, *, multiplexed_model_id: str = "") -> "DeploymentHandle":
+    def options(self, *, multiplexed_model_id: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
         """≈ `serve.handle.DeploymentHandle.options`: a copy of this handle
-        whose requests carry (and route by) the multiplexed model id."""
-        h = DeploymentHandle(self._app, self._deployment, self._controller,
-                             multiplexed_model_id=multiplexed_model_id)
+        whose requests carry (and route by) the multiplexed model id
+        and/or stream via the native generator transport (stream=True,
+        ≈ the reference's handle.options(stream=True)). Unspecified
+        options keep their current values, so chained .options() calls
+        compose."""
+        h = DeploymentHandle(
+            self._app, self._deployment, self._controller,
+            multiplexed_model_id=(self._mux_id if multiplexed_model_id
+                                  is None else multiplexed_model_id),
+            stream=self._stream if stream is None else stream)
         # share ONE router (and its replica view + affinity state) across
         # all options() copies — materialize it now so per-request
         # h.options(...) calls don't each build a router + poll threads
@@ -105,7 +144,8 @@ class DeploymentHandle:
         if self._mux_id:
             kwargs = dict(kwargs, __serve_mux_id=self._mux_id)
         ref, replica = self._get_router().assign_request_with_replica(
-            method, args, kwargs, multiplexed_model_id=self._mux_id)
+            method, args, kwargs, multiplexed_model_id=self._mux_id,
+            streaming=self._stream)
         return DeploymentResponse(ref, replica=replica)
 
     def __getattr__(self, name: str) -> _BoundMethod:
@@ -115,4 +155,5 @@ class DeploymentHandle:
 
     def __reduce__(self):
         return (DeploymentHandle,
-                (self._app, self._deployment, None, self._mux_id))
+                (self._app, self._deployment, None, self._mux_id,
+                 self._stream))

@@ -162,6 +162,12 @@ class ClientServer:
             blob = body["fn"]
             key = hashlib.sha256(blob).hexdigest()
             num_returns = opts.get("num_returns", 1)
+            if (num_returns in ("streaming", "dynamic")
+                    or (isinstance(num_returns, int) and num_returns < 0)):
+                # stream state lives in the owner process; a client://
+                # proxy consumer needs per-item forwarding (not yet built)
+                raise NotImplementedError(
+                    "num_returns='streaming' is not supported in client mode")
             oids = core.submit_task(
                 None, args, kwargs,
                 name=opts.get("name") or body.get("fn_name", "client_task"),

@@ -250,6 +250,52 @@ class TestStreaming:
         with pytest.raises(RuntimeError, match="boom"):
             list(it)
 
+    def test_native_generator_transport(self, serve_shutdown):
+        """handle.options(stream=True): chunks ride the streaming-
+        generator task transport (ObjectRefGenerator), not the
+        chunk-pull stream_next path."""
+        @serve.deployment
+        class Streamer:
+            def __call__(self, n):
+                def gen():
+                    for i in range(n):
+                        yield f"n{i}"
+                return gen()
+
+        h = serve.run(Streamer.bind(), name="ngen", route_prefix="/ngen")
+        sh = h.options(stream=True)
+        resp = sh.remote(4)
+        assert isinstance(resp.ref, ray_tpu.ObjectRefGenerator)
+        assert list(resp) == ["n0", "n1", "n2", "n3"]
+        # async generators too
+        @serve.deployment
+        class AStreamer:
+            async def __call__(self, n):
+                async def gen():
+                    for i in range(n):
+                        await asyncio.sleep(0.001)
+                        yield i
+                return gen()
+
+        h2 = serve.run(AStreamer.bind(), name="ngen2",
+                       route_prefix="/ngen2")
+        assert list(h2.options(stream=True).remote(3)) == [0, 1, 2]
+
+    def test_native_stream_error_propagates(self, serve_shutdown):
+        @serve.deployment
+        class Bad:
+            def __call__(self, _):
+                def gen():
+                    yield "ok"
+                    raise ValueError("native boom")
+                return gen()
+
+        h = serve.run(Bad.bind(), name="nbad", route_prefix="/nbad")
+        it = iter(h.options(stream=True).remote(None))
+        assert next(it) == "ok"
+        with pytest.raises(Exception, match="native boom"):
+            list(it)
+
 
 class TestLLMDecode:
     """The BASELINE.md serve flagship: batched llama-shaped decode replica

@@ -1,0 +1,287 @@
+"""Streaming generator tasks (`num_returns="streaming"`).
+
+Parity target: the reference's ObjectRefGenerator
+(`python/ray/_raylet.pyx:273`) with executor-side item reporting
+(`src/ray/core_worker/core_worker.cc:3260`): items become owner-owned
+objects the moment they are yielded, consumers iterate ObjectRefs,
+streams survive worker death via deterministic item ids + retry replay,
+and backpressure bounds the producer's lead.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def ray_init():
+    info = ray_tpu.init(
+        num_cpus=8,
+        object_store_memory=128 * 1024 * 1024,
+        ignore_reinit_error=True,
+    )
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_basic_stream(ray_init):
+    @ray_tpu.remote
+    def gen(n):
+        for i in range(n):
+            yield i * 10
+
+    g = gen.options(num_returns="streaming").remote(5)
+    assert isinstance(g, ray_tpu.ObjectRefGenerator)
+    vals = [ray_tpu.get(ref) for ref in g]
+    assert vals == [0, 10, 20, 30, 40]
+
+
+def test_stream_items_are_plain_refs(ray_init):
+    """Yielded refs are ordinary ObjectRefs: usable in wait() and as args
+    to downstream tasks."""
+
+    @ray_tpu.remote
+    def gen():
+        yield 1
+        yield 2
+
+    @ray_tpu.remote
+    def plus_one(x):
+        return x + 1
+
+    g = gen.options(num_returns="streaming").remote()
+    first = next(g)
+    ready, _ = ray_tpu.wait([first], num_returns=1, timeout=10)
+    assert ready == [first]
+    assert ray_tpu.get(plus_one.remote(first)) == 2
+    assert ray_tpu.get(next(g)) == 2
+
+
+def test_stream_incremental_delivery(ray_init):
+    """Items are consumable BEFORE the generator finishes — the defining
+    property vs. num_returns=N."""
+
+    @ray_tpu.remote
+    def slow_gen(tmp):
+        yield "first"
+        # block until the consumer proves it saw item 0
+        while not os.path.exists(tmp):
+            time.sleep(0.02)
+        yield "second"
+
+    import tempfile
+
+    tmp = os.path.join(tempfile.mkdtemp(), "go")
+    g = slow_gen.options(num_returns="streaming").remote(tmp)
+    assert ray_tpu.get(g.next(timeout=20)) == "first"
+    with open(tmp, "w") as f:
+        f.write("x")
+    assert ray_tpu.get(g.next(timeout=20)) == "second"
+    with pytest.raises(StopIteration):
+        g.next(timeout=20)
+
+
+def test_stream_large_items_via_arena(ray_init):
+    """Items over the inline threshold route through the shared arena."""
+    import numpy as np
+
+    @ray_tpu.remote
+    def gen():
+        for i in range(3):
+            yield np.full((256, 1024), i, dtype=np.float32)  # ~1MB
+
+    g = gen.options(num_returns="streaming").remote()
+    for i, ref in enumerate(g):
+        arr = ray_tpu.get(ref)
+        assert arr.shape == (256, 1024) and float(arr[0, 0]) == i
+
+
+def test_stream_error_after_items(ray_init):
+    """Error surfaces AFTER the successfully yielded items."""
+
+    @ray_tpu.remote
+    def bad_gen():
+        yield 1
+        yield 2
+        raise ValueError("boom at 2")
+
+    g = bad_gen.options(num_returns="streaming").remote()
+    assert ray_tpu.get(next(g)) == 1
+    assert ray_tpu.get(next(g)) == 2
+    with pytest.raises(Exception, match="boom"):
+        next(g)
+
+
+def test_stream_non_generator_return_fails(ray_init):
+    @ray_tpu.remote
+    def not_gen():
+        return [1, 2, 3]
+
+    g = not_gen.options(num_returns="streaming").remote()
+    with pytest.raises(Exception, match="generator"):
+        next(g)
+
+
+def test_actor_streaming_method(ray_init):
+    @ray_tpu.remote
+    class Streamer:
+        def tokens(self, n):
+            for i in range(n):
+                yield f"tok{i}"
+
+    a = Streamer.remote()
+    g = a.tokens.options(num_returns="streaming").remote(4)
+    assert [ray_tpu.get(r) for r in g] == ["tok0", "tok1", "tok2", "tok3"]
+    ray_tpu.kill(a)
+
+
+def test_async_actor_async_generator(ray_init):
+    """Async actors stream via async generators interleaved on the loop."""
+
+    @ray_tpu.remote
+    class AsyncStreamer:
+        async def agen(self, n):
+            import asyncio
+
+            for i in range(n):
+                await asyncio.sleep(0.01)
+                yield i * 2
+
+    a = AsyncStreamer.remote()
+    g = a.agen.options(num_returns="streaming").remote(3)
+    assert [ray_tpu.get(r) for r in g] == [0, 2, 4]
+    ray_tpu.kill(a)
+
+
+def test_stream_backpressure(ray_init):
+    """With a backpressure window the producer never leads by more than
+    the window."""
+
+    @ray_tpu.remote
+    def gen(tmp):
+        for i in range(20):
+            with open(tmp, "w") as f:
+                f.write(str(i + 1))  # produced count
+            yield i
+
+    import tempfile
+
+    tmp = os.path.join(tempfile.mkdtemp(), "produced")
+    g = gen.options(num_returns="streaming",
+                    generator_backpressure=3).remote(tmp)
+    # consume slowly; the producer must stay within window+1 of us
+    max_lead = 0
+    for consumed, ref in enumerate(g, start=1):
+        ray_tpu.get(ref)
+        time.sleep(0.03)
+        try:
+            with open(tmp) as f:
+                produced = int(f.read() or 0)
+        except FileNotFoundError:
+            produced = 0
+        max_lead = max(max_lead, produced - consumed)
+    # window 3 plus one item in flight
+    assert max_lead <= 5, f"producer led by {max_lead}"
+
+
+def test_stream_cancel(ray_init):
+    @ray_tpu.remote
+    def endless():
+        i = 0
+        while True:
+            yield i
+            i += 1
+            time.sleep(0.01)
+
+    g = endless.options(num_returns="streaming").remote()
+    assert ray_tpu.get(next(g)) == 0
+    ray_tpu.cancel(g)
+    with pytest.raises(Exception):
+        # drains the few in-flight items, then raises TaskCancelledError
+        for _ in range(1000):
+            next(g)
+
+
+def test_stream_survives_worker_death(ray_init):
+    """Worker dies mid-stream -> retry replays the generator onto the
+    SAME deterministic item ids; the consumer sees a seamless stream and
+    every ref resolves (the VERDICT r3 acceptance bar)."""
+    import tempfile
+
+    marker = os.path.join(tempfile.mkdtemp(), "died_once")
+
+    @ray_tpu.remote(max_retries=2, retry_exceptions=True)
+    def fragile_gen(marker):
+        for i in range(6):
+            if i == 3 and not os.path.exists(marker):
+                with open(marker, "w") as f:
+                    f.write("x")
+                os._exit(1)  # hard crash mid-stream, first execution only
+            yield i * 100
+
+    g = fragile_gen.options(num_returns="streaming").remote(marker)
+    vals = []
+    for ref in g:
+        vals.append(ray_tpu.get(ref))
+    assert vals == [0, 100, 200, 300, 400, 500]
+
+
+def test_stream_release_frees_unconsumed(ray_init):
+    """Dropping the generator releases owner-side stream state."""
+
+    @ray_tpu.remote
+    def gen():
+        for i in range(10):
+            yield bytes(1000)
+
+    g = gen.options(num_returns="streaming").remote()
+    ray_tpu.get(next(g))
+    task_id = g.task_id()
+    core = ray_tpu._private.api._require_core()
+    assert task_id in core._streams
+    del g
+    import gc
+
+    gc.collect()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and task_id in core._streams:
+        time.sleep(0.05)
+    assert task_id not in core._streams
+
+
+def test_stream_async_for(ray_init):
+    """ObjectRefGenerator works with async-for (async consumers)."""
+    import asyncio
+
+    @ray_tpu.remote
+    def gen():
+        yield "a"
+        yield "b"
+
+    g = gen.options(num_returns="streaming").remote()
+
+    async def consume():
+        out = []
+        async for ref in g:
+            out.append(ray_tpu.get(ref))
+        return out
+
+    assert asyncio.run(consume()) == ["a", "b"]
+
+
+def test_generator_not_serializable(ray_init):
+    @ray_tpu.remote
+    def gen():
+        yield 1
+
+    @ray_tpu.remote
+    def consume(g):
+        return list(g)
+
+    g = gen.options(num_returns="streaming").remote()
+    with pytest.raises(Exception):
+        consume.remote(g)
+    assert ray_tpu.get(next(g)) == 1
