@@ -134,19 +134,10 @@ class ObjectRefGenerator:
         return self
 
     async def __anext__(self) -> ObjectRef:
-        import asyncio
+        from ray_tpu._private.async_utils import END_OF_ITERATION, step_off_loop
 
-        _end = object()  # StopIteration cannot be raised into a Future
-
-        def step():
-            try:
-                return self.__next__()
-            except StopIteration:
-                return _end
-
-        loop = asyncio.get_running_loop()
-        out = await loop.run_in_executor(None, step)
-        if out is _end:
+        out = await step_off_loop(self.__next__)
+        if out is END_OF_ITERATION:
             raise StopAsyncIteration
         return out
 

@@ -624,6 +624,19 @@ class CoreWorker:
 
     # ------------------------------------------------------------- owner RPCs
 
+    async def rpc_task_done_batch(self, body) -> None:
+        """Coalesced completion reports (executor-side reply batching —
+        the mirror of push_task_batch on the submit side). Each report is
+        isolated: one malformed body (e.g. an error payload whose class
+        only unpickles worker-side) must not strand the other N-1
+        callers in get()."""
+        for done in body["dones"]:
+            try:
+                await self.rpc_task_done(done)
+            except Exception:
+                logger.exception("task_done in batch failed (task %s)",
+                                 done.get("task_id", b"").hex()[:12])
+
     async def rpc_task_done(self, body) -> None:
         _trace(f"task_done received {body.get('task_id', b'').hex()[:12]} err={body.get('error') is not None}")
         """Executor reports task completion to the owner
@@ -1013,7 +1026,12 @@ class CoreWorker:
             self._wake(entry)
         if spec.is_streaming:
             stream = self._streams.get(spec.task_id)
-            if stream is not None and not stream.finished:
+            if stream is not None and stream.consumed >= (1 << 31):
+                # failed reconstruction replay: no live consumer exists
+                # to release the sentinel state — drop it here or it
+                # leaks per failed reconstruction
+                self._streams.pop(spec.task_id, None)
+            elif stream is not None and not stream.finished:
                 # items yielded before the failure stay consumable; the
                 # error surfaces after the last of them (reference
                 # generator semantics)

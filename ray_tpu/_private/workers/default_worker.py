@@ -28,7 +28,7 @@ import logging
 import os
 import threading
 import traceback
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional
 
@@ -62,6 +62,11 @@ class Executor:
         self._seen_pushes: "OrderedDict[TaskID, bool]" = OrderedDict()
         # streaming: last consumption watermark the owner told us, per task
         self._stream_consumed: Dict[TaskID, int] = {}
+        # completion-report outbox (batched reply path, see _send_done);
+        # appended from executor threads, drained on the IO loop (deque
+        # append/popleft are thread-safe)
+        self._done_outbox: deque = deque()
+        self._done_flushing = False
         self._tpu_env_set = False
         self._lock = threading.Lock()
 
@@ -204,8 +209,13 @@ class Executor:
                 result = fn(*args, **kwargs)
                 if asyncio.iscoroutine(result):
                     # sync path hit an async def: run it to completion here
-                    result = asyncio.new_event_loop().run_until_complete(
-                        result)
+                    # (loop closed afterwards — each leaks an epoll fd +
+                    # self-pipe otherwise, EMFILE on long-lived workers)
+                    _loop = asyncio.new_event_loop()
+                    try:
+                        result = _loop.run_until_complete(result)
+                    finally:
+                        _loop.close()
                 if spec.is_streaming:
                     self._run_generator(spec, result)
                     return
@@ -271,8 +281,12 @@ class Executor:
         if hasattr(gen, "__anext__"):
             # async generator reached the sync executor (e.g. a task
             # function defined async): drive it on a private loop
-            asyncio.new_event_loop().run_until_complete(
-                self._run_async_generator(spec, gen))
+            _loop = asyncio.new_event_loop()
+            try:
+                _loop.run_until_complete(
+                    self._run_async_generator(spec, gen))
+            finally:
+                _loop.close()
             return
         if not hasattr(gen, "__next__"):
             raise TypeError(
@@ -430,17 +444,57 @@ class Executor:
         )
 
     def _send_done(self, spec: TaskSpec, body: dict) -> None:
-        async def send():
-            try:
-                await self.core.clients.get(tuple(spec.owner)).call("task_done", body)
-            except Exception:
-                logger.warning("failed to report task_done for %s", spec.name)
-            if spec.kind == TaskKind.NORMAL:
-                # tell the supervisor this slot is free (lease stays cached
-                # owner-side for pipelining; supervisor accounting unchanged)
-                pass
+        """Queue the completion report and return immediately.
 
-        self.core._run(send())
+        Replies are coalesced: the executor thread never blocks on the
+        report roundtrip (it picks up the next task right away), and the
+        flusher on the IO loop drains whatever accumulated while the
+        previous frame was in flight into ONE `task_done_batch` RPC per
+        owner — the reply-side twin of the owner's push_task_batch
+        (`ray microbenchmark`'s actor-call envelope needs both sides
+        batched; reference: the reply batching inside the C++ direct
+        actor transport, `direct_task_transport`)."""
+        self._done_outbox.append((tuple(spec.owner), body, 0))
+        self.core._run_nowait(self._flush_done())
+
+    async def _flush_done(self) -> None:
+        if self._done_flushing:
+            return  # one flusher; it will drain what we just queued
+        self._done_flushing = True
+        try:
+            while self._done_outbox:
+                by_owner: Dict[tuple, list] = {}
+                count = 0
+                while self._done_outbox and count < 256:
+                    addr, body, attempts = self._done_outbox.popleft()
+                    by_owner.setdefault(addr, []).append((body, attempts))
+                    count += 1
+                for addr, entries in by_owner.items():
+                    bodies = [b for b, _ in entries]
+                    try:
+                        if len(bodies) == 1:
+                            await self.core.clients.get(addr).call(
+                                "task_done", bodies[0])
+                        else:
+                            await self.core.clients.get(addr).call(
+                                "task_done_batch", {"dones": bodies})
+                    except Exception:
+                        # a transient blip must not strand N callers in
+                        # get(): requeue with bounded retries (a dead
+                        # owner gives up after 3 — its worker-failed
+                        # handling covers the rest)
+                        retry = [(addr, b, a + 1) for b, a in entries
+                                 if a + 1 < 3]
+                        dropped = len(entries) - len(retry)
+                        if dropped:
+                            logger.warning(
+                                "dropping %d task_done report(s) to %s "
+                                "after 3 attempts", dropped, addr)
+                        if retry:
+                            await asyncio.sleep(0.1)
+                            self._done_outbox.extend(retry)
+        finally:
+            self._done_flushing = False
 
     async def _notify_actor_ready(self, spec: TaskSpec) -> None:
         await self.core.clients.get(self.core.controller_addr).call(

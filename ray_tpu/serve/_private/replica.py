@@ -147,22 +147,17 @@ class ReplicaActor:
                     yield item
             elif inspect.isgenerator(out):
                 # sync generator (e.g. a jitted decode step per token):
-                # step it off-loop so health checks keep flowing
+                # step it off-loop so health checks keep flowing, under
+                # the request's contextvars (multiplexed model id)
                 import contextvars as _cv
 
-                loop = asyncio.get_running_loop()
+                from ray_tpu._private.async_utils import (END_OF_ITERATION,
+                                                          step_off_loop)
+
                 ctx = _cv.copy_context()
-                _end = object()
-
-                def step():
-                    try:
-                        return ctx.run(next, out)
-                    except StopIteration:
-                        return _end
-
                 while True:
-                    item = await loop.run_in_executor(None, step)
-                    if item is _end:
+                    item = await step_off_loop(lambda: next(out), ctx)
+                    if item is END_OF_ITERATION:
                         break
                     yield item
             else:

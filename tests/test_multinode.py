@@ -181,6 +181,13 @@ class TestNodeFailure:
         a = A.options(resources={"doomed": 1}).remote()
         assert ray_tpu.get(a.ping.remote()) == "pong"
         ray_cluster.remove_node(victim)
+        # a call racing the kill itself may legitimately still be served
+        # from the pre-FIN window (same in the reference's direct actor
+        # transport); the GUARANTEE is that calls fail once the cluster
+        # has declared the node dead — wait for that declaration
+        _wait_for(
+            lambda: sum(1 for v in ray_tpu.nodes() if v["alive"]) == 1,
+            timeout=30, msg="node death declaration")
         with pytest.raises(Exception):
             # dies and never comes back: calls must fail, not hang
             ray_tpu.get(a.ping.remote(), timeout=30)
