@@ -3,7 +3,7 @@
 from __future__ import annotations
 
 import asyncio
-from typing import Any, Callable, Optional
+from typing import Any, Callable
 
 END_OF_ITERATION = object()
 """Sentinel returned by :func:`step_off_loop` at iterator exhaustion —

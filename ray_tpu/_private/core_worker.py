@@ -199,6 +199,8 @@ class CoreWorker:
         self._lineage_bytes = 0
         # streaming generator tasks: task_id -> owner-side stream state
         self._streams: Dict[TaskID, _StreamState] = {}
+        # dedupe of retried completion reports (bounded LRU)
+        self._seen_reports: "OrderedDict[bytes, bool]" = OrderedDict()
 
         self.loop = asyncio.new_event_loop()
         self._loop_thread = threading.Thread(
@@ -639,6 +641,16 @@ class CoreWorker:
 
     async def rpc_task_done(self, body) -> None:
         _trace(f"task_done received {body.get('task_id', b'').hex()[:12]} err={body.get('error') is not None}")
+        rid = body.get("report_id")
+        if rid is not None:
+            # executor-side reply batching retries ambiguous deliveries;
+            # a report that already landed (reply lost) must be a no-op —
+            # reprocessing a retryable error would double-requeue the task
+            if rid in self._seen_reports:
+                return
+            self._seen_reports[rid] = True
+            while len(self._seen_reports) > 10_000:
+                self._seen_reports.popitem(last=False)
         """Executor reports task completion to the owner
         (return values inline if small, else arena locations)."""
         task_id = TaskID(body["task_id"])

@@ -454,6 +454,11 @@ class Executor:
         (`ray microbenchmark`'s actor-call envelope needs both sides
         batched; reference: the reply batching inside the C++ direct
         actor transport, `direct_task_transport`)."""
+        # report_id makes redelivery safe: a retried report whose first
+        # delivery actually landed (reply lost to a transport blip) must
+        # not be processed twice — a duplicated retryable-error body would
+        # double-requeue the task at the owner
+        body["report_id"] = os.urandom(8)
         self._done_outbox.append((tuple(spec.owner), body, 0))
         self.core._run_nowait(self._flush_done())
 
@@ -469,32 +474,41 @@ class Executor:
                     addr, body, attempts = self._done_outbox.popleft()
                     by_owner.setdefault(addr, []).append((body, attempts))
                     count += 1
-                for addr, entries in by_owner.items():
-                    bodies = [b for b, _ in entries]
-                    try:
-                        if len(bodies) == 1:
-                            await self.core.clients.get(addr).call(
-                                "task_done", bodies[0])
-                        else:
-                            await self.core.clients.get(addr).call(
-                                "task_done_batch", {"dones": bodies})
-                    except Exception:
-                        # a transient blip must not strand N callers in
-                        # get(): requeue with bounded retries (a dead
-                        # owner gives up after 3 — its worker-failed
-                        # handling covers the rest)
-                        retry = [(addr, b, a + 1) for b, a in entries
-                                 if a + 1 < 3]
-                        dropped = len(entries) - len(retry)
-                        if dropped:
-                            logger.warning(
-                                "dropping %d task_done report(s) to %s "
-                                "after 3 attempts", dropped, addr)
-                        if retry:
-                            await asyncio.sleep(0.1)
-                            self._done_outbox.extend(retry)
+                # per-owner sends run CONCURRENTLY: one dead owner's RPC
+                # timeout must not head-of-line block reports to healthy
+                # owners sitting behind it in the outbox
+                await asyncio.gather(
+                    *(self._send_done_batch(addr, entries)
+                      for addr, entries in by_owner.items()))
         finally:
             self._done_flushing = False
+
+    async def _send_done_batch(self, addr: tuple, entries: list) -> None:
+        bodies = [b for b, _ in entries]
+        try:
+            if len(bodies) == 1:
+                await self.core.clients.get(addr).call(
+                    "task_done", bodies[0])
+            else:
+                await self.core.clients.get(addr).call(
+                    "task_done_batch", {"dones": bodies})
+        except Exception:
+            # a transient blip must not strand N callers in get():
+            # requeue with bounded retries (a dead owner gives up after
+            # 3 — its worker-failed handling covers the rest). Backoff
+            # rides call_later so the drain loop never sleeps inline.
+            retry = [(addr, b, a + 1) for b, a in entries if a + 1 < 3]
+            dropped = len(entries) - len(retry)
+            if dropped:
+                logger.warning(
+                    "dropping %d task_done report(s) to %s after 3 "
+                    "attempts", dropped, addr)
+            if retry:
+                def requeue():
+                    self._done_outbox.extend(retry)
+                    self.core._run_nowait(self._flush_done())
+
+                asyncio.get_running_loop().call_later(0.1, requeue)
 
     async def _notify_actor_ready(self, spec: TaskSpec) -> None:
         await self.core.clients.get(self.core.controller_addr).call(

@@ -6,6 +6,7 @@ from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.algorithms.marwil import BC, BCConfig, MARWIL, MARWILConfig
+from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
 
 __all__ = [
     "Algorithm",
@@ -22,6 +23,8 @@ __all__ = [
     "SACConfig",
     "BC",
     "BCConfig",
+    "CQL",
+    "CQLConfig",
     "MARWIL",
     "MARWILConfig",
 ]

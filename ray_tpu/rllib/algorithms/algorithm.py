@@ -112,10 +112,76 @@ class Algorithm:
             "episode_len_mean": float(np.mean(lens)) if lens else None,
             "time_total_s": time.time() - self._start,
         })
+        interval = getattr(self.config, "evaluation_interval", None)
+        if interval and self.iteration % interval == 0:
+            result["evaluation"] = self.evaluate()["evaluation"]
         return result
+
+    # ------------------------------------------------------------ evaluation
+
+    def _make_eval_runner_group(self):
+        """Dedicated eval sampler group (overridden by continuous-control
+        algorithms). Seeded away from the train runners so eval episodes
+        are not correlated with training rollouts."""
+        cfg = self.config
+        if cfg.is_multi_agent:
+            raise NotImplementedError(
+                "evaluate() supports single-agent configs; sample the "
+                "multi-agent runner group directly for eval")
+        import copy as _copy
+
+        return EnvRunnerGroup(
+            cfg.env, self.spec,
+            num_env_runners=cfg.evaluation_num_env_runners,
+            num_envs_per_runner=cfg.num_envs_per_env_runner,
+            seed=cfg.seed + 77_777, env_config=cfg.env_config,
+            # a stateful connector (running obs stats) must not be shared
+            # with the train runners — eval rollouts would mutate the
+            # normalization applied to training batches
+            obs_connector=_copy.deepcopy(cfg.env_to_module_connector))
+
+    def evaluate(self) -> Dict[str, Any]:
+        """Run the current (greedy) policy on DEDICATED eval runners until
+        `evaluation_duration` episodes/timesteps complete — eval metrics
+        never mix with train-time sampling
+        (≈ Algorithm.evaluate, rllib/algorithms/algorithm.py:954)."""
+        cfg = self.config
+        if getattr(self, "_eval_runner_group", None) is None:
+            self._eval_runner_group = self._make_eval_runner_group()
+        group = self._eval_runner_group
+        group.set_weights(self.learner_group.get_weights())
+        group.get_metrics()  # drain any stale episode stats
+
+        duration = cfg.evaluation_duration
+        by_steps = cfg.evaluation_duration_unit == "timesteps"
+        chunk = cfg.rollout_fragment_length
+        episodes, steps = 0, 0
+        returns: List[float] = []
+        lens: List[float] = []
+        for _ in range(1000):  # hard cap: eval must terminate
+            for batch in group.sample(chunk, greedy=True):
+                steps += int(np.size(batch["rewards"]))
+            for m in group.get_metrics():
+                n = m.get("num_episodes", 0)
+                episodes += n
+                if n and m.get("episode_return_mean") is not None:
+                    returns.extend([m["episode_return_mean"]] * n)
+                    lens.extend([m["episode_len_mean"]] * n)
+            if (steps if by_steps else episodes) >= duration:
+                break
+        return {"evaluation": {
+            "episode_return_mean": (float(np.mean(returns))
+                                    if returns else None),
+            "episode_len_mean": float(np.mean(lens)) if lens else None,
+            "num_episodes": episodes,
+            "num_env_steps": steps,
+        }}
 
     def stop(self) -> None:
         self.env_runner_group.stop()
+        eval_group = getattr(self, "_eval_runner_group", None)
+        if eval_group is not None:
+            eval_group.stop()
         if self.learner_groups is not None:
             for lg in self.learner_groups.values():
                 lg.shutdown()

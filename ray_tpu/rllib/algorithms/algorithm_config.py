@@ -47,6 +47,14 @@ class AlgorithmConfig:
         # env runner; learner_connector transforms every train batch
         self.env_to_module_connector: Optional[Any] = None
         self.learner_connector: Optional[Any] = None
+        # evaluation (≈ AlgorithmConfig.evaluation(), feeding
+        # Algorithm.evaluate / rllib/algorithms/algorithm.py:954):
+        # dedicated eval runners, greedy policy, metrics kept separate
+        # from train-time sampling
+        self.evaluation_interval: Optional[int] = None  # every N train()s
+        self.evaluation_duration: int = 10
+        self.evaluation_duration_unit: str = "episodes"  # or "timesteps"
+        self.evaluation_num_env_runners: int = 0
 
     # ------------------------------------------------------- fluent setters
 
@@ -88,6 +96,20 @@ class AlgorithmConfig:
 
     def debugging(self, *, seed: Optional[int] = None) -> "AlgorithmConfig":
         return self._apply(dict(seed=seed))
+
+    def evaluation(self, *, evaluation_interval: Optional[int] = None,
+                   evaluation_duration: Optional[int] = None,
+                   evaluation_duration_unit: Optional[str] = None,
+                   evaluation_num_env_runners: Optional[int] = None
+                   ) -> "AlgorithmConfig":
+        if evaluation_duration_unit not in (None, "episodes", "timesteps"):
+            raise ValueError("evaluation_duration_unit must be "
+                             "'episodes' or 'timesteps'")
+        return self._apply(dict(
+            evaluation_interval=evaluation_interval,
+            evaluation_duration=evaluation_duration,
+            evaluation_duration_unit=evaluation_duration_unit,
+            evaluation_num_env_runners=evaluation_num_env_runners))
 
     def multi_agent(self, *, policies: Optional[Dict[str, Dict[str, Any]]]
                     = None, policy_mapping_fn=None) -> "AlgorithmConfig":

@@ -140,15 +140,22 @@ class ContinuousEnvRunner:
         self.params = jax.tree.map(jnp.asarray, weights)
         return True
 
-    def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
-        """Row-flat batch: [T*B] transitions for the replay buffer."""
+    def sample(self, num_steps: int, epsilon=None,
+               greedy: bool = False) -> Dict[str, np.ndarray]:
+        """Row-flat batch: [T*B] transitions for the replay buffer.
+        greedy=True (evaluation) acts with tanh(mean), no exploration."""
         import jax
 
         a_dim = self._spec.num_actions
         rows = {k: [] for k in ("obs", "actions", "rewards", "next_obs",
                                 "terminateds", "truncateds")}
         for _ in range(num_steps):
-            if self._steps < self._warmup:
+            if greedy:
+                act, _ = self._sample_fn(
+                    self.params, self._obs.astype(np.float32),
+                    np.zeros((self.num_envs, a_dim), np.float32))
+                act = np.asarray(act)
+            elif self._steps < self._warmup:
                 act = self._rng.uniform(-1, 1,
                                         (self.num_envs, a_dim)).astype(
                                             np.float32)
@@ -217,10 +224,11 @@ class _ContinuousRunnerGroup:
         else:
             ray_tpu.get([a.set_weights.remote(w) for a in self._actors])
 
-    def sample(self, n):
+    def sample(self, n, epsilon=None, greedy=False):
         if self._local is not None:
-            return [self._local.sample(n)]
-        return ray_tpu.get([a.sample.remote(n) for a in self._actors])
+            return [self._local.sample(n, epsilon, greedy)]
+        return ray_tpu.get([a.sample.remote(n, epsilon, greedy)
+                            for a in self._actors])
 
     def get_metrics(self):
         if self._local is not None:
@@ -306,6 +314,14 @@ class SAC(Algorithm):
     @classmethod
     def get_default_config(cls) -> SACConfig:
         return SACConfig()
+
+    def _make_eval_runner_group(self):
+        cfg = self.config
+        return _ContinuousRunnerGroup(
+            cfg.env, self.spec,
+            num_env_runners=cfg.evaluation_num_env_runners,
+            num_envs_per_runner=cfg.num_envs_per_env_runner,
+            seed=cfg.seed + 77_777, warmup=0, env_config=cfg.env_config)
 
     # ------------------------------------------------------------------ loss
 
