@@ -52,6 +52,10 @@ class Config:
     object_transfer_chunk_bytes: int = 8 * 1024**2
     object_spilling_threshold: float = 0.8
     object_spilling_dir: str = ""
+    # URI spill target (≈ the reference's object_spilling_config /
+    # external_storage.py:496): "" = local dir above; file:///path,
+    # mock://dir (fake remote, tests), s3://bucket/prefix
+    object_spilling_uri: str = ""
     # ---- OOM defense (≈ memory_monitor.h:52) ----
     # kill the newest leased worker when host memory use crosses this
     # fraction; <= 0 disables the monitor

@@ -1,0 +1,160 @@
+"""External spill storage — pluggable backends behind one URI interface.
+
+Analog of the reference's `python/ray/_private/external_storage.py:496`
+(`ExternalStorage` + filesystem/S3 implementations behind
+`object_spilling_config`): the node object store spills cold objects
+through whichever backend the spill URI selects, so spill capacity can
+live on a local disk, a remote object store, or (in tests) a fake remote.
+
+Backends by scheme:
+  - ``""`` / ``file://``  — local filesystem directory (default)
+  - ``mock://``           — fake remote store for tests: same URI contract
+                            as a real remote (opaque returned URIs, no
+                            local-path semantics), backed by a directory
+                            plus op counters
+  - ``s3://``             — S3-class object storage via boto3 when
+                            available (gated: this image has no boto3, so
+                            constructing it raises with a clear message)
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+from typing import Dict
+
+
+class ExternalStorage:
+    """One spilled object = one (key, payload) in the backend. `put`
+    returns an opaque URI that `get`/`delete` accept — callers must not
+    parse it (a remote backend's URIs carry no local meaning). `data` is
+    bytes-like (often a memoryview into the arena — backends that need
+    real bytes copy themselves)."""
+
+    def put(self, key: str, data) -> str:
+        raise NotImplementedError
+
+    def get(self, uri: str) -> bytes:
+        raise NotImplementedError
+
+    def delete(self, uri: str) -> None:
+        raise NotImplementedError
+
+
+class FileSystemStorage(ExternalStorage):
+    """Spill to a local directory (the default backend)."""
+
+    def __init__(self, base_dir: str):
+        self._dir = base_dir
+        os.makedirs(base_dir, exist_ok=True)
+
+    def put(self, key: str, data: bytes) -> str:
+        path = os.path.join(self._dir, key)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)  # readers never see a half-written spill
+        return "file://" + path
+
+    def get(self, uri: str) -> bytes:
+        with open(uri[len("file://"):], "rb") as f:
+            return f.read()
+
+    def delete(self, uri: str) -> None:
+        try:
+            os.unlink(uri[len("file://"):])
+        except OSError:
+            pass
+
+
+class MockRemoteStorage(ExternalStorage):
+    """Fake remote object store for tests: honors the exact URI contract
+    of a real remote (opaque URIs with a random token, so any caller
+    that treats them as paths breaks loudly) and counts operations."""
+
+    def __init__(self, base_dir: str):
+        self._dir = base_dir
+        os.makedirs(base_dir, exist_ok=True)
+        self.puts = 0
+        self.gets = 0
+        self.deletes = 0
+
+    def _path(self, uri: str) -> str:
+        assert uri.startswith("mock://"), uri
+        return os.path.join(self._dir, uri[len("mock://"):])
+
+    def put(self, key: str, data: bytes) -> str:
+        self.puts += 1
+        token = f"{key}-{uuid.uuid4().hex[:8]}"
+        with open(os.path.join(self._dir, token), "wb") as f:
+            f.write(data)
+        return "mock://" + token
+
+    def get(self, uri: str) -> bytes:
+        self.gets += 1
+        with open(self._path(uri), "rb") as f:
+            return f.read()
+
+    def delete(self, uri: str) -> None:
+        self.deletes += 1
+        try:
+            os.unlink(self._path(uri))
+        except OSError:
+            pass
+
+
+class S3Storage(ExternalStorage):
+    """S3-class backend (``s3://bucket/prefix``). Requires boto3, which
+    this image does not ship — the class exists so a deployment with
+    boto3 gets the full path, and everyone else a clear error."""
+
+    def __init__(self, uri: str):
+        try:
+            import boto3  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "s3:// spill requires boto3, which is not installed; "
+                "use a file:// or local-directory spill target") from e
+        rest = uri[len("s3://"):]
+        self._bucket, _, self._prefix = rest.partition("/")
+        import boto3
+
+        self._client = boto3.client("s3")
+
+    def _key(self, uri: str) -> str:
+        return uri[len("s3://") + len(self._bucket) + 1:]
+
+    def put(self, key: str, data: bytes) -> str:
+        full = (self._prefix + "/" + key).lstrip("/")
+        self._client.put_object(Bucket=self._bucket, Key=full, Body=data)
+        return f"s3://{self._bucket}/{full}"
+
+    def get(self, uri: str) -> bytes:
+        out = self._client.get_object(Bucket=self._bucket,
+                                      Key=self._key(uri))
+        return out["Body"].read()
+
+    def delete(self, uri: str) -> None:
+        try:
+            self._client.delete_object(Bucket=self._bucket,
+                                       Key=self._key(uri))
+        except Exception:
+            pass
+
+
+def storage_from_spill_target(target: str, default_dir: str
+                              ) -> ExternalStorage:
+    """Build the backend for a spill target (config.object_spilling_uri):
+    empty -> local default dir; file:///path or /path -> that dir;
+    mock://dir -> fake remote; s3://... -> S3."""
+    if not target:
+        return FileSystemStorage(default_dir)
+    if target.startswith("file://"):
+        return FileSystemStorage(target[len("file://"):])
+    if target.startswith("mock://"):
+        return MockRemoteStorage(target[len("mock://"):])
+    if target.startswith("s3://"):
+        return S3Storage(target)
+    if "://" not in target:
+        return FileSystemStorage(target)
+    raise ValueError(f"unsupported spill target {target!r}")

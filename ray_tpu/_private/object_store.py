@@ -209,13 +209,19 @@ class NodeObjectStore:
     """Supervisor-side object index + allocator (single-threaded: runs on the
     supervisor's event loop)."""
 
-    def __init__(self, arena_path: str, capacity: int, spill_dir: str):
+    def __init__(self, arena_path: str, capacity: int, spill_dir: str,
+                 spill_storage=None):
         self.capacity = capacity
         self.arena = ArenaFile(arena_path, capacity, create=True)
         self._alloc = make_free_list(capacity)
         self._objects: Dict[ObjectID, ObjectMeta] = {}
-        self._spill_dir = spill_dir
-        os.makedirs(spill_dir, exist_ok=True)
+        if spill_storage is None:
+            from ray_tpu._private.external_storage import FileSystemStorage
+
+            spill_storage = FileSystemStorage(spill_dir)
+        # pluggable spill target (≈ external_storage.py:496): local dir by
+        # default, mock:// fake remote in tests, s3:// in deployments
+        self.spill_storage = spill_storage
         self.num_spilled = 0
         self.num_restored = 0
 
@@ -305,10 +311,7 @@ class NodeObjectStore:
             return
         self._objects.pop(object_id, None)
         if meta.state == SPILLED and meta.spill_path:
-            try:
-                os.unlink(meta.spill_path)
-            except OSError:
-                pass
+            self.spill_storage.delete(meta.spill_path)
         elif meta.offset >= 0:
             self._alloc.free(meta.offset, meta.size)
 
@@ -328,12 +331,14 @@ class NodeObjectStore:
             self._spill(meta)
 
     def _spill(self, meta: ObjectMeta) -> None:
-        path = os.path.join(self._spill_dir, meta.object_id.hex())
-        with open(path, "wb") as f:
-            f.write(self.arena.view(meta.offset, meta.size))
+        # pass the arena view straight through (bytes-like): spilling
+        # fires under memory pressure, so a full bytes copy of a multi-GB
+        # object here would double transient memory at the worst moment
+        uri = self.spill_storage.put(
+            meta.object_id.hex(), self.arena.view(meta.offset, meta.size))
         self._alloc.free(meta.offset, meta.size)
         meta.offset = -1
-        meta.spill_path = path
+        meta.spill_path = uri  # opaque backend URI, not a local path
         meta.state = SPILLED
         self.num_spilled += 1
 
@@ -344,12 +349,8 @@ class NodeObjectStore:
             offset = self._alloc.alloc(meta.size)
             if offset is None:
                 raise OutOfMemoryError("cannot restore spilled object: store full")
-        with open(meta.spill_path, "rb") as f:
-            self.arena.write(offset, f.read())
-        try:
-            os.unlink(meta.spill_path)
-        except OSError:
-            pass
+        self.arena.write(offset, self.spill_storage.get(meta.spill_path))
+        self.spill_storage.delete(meta.spill_path)
         meta.offset = offset
         meta.spill_path = ""
         meta.state = IN_MEMORY

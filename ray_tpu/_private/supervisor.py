@@ -136,8 +136,12 @@ class Supervisor:
         spill_dir = config.object_spilling_dir or os.path.join(
             session_dir, "spill", self.node_id.hex()[:12]
         )
+        from ray_tpu._private.external_storage import storage_from_spill_target
+
         self.store = NodeObjectStore(
-            self.arena_path, config.object_store_memory_bytes, spill_dir
+            self.arena_path, config.object_store_memory_bytes, spill_dir,
+            spill_storage=storage_from_spill_target(
+                config.object_spilling_uri, spill_dir),
         )
         # worker pool
         self.workers: Dict[str, WorkerHandle] = {}

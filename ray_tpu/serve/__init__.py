@@ -14,6 +14,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import ray_tpu
 from ray_tpu.serve._private.controller import CONTROLLER_NAME, ServeController
+from ray_tpu.serve.asgi import ingress  # noqa: F401
 from ray_tpu.serve.batching import batch  # noqa: F401
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse  # noqa: F401
 from ray_tpu.serve.multiplex import (  # noqa: F401

@@ -23,6 +23,7 @@ class ProxyActor:
         self._controller = controller
         self._port = port
         self._routes: Dict[str, Any] = {}
+        self._route_asgi: Dict[str, bool] = {}  # target -> ASGI ingress
         self._handles: Dict[str, Any] = {}
         self._runner = None
         self._started_evt = asyncio.Event()
@@ -68,11 +69,19 @@ class ProxyActor:
 
     async def _route_refresher(self):
         while True:
-            try:
-                self._routes = await self._controller.get_routes.remote()
-            except Exception:
-                pass
+            await self._refresh_routes()
             await asyncio.sleep(1.0)
+
+    async def _refresh_routes(self):
+        try:
+            self._routes = await self._controller.get_routes.remote()
+            # published by the controller from the deployment class's
+            # static marker — the proxy never probes user code, and a
+            # redeploy (plain <-> ASGI) takes effect on the next refresh
+            self._route_asgi = (
+                await self._controller.get_route_asgi.remote())
+        except Exception:
+            pass
 
     async def _handle(self, request):
         from aiohttp import web
@@ -82,10 +91,10 @@ class ProxyActor:
             return web.Response(text="ok")
         if path == "/-/routes":
             if not self._routes:
-                self._routes = await self._controller.get_routes.remote()
+                await self._refresh_routes()
             return web.json_response(self._routes)
         if not self._routes:
-            self._routes = await self._controller.get_routes.remote()
+            await self._refresh_routes()
         target = None
         best = -1
         for prefix, dest in self._routes.items():
@@ -100,6 +109,18 @@ class ProxyActor:
             app_name, dep = target.split("/", 1)
             handle = DeploymentHandle(app_name, dep, self._controller)
             self._handles[target] = handle
+        if self._route_asgi.get(target, False):
+            # ASGI deployment (serve.ingress): full scope translation,
+            # streaming responses, websocket bridging (proxy.py:431)
+            try:
+                if request.headers.get("Upgrade", "").lower() == "websocket":
+                    return await self._handle_ws(request, handle, path,
+                                                 best)
+                return await self._handle_asgi(request, handle, path, best)
+            except Exception as e:  # noqa: BLE001 — replica/router failure
+                logger.exception("asgi proxy error on %s", path)
+                return web.Response(status=500,
+                                    text=f"{type(e).__name__}: {e}")
         try:
             if request.can_read_body:
                 body = await request.read()
@@ -130,6 +151,140 @@ class ProxyActor:
         except Exception as e:
             logger.exception("proxy error on %s", path)
             return web.Response(status=500, text=f"{type(e).__name__}: {e}")
+
+    # ------------------------------------------------------------- ASGI
+
+    def _asgi_scope(self, request, path: str, prefix_len: int,
+                    ws: bool = False) -> Dict[str, Any]:
+        root = path[:prefix_len].rstrip("/")
+        return {
+            "type": "websocket" if ws else "http",
+            "asgi": {"version": "3.0", "spec_version": "2.3"},
+            "http_version": "1.1",
+            "method": request.method,
+            "scheme": "ws" if ws else "http",
+            "path": path[prefix_len:] or "/",
+            "raw_path": path,
+            "root_path": root,
+            "query_string": request.query_string,
+            # header values as str pairs on the wire; the replica-side
+            # adapter re-encodes to the bytes pairs ASGI requires
+            "headers": [(k.lower(), v) for k, v in request.headers.items()],
+            "client": (request.remote, 0),
+        }
+
+    async def _handle_asgi(self, request, handle, path: str,
+                           prefix_len: int):
+        from aiohttp import web
+
+        body = await request.read() if request.can_read_body else b""
+        scope = self._asgi_scope(request, path, prefix_len)
+        loop = asyncio.get_running_loop()
+        sh = handle.options(stream=True)
+        resp_obj = await loop.run_in_executor(
+            None, lambda: sh._call("__serve_asgi__", (scope, body), {}))
+        gen = resp_obj.ref  # ObjectRefGenerator of header + body chunks
+        try:
+            first_ref = await gen.__anext__()
+        except StopAsyncIteration:
+            return web.Response(status=500, text="empty ASGI response")
+        head = await first_ref
+        resp = web.StreamResponse(status=head.get("status", 200))
+        for k, v in head.get("headers", []):
+            if k.lower() not in ("content-length", "transfer-encoding"):
+                resp.headers[k] = v
+        resp.enable_chunked_encoding()
+        await resp.prepare(request)
+        try:
+            async for chunk_ref in gen:
+                chunk = await chunk_ref
+                if isinstance(chunk, str):
+                    chunk = chunk.encode()
+                if chunk:
+                    await resp.write(chunk)
+        except Exception as e:  # noqa: BLE001 — mid-stream failure
+            logger.warning("asgi stream aborted: %s", e)
+        try:
+            await resp.write_eof()
+        except Exception:
+            pass
+        return resp
+
+    async def _handle_ws(self, request, handle, path: str, prefix_len: int):
+        """Websocket pass-through (≈ proxy.py:431): outbound ASGI events
+        ride a streaming generator from the replica; inbound frames feed
+        the session via per-message calls to the SAME replica."""
+        import uuid
+
+        from aiohttp import web
+
+        # dispatch BEFORE upgrading: a replica/router failure here still
+        # has a plain HTTP connection to answer with a 500 (after the
+        # 101 upgrade there is no way to signal an error)
+        sid = uuid.uuid4().hex
+        scope = self._asgi_scope(request, path, prefix_len, ws=True)
+        loop = asyncio.get_running_loop()
+        sh = handle.options(stream=True)
+        resp_obj = await loop.run_in_executor(
+            None, lambda: sh._call("__serve_ws__", (sid, scope), {}))
+        gen, replica = resp_obj.ref, resp_obj._replica
+
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+
+        async def pump_outbound():
+            try:
+                async for ev_ref in gen:
+                    event = await ev_ref
+                    et = event["type"]
+                    if et == "websocket.accept":
+                        continue  # aiohttp accepted at prepare()
+                    if et == "websocket.send":
+                        if event.get("text") is not None:
+                            await ws.send_str(event["text"])
+                        elif event.get("bytes") is not None:
+                            await ws.send_bytes(event["bytes"])
+                    elif et == "websocket.close":
+                        await ws.close(code=event.get("code", 1000))
+                        return
+            except Exception as e:  # noqa: BLE001
+                logger.warning("ws outbound pump ended: %s", e)
+                try:
+                    await ws.close(code=1011)
+                except Exception:
+                    pass
+
+        out_task = asyncio.ensure_future(pump_outbound())
+
+        async def feed(event):
+            await replica.handle_request.remote(
+                "__serve_ws_feed__", (sid, event), {})
+
+        from aiohttp import WSMsgType
+
+        try:
+            async for msg in ws:
+                if msg.type == WSMsgType.TEXT:
+                    await feed({"type": "websocket.receive",
+                                "text": msg.data})
+                elif msg.type == WSMsgType.BINARY:
+                    await feed({"type": "websocket.receive",
+                                "bytes": msg.data})
+                elif msg.type in (WSMsgType.CLOSE, WSMsgType.CLOSING,
+                                  WSMsgType.ERROR):
+                    break
+        finally:
+            try:
+                await feed({"type": "websocket.disconnect", "code": 1000})
+            except Exception:
+                pass
+            if not out_task.done():
+                # give the app a moment to close gracefully
+                try:
+                    await asyncio.wait_for(out_task, timeout=5)
+                except Exception:
+                    out_task.cancel()
+        return ws
 
     async def _stream_response(self, request, replica, stream_id: int):
         from aiohttp import web

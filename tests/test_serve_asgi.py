@@ -1,0 +1,161 @@
+"""ASGI ingress + websocket pass-through (≈ serve.ingress api.py:172,
+proxy websockets proxy.py:431). The apps below are dependency-free ASGI3
+callables — exactly the protocol FastAPI/Starlette apps speak, so the
+adapter serves those unchanged when they are installed.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_shutdown(ray_init):
+    yield
+    serve.shutdown()
+
+
+async def _echo_app(scope, receive, send):
+    """Minimal ASGI app: routes by path; supports streaming + websocket."""
+    if scope["type"] == "http":
+        event = await receive()
+        body = event.get("body", b"")
+        if scope["path"] == "/":
+            payload = json.dumps({
+                "method": scope["method"],
+                "path": scope["path"],
+                "got": body.decode() if body else None,
+                # ASGI spec: query_string is BYTES (Starlette decodes it)
+                "query": scope.get("query_string", b"").decode(),
+            }).encode()
+            await send({"type": "http.response.start", "status": 200,
+                        "headers": [(b"content-type", b"application/json"),
+                                    (b"x-app", b"asgi-echo")]})
+            await send({"type": "http.response.body", "body": payload})
+        elif scope["path"] == "/stream":
+            await send({"type": "http.response.start", "status": 200,
+                        "headers": [(b"content-type", b"text/plain")]})
+            for i in range(4):
+                await send({"type": "http.response.body",
+                            "body": f"chunk{i};".encode(),
+                            "more_body": True})
+            await send({"type": "http.response.body", "body": b"end",
+                        "more_body": False})
+        elif scope["path"] == "/boom":
+            raise RuntimeError("app exploded")
+        else:
+            await send({"type": "http.response.start", "status": 404,
+                        "headers": []})
+            await send({"type": "http.response.body", "body": b"nope"})
+    elif scope["type"] == "websocket":
+        event = await receive()
+        assert event["type"] == "websocket.connect"
+        await send({"type": "websocket.accept"})
+        while True:
+            event = await receive()
+            if event["type"] == "websocket.disconnect":
+                return
+            text = event.get("text")
+            if text == "close":
+                await send({"type": "websocket.close", "code": 1000})
+                return
+            await send({"type": "websocket.send",
+                        "text": f"echo:{text}"})
+
+
+class TestASGIIngress:
+    def _run_app(self):
+        @serve.deployment
+        @serve.ingress(_echo_app)
+        class App:
+            pass
+
+        serve.run(App.bind(), name="asgiapp", route_prefix="/api")
+        return serve.start(http_port=0)
+
+    def test_http_roundtrip_and_headers(self, serve_shutdown):
+        import httpx
+
+        port = self._run_app()
+        base = f"http://127.0.0.1:{port}/api"
+        r = httpx.post(base + "/", content="hello", timeout=30)
+        assert r.status_code == 200
+        assert r.headers["x-app"] == "asgi-echo"
+        out = r.json()
+        assert out["method"] == "POST"
+        assert out["path"] == "/"
+        assert out["got"] == "hello"
+
+    def test_streaming_response(self, serve_shutdown):
+        import httpx
+
+        port = self._run_app()
+        chunks = []
+        with httpx.stream(
+                "GET", f"http://127.0.0.1:{port}/api/stream",
+                timeout=30) as r:
+            assert r.status_code == 200
+            for chunk in r.iter_raw():
+                chunks.append(chunk)
+        assert b"".join(chunks) == b"chunk0;chunk1;chunk2;chunk3;end"
+
+    def test_app_error_becomes_500(self, serve_shutdown):
+        import httpx
+
+        port = self._run_app()
+        r = httpx.get(f"http://127.0.0.1:{port}/api/boom", timeout=30)
+        assert r.status_code == 500
+        assert "app exploded" in r.text
+
+    def test_unknown_path_404_from_app(self, serve_shutdown):
+        import httpx
+
+        port = self._run_app()
+        r = httpx.get(f"http://127.0.0.1:{port}/api/missing", timeout=30)
+        assert r.status_code == 404
+
+    def test_websocket_echo(self, serve_shutdown):
+        import aiohttp
+
+        port = self._run_app()
+
+        async def talk():
+            async with aiohttp.ClientSession() as sess:
+                async with sess.ws_connect(
+                        f"http://127.0.0.1:{port}/api/ws",
+                        timeout=aiohttp.ClientWSTimeout(ws_close=30)
+                        if hasattr(aiohttp, "ClientWSTimeout") else 30
+                ) as ws:
+                    await ws.send_str("hi")
+                    first = await asyncio.wait_for(ws.receive_str(), 30)
+                    await ws.send_str("there")
+                    second = await asyncio.wait_for(ws.receive_str(), 30)
+                    await ws.send_str("close")
+                    closed = await asyncio.wait_for(ws.receive(), 30)
+                    return first, second, closed.type
+
+        first, second, closed_type = asyncio.run(talk())
+        assert first == "echo:hi"
+        assert second == "echo:there"
+        import aiohttp as _a
+
+        assert closed_type in (_a.WSMsgType.CLOSE, _a.WSMsgType.CLOSED)
+
+    def test_plain_deployments_unaffected(self, serve_shutdown):
+        """Non-ASGI deployments keep the legacy JSON contract."""
+        import httpx
+
+        @serve.deployment
+        class Plain:
+            def __call__(self, payload):
+                return {"doubled": (payload or 0) * 2}
+
+        serve.run(Plain.bind(), name="plain", route_prefix="/plain")
+        port = serve.start(http_port=0)
+        r = httpx.post(f"http://127.0.0.1:{port}/plain", json=21,
+                       timeout=30)
+        assert r.json() == {"doubled": 42}
