@@ -129,6 +129,11 @@ class Supervisor:
         )
         self.available = self.total.copy()
         self.labels = labels or {}
+        # structured lifecycle events (≈ src/ray/util/event.h)
+        from ray_tpu._private.events import EventLogger
+
+        self.events = EventLogger(f"supervisor_{self.node_name}",
+                                  session_dir)
         arena_dir = "/dev/shm" if os.path.isdir("/dev/shm") else session_dir
         self.arena_path = os.path.join(
             arena_dir, f"rtpu_arena_{self.node_id.hex()[:12]}"
@@ -688,6 +693,9 @@ class Supervisor:
         err.close()
         self._spawned_log_paths[proc.pid] = (out.name, err.name)
         self._m_workers_spawned.inc()
+        self.events.emit("WORKER_SPAWNED",
+                         f"pid {proc.pid} for {spec.name}",
+                         pid=proc.pid, task_name=spec.name)
         self._spawned_procs[proc.pid] = proc
         self._spawned_jobs[proc.pid] = spec.job_id.hex() if spec.job_id else ""
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
@@ -780,6 +788,10 @@ class Supervisor:
         reason = self._kill_reasons.pop(
             w.worker_id_hex, f"worker exited with code {exitcode}")
         self._oom_killed.discard(w.worker_id_hex)
+        self.events.emit(
+            "WORKER_EXITED", f"worker {w.worker_id_hex[:8]}: {reason}",
+            severity="INFO" if exitcode == 0 else "WARNING",
+            worker_id=w.worker_id_hex, exitcode=exitcode, reason=reason)
         # fail leases bound to this worker and tell their owners
         for lease in [l for l in self.leases.values() if l.worker is w]:
             if lease.owner is not None:
@@ -943,6 +955,11 @@ class Supervisor:
                 "(pid %d) to relieve pressure",
                 usage * 100, self.config.memory_usage_threshold * 100,
                 victim.worker_id_hex[:8], victim.pid)
+            self.events.emit(
+                "WORKER_OOM_KILLED",
+                f"worker {victim.worker_id_hex[:8]} killed at "
+                f"{usage:.1%} host memory", severity="ERROR",
+                worker_id=victim.worker_id_hex, usage=usage)
             return
 
     def _oom_victim_order(self) -> List[WorkerHandle]:

@@ -231,7 +231,20 @@ class ProxyActor:
 
         ws = web.WebSocketResponse()
         await ws.prepare(request)
+        # post-upgrade failures must end as close frames on THIS ws —
+        # the shared 500-Response handler upstream cannot answer an
+        # already-upgraded connection
+        try:
+            return await self._pump_ws(request, ws, gen, replica, sid)
+        except Exception as e:  # noqa: BLE001 — replica died mid-session
+            logger.warning("ws session %s failed: %s", sid[:8], e)
+            try:
+                await ws.close(code=1011)
+            except Exception:
+                pass
+            return ws
 
+    async def _pump_ws(self, request, ws, gen, replica, sid: str):
         async def pump_outbound():
             try:
                 async for ev_ref in gen:

@@ -30,6 +30,9 @@ import asyncio
 from typing import Any, Dict
 
 
+_CLOSED = object()  # websocket-session tombstone (see __serve_ws_feed__)
+
+
 def _encode_scope(scope: Dict[str, Any]) -> Dict[str, Any]:
     """Wire scope (str values, picklable) -> ASGI-spec scope: headers,
     query_string, and raw_path must be bytes (Starlette/FastAPI decode
@@ -75,7 +78,11 @@ def ingress(asgi_app: Any):
                         sent_request = True
                         return {"type": "http.request",
                                 "body": body or b"", "more_body": False}
-                    return {"type": "http.disconnect"}
+                    # Starlette's listen_for_disconnect awaits a second
+                    # receive() WHILE streaming; returning http.disconnect
+                    # here would abort every StreamingResponse. Block
+                    # until the request task is torn down instead.
+                    await asyncio.Event().wait()
 
                 queue: asyncio.Queue = asyncio.Queue()
 
@@ -164,19 +171,31 @@ def ingress(asgi_app: Any):
                             return
                 finally:
                     task.cancel()
-                    self._ws_sessions().pop(session_id, None)
+                    # tombstone, not pop: the proxy's final disconnect
+                    # feed must not setdefault() a fresh queue that then
+                    # leaks (one per closed websocket on a long-lived
+                    # replica)
+                    self._ws_sessions()[session_id] = _CLOSED
 
             async def __serve_ws_feed__(self, session_id: str,
                                         event: Dict[str, Any]) -> bool:
                 """Inbound client frame -> the session's receive queue.
                 Async so it runs on the actor loop (asyncio.Queue is not
                 thread-safe). Returns False when the session is gone."""
-                # setdefault: a client frame can race __serve_ws__'s queue
-                # registration (the proxy feeds per-message while the
-                # streaming call is still being scheduled) — early frames
-                # must buffer, not drop
-                q = self._ws_sessions().setdefault(session_id,
-                                                   asyncio.Queue())
+                sessions = self._ws_sessions()
+                q = sessions.get(session_id)
+                if q is _CLOSED:
+                    # session over: this is the proxy's final disconnect
+                    # feed — clear the tombstone and report the session
+                    # gone so nothing re-registers it
+                    sessions.pop(session_id, None)
+                    return False
+                if q is None:
+                    # a client frame can race __serve_ws__'s queue
+                    # registration (the proxy feeds per-message while the
+                    # streaming call is still being scheduled) — early
+                    # frames must buffer, not drop
+                    q = sessions.setdefault(session_id, asyncio.Queue())
                 q.put_nowait(event)
                 return True
 

@@ -39,6 +39,18 @@ def list_placement_groups() -> List[Dict[str, Any]]:
     return _call("pg_list")
 
 
+def list_cluster_events(*, limit: int = 1000,
+                        event_type: Optional[str] = None,
+                        source_type: Optional[str] = None,
+                        severity: Optional[str] = None
+                        ) -> List[Dict[str, Any]]:
+    """Structured lifecycle events from every daemon, time-ordered
+    (≈ `ray list cluster-events`; emitters: _private/events.py)."""
+    return _call("events_list", {
+        "limit": limit, "event_type": event_type,
+        "source_type": source_type, "severity": severity})
+
+
 def list_jobs() -> List[Dict[str, Any]]:
     return _call("job_list")
 

@@ -1,0 +1,87 @@
+"""Structured events (≈ src/ray/util/event.h + dashboard event module)
+and synchronous registration durability (the round-3 500ms tail-loss
+window: a record acked by the controller must survive an immediate
+SIGKILL, with no snapshot interval to ride out).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.events import EventLogger, read_events
+
+
+class TestEventLogger:
+    def test_emit_and_read(self, tmp_path):
+        session = str(tmp_path)
+        log = EventLogger("testd", session)
+        log.emit("THING_HAPPENED", "hello", foo=1)
+        log.emit("OTHER_THING", "bye", severity="ERROR")
+        events = read_events(session)
+        assert [e["event_type"] for e in events] == [
+            "THING_HAPPENED", "OTHER_THING"]
+        assert events[0]["custom_fields"] == {"foo": 1}
+        assert events[0]["source_type"] == "testd"
+        assert read_events(session, severity="ERROR")[0][
+            "event_type"] == "OTHER_THING"
+        assert read_events(session, event_type="THING_HAPPENED")[0][
+            "message"] == "hello"
+
+    def test_null_logger_is_silent(self):
+        log = EventLogger("nowhere", "")
+        log.emit("X")  # must not raise
+
+
+class TestClusterEvents:
+    def test_lifecycle_events_queryable(self, ray_init):
+        """Driving the cluster produces queryable structured events."""
+        from ray_tpu.util import state
+
+        @ray_tpu.remote
+        class A:
+            def ping(self):
+                return 1
+
+        a = A.remote()
+        assert ray_tpu.get(a.ping.remote()) == 1
+        events = state.list_cluster_events()
+        types = {e["event_type"] for e in events}
+        assert "NODE_REGISTERED" in types
+        assert "ACTOR_REGISTERED" in types
+        assert "WORKER_SPAWNED" in types
+        reg = [e for e in events if e["event_type"] == "ACTOR_REGISTERED"]
+        assert reg[-1]["custom_fields"]["class_name"] == "A"
+        # filters work server-side
+        only_nodes = state.list_cluster_events(
+            event_type="NODE_REGISTERED")
+        assert only_nodes and all(
+            e["event_type"] == "NODE_REGISTERED" for e in only_nodes)
+        ray_tpu.kill(a)
+
+    def test_actor_death_event(self, ray_init):
+        from ray_tpu.util import state
+
+        @ray_tpu.remote
+        class D:
+            def ping(self):
+                return 1
+
+        a = D.remote()
+        ray_tpu.get(a.ping.remote())
+        ray_tpu.kill(a)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            dead = state.list_cluster_events(event_type="ACTOR_DEAD")
+            if any(e["custom_fields"].get("class_name") == "D"
+                   for e in dead):
+                return
+            time.sleep(0.2)
+        pytest.fail("no ACTOR_DEAD event recorded")
+
+
+# Registration durability (register -> instant controller crash ->
+# recover with zero loss) lives in test_multinode.py
+# (TestControllerRecovery.test_register_then_instant_crash_recovers):
+# it needs the ray_cluster fixture, which cannot share a module with the
+# module-scoped ray_init cluster above.

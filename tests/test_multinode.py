@@ -394,3 +394,33 @@ class TestControllerFaultTolerance:
 
         assert ray_tpu.get(ping.remote(), timeout=30) == "alive"
         ray_tpu.kill(b)
+
+    def test_register_then_instant_crash_recovers(self, ray_cluster):
+        """Actor registered -> controller SIGKILLed IMMEDIATELY (inside
+        what used to be the 500ms interval-snapshot loss window) ->
+        restarted controller still knows the actor: registrations are
+        made durable BEFORE the ack (controller._persist_now)."""
+        ray_cluster.add_node(num_cpus=2)
+        ray_cluster.wait_for_nodes(1)
+        ray_tpu.init(address=ray_cluster.address)
+
+        @ray_tpu.remote
+        class KV:
+            def __init__(self):
+                self.d = {}
+
+            def put(self, k, v):
+                self.d[k] = v
+                return True
+
+            def get(self, k):
+                return self.d.get(k)
+
+        a = KV.options(name="durable_kv", lifetime="detached").remote()
+        assert ray_tpu.get(a.put.remote("k", 7))
+        # NO sleep: the kill lands inside the old loss window
+        ray_cluster.restart_controller()
+        ray_cluster.wait_for_nodes(1, timeout=15)
+        b = ray_tpu.get_actor("durable_kv")
+        assert ray_tpu.get(b.get.remote("k"), timeout=30) == 7
+        ray_tpu.kill(b)
