@@ -150,6 +150,7 @@ class Controller:
         self._snapshot_task: Optional[asyncio.Task] = None
         self._state_dirty = False
         self._mutation_seq = 0
+        self._wal_epoch = 0  # bumped by each snapshot compaction
         self._persist_lock = asyncio.Lock()  # WAL appends vs compaction
         self._next_job_int = 0
         self._started = time.time()
@@ -187,6 +188,9 @@ class Controller:
             "jobs": self.jobs,
             "kv": self.kv,
             "next_job_int": self._next_job_int,
+            # WAL frames from epochs <= this are superseded by this
+            # snapshot (see _wal_path)
+            "wal_epoch": self._wal_epoch,
         }
 
     def _mark_dirty(self) -> None:
@@ -195,7 +199,14 @@ class Controller:
 
     @property
     def _wal_path(self) -> str:
-        return self.snapshot_path + ".wal" if self.snapshot_path else ""
+        """Epoch-stamped WAL: the snapshot records which WAL epoch it
+        supersedes, so recovery replays ONLY frames newer than the
+        installed snapshot — a crash between snapshot install and old-WAL
+        deletion can never replay stale registration-time records over
+        newer state (resurrecting dead actors/finished jobs)."""
+        if not self.snapshot_path:
+            return ""
+        return f"{self.snapshot_path}.wal.{self._wal_epoch}"
 
     def _atomic_snapshot_write(self, blob: bytes) -> None:
         """THE snapshot writer (single copy: _write_snapshot, the
@@ -227,6 +238,23 @@ class Controller:
                     os.fsync(f.fileno())
 
             await asyncio.get_running_loop().run_in_executor(None, write)
+
+    def _sweep_old_wals(self, max_epoch: int) -> None:
+        """Best-effort deletion of WAL files superseded by a snapshot
+        (epoch <= max_epoch); recovery ignores them either way."""
+        base = os.path.basename(self.snapshot_path) + ".wal."
+        d = os.path.dirname(self.snapshot_path) or "."
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return
+        for name in names:
+            if name.startswith(base):
+                try:
+                    if int(name[len(base):]) <= max_epoch:
+                        os.unlink(os.path.join(d, name))
+                except (ValueError, OSError):
+                    continue
 
     def _replay_wal(self) -> int:
         """Apply WAL entries on top of the loaded snapshot (entries are
@@ -291,6 +319,10 @@ class Controller:
         self.jobs = state["jobs"]
         self.kv = state["kv"]
         self._next_job_int = state["next_job_int"]
+        # resume appending at the epoch AFTER the one this snapshot
+        # superseded; stale lower-epoch WAL files are ignored and swept
+        self._wal_epoch = state.get("wal_epoch", 0) + 1
+        self._sweep_old_wals(self._wal_epoch - 1)
         logger.info(
             "controller recovered from snapshot: %d actors, %d pgs, "
             "%d jobs, %d kv namespaces",
@@ -305,19 +337,23 @@ class Controller:
                 continue  # nothing changed since the last write
             self._state_dirty = False
             try:
-                # serialize on-loop (consistent view), write off-loop so a
-                # large KV/function table never stalls RPC handling. The
-                # lock keeps the tmp file from racing WAL compaction and
-                # sequences with in-flight _wal_append writes; the WAL
-                # truncate AFTER a successful snapshot is the compaction.
-                blob = serialization.dumps(self._snapshot_state())
+                # Compaction. Serialize INSIDE the lock: every acked
+                # registration takes this lock for its WAL append, so a
+                # mutation is either already in the blob (its old-epoch
+                # frame is then safely superseded) or its append lands in
+                # the NEW epoch's file and replays after this snapshot.
+                # The epoch bump (not truncation) makes compaction
+                # crash-atomic: recovery replays only frames newer than
+                # the installed snapshot's recorded epoch.
                 async with self._persist_lock:
+                    blob = serialization.dumps(self._snapshot_state())
                     loop = asyncio.get_running_loop()
                     await loop.run_in_executor(
                         None, self._atomic_snapshot_write, blob)
-                    if self._wal_path:
-                        await loop.run_in_executor(
-                            None, lambda: open(self._wal_path, "wb").close())
+                    superseded = self._wal_epoch
+                    self._wal_epoch += 1
+                    await loop.run_in_executor(
+                        None, self._sweep_old_wals, superseded)
             except Exception:
                 self._state_dirty = True
                 logger.exception("controller snapshot write failed")

@@ -653,6 +653,22 @@ def main() -> None:
     asyncio.run_coroutine_threadsafe(
         _liveness_bond(parse_addr(args.supervisor)), core.loop
     )
+    # SIGTERM (supervisor shutdown/kill): drain the IO loop before dying
+    # so asyncio never reports destroyed-pending tasks into the log tail
+    # the driver is still reading
+    import signal as _signal
+
+    def _graceful_exit(_sig, _frm):
+        try:
+            core.shutdown()
+        except Exception:
+            pass
+        # 143 = SIGTERM convention: the supervisor's exit handling and
+        # the WORKER_EXITED event must still see a signal-terminated
+        # worker, not a clean exit
+        os._exit(143)
+
+    _signal.signal(_signal.SIGTERM, _graceful_exit)
     logger.info("worker %s registered, serving", core.worker_id.hex()[:8])
     threading.Event().wait()  # serve forever; supervisor kills us
 

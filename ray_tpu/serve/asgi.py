@@ -185,10 +185,13 @@ def ingress(asgi_app: Any):
                 sessions = self._ws_sessions()
                 q = sessions.get(session_id)
                 if q is _CLOSED:
-                    # session over: this is the proxy's final disconnect
-                    # feed — clear the tombstone and report the session
-                    # gone so nothing re-registers it
-                    sessions.pop(session_id, None)
+                    # session over. Only the proxy's FINAL feed (the
+                    # disconnect in its finally block) clears the
+                    # tombstone — an in-flight data frame racing the
+                    # close must not consume it, or the disconnect feed
+                    # would setdefault a fresh queue and leak it
+                    if event.get("type") == "websocket.disconnect":
+                        sessions.pop(session_id, None)
                     return False
                 if q is None:
                     # a client frame can race __serve_ws__'s queue
