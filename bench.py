@@ -19,14 +19,12 @@ from __future__ import annotations
 
 import json
 import os
-import signal
-import subprocess
 import sys
 import time
 
-TPU_ATTEMPTS = 2
-TPU_TIMEOUT_S = 900.0
-CPU_TIMEOUT_S = 600.0
+TPU_ATTEMPTS = int(os.environ.get("RAY_TPU_BENCH_ATTEMPTS", "2"))
+TPU_TIMEOUT_S = float(os.environ.get("RAY_TPU_BENCH_TIMEOUT_S", "900"))
+CPU_TIMEOUT_S = float(os.environ.get("RAY_TPU_BENCH_CPU_TIMEOUT_S", "600"))
 
 PEAK_FLOPS = {
     # bf16 peak per chip
@@ -179,63 +177,49 @@ def main() -> None:
     """Parent orchestrator: reap, run child with timeout, retry, fall back."""
     repo = os.path.dirname(os.path.abspath(__file__))
     sys.path.insert(0, repo)
-    from ray_tpu._private.reaper import reap_all
+    from ray_tpu._private.harness import (preflight_sweep, run_killable,
+                                          scrub_axon_cpu)
 
-    swept = reap_all()
-    if any(swept.values()):
-        print(f"bench: pre-flight sweep {swept}", file=sys.stderr)
+    log = lambda m: print(f"bench: {m}", file=sys.stderr)  # noqa: E731
+    preflight_sweep(log)
 
-    def attempt(env_extra, timeout):
-        env = dict(os.environ)
-        env.update(env_extra)
-        proc = subprocess.Popen(
+    def attempt(env, timeout):
+        rc, out, _err, timed_out = run_killable(
             [sys.executable, os.path.abspath(__file__), "--child"],
-            env=env, cwd=repo, stdout=subprocess.PIPE,
-            start_new_session=True)  # killable with its tpu helper procs
-        timed_out = False
-        try:
-            out, _ = proc.communicate(timeout=timeout)
-        except subprocess.TimeoutExpired:
-            timed_out = True
-            try:
-                os.killpg(proc.pid, signal.SIGKILL)
-            except OSError:
-                proc.kill()
-            # second communicate() collects whatever the child flushed
-            # before it wedged — the primary record is emitted early
-            # exactly so it can be salvaged here
-            out, _ = proc.communicate()
-            print(f"bench: child timed out after {timeout}s", file=sys.stderr)
-        if not timed_out and proc.returncode != 0:
+            env=env, cwd=repo, timeout=timeout, capture_stderr=False)
+        if timed_out:
+            # the kill's second communicate() collected whatever the
+            # child flushed before it wedged — the primary record is
+            # emitted early exactly so it can be salvaged here
+            log(f"child timed out after {timeout}s")
+        elif rc != 0:
             # do NOT bail yet: a crash (TPU runtime abort, OOM-kill,
             # segfault) during the optional second measurement must not
             # discard an already-emitted primary record — fall through
             # to the salvage scan
-            print(f"bench: child failed rc={proc.returncode}", file=sys.stderr)
+            log(f"child failed rc={rc}")
         # last valid JSON line wins (the child may emit a primary record
         # then an enriched one)
-        for line in reversed(out.decode().strip().splitlines() if out else []):
+        for line in reversed(out.strip().splitlines()):
             try:
                 json.loads(line)
                 return line
             except Exception:
                 continue
-        print("bench: child emitted no JSON", file=sys.stderr)
+        log("child emitted no JSON")
         return None
 
     line = None
     for i in range(TPU_ATTEMPTS):
-        line = attempt({}, TPU_TIMEOUT_S)
+        line = attempt(dict(os.environ), TPU_TIMEOUT_S)
         if line:
             break
         if i + 1 < TPU_ATTEMPTS:  # re-sweep only between TPU attempts
-            reap_all()  # the failed attempt may itself have left debris
+            preflight_sweep(log)  # the failed attempt may have left debris
             time.sleep(5)
     if not line:
-        print("bench: TPU attempts exhausted; falling back to CPU smoke",
-              file=sys.stderr)
-        line = attempt({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
-                       CPU_TIMEOUT_S)
+        log("TPU attempts exhausted; falling back to CPU smoke")
+        line = attempt(scrub_axon_cpu(), CPU_TIMEOUT_S)
     if not line:
         sys.exit(1)
     print(line)
