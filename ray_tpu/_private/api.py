@@ -675,9 +675,17 @@ class ActorMethod:
         self._num_returns = _norm_num_returns(num_returns)
         self._backpressure = backpressure
 
-    def options(self, num_returns=1, **kw) -> "ActorMethod":
-        return ActorMethod(self._handle, self._name, num_returns,
-                           backpressure=_backpressure_from_options(kw))
+    def options(self, num_returns=None, **kw) -> "ActorMethod":
+        # unspecified fields inherit the current values so chained
+        # .options(num_returns="streaming").options(backpressure=2)
+        # composes (advisor r4; mirrors DeploymentHandle.options)
+        return ActorMethod(
+            self._handle, self._name,
+            self._num_returns if num_returns is None else num_returns,
+            backpressure=(_backpressure_from_options(kw)
+                          if ("generator_backpressure" in kw or
+                              "_generator_backpressure_num_objects" in kw)
+                          else self._backpressure))
 
     def bind(self, *args, **kwargs):
         """Build a lazy DAG node for this actor method (ray.dag analog)."""

@@ -292,6 +292,22 @@ class Controller:
             elif kind == "kv":
                 ns, key, value = payload
                 self.kv.setdefault(ns, {})[key] = value
+            elif kind == "kv_del":
+                ns, key = payload
+                self.kv.get(ns, {}).pop(key, None)
+            elif kind == "actor_dead":
+                actor_hex, reason = payload
+                rec = self.actors.get(actor_hex)
+                if rec is not None:
+                    rec.state = ACTOR_DEAD
+                    rec.death_cause = reason
+                    rec.address = None
+            elif kind == "job_finish":
+                job_hex, end_time = payload
+                job = self.jobs.get(job_hex)
+                if job is not None:
+                    job.alive = False
+                    job.end_time = end_time
             applied += 1
         return applied
 
@@ -723,7 +739,15 @@ class Controller:
 
     async def rpc_kv_del(self, body) -> bool:
         self._mark_dirty()
-        return self.kv.get(body.get("ns", ""), {}).pop(body["key"], None) is not None
+        existed = self.kv.get(body.get("ns", ""), {}).pop(
+            body["key"], None) is not None
+        if existed:
+            # tombstone BEFORE the ack: without it, a crash after an
+            # acked delete replays the earlier "kv" registration frame
+            # and resurrects the key (advisor r4, medium)
+            await self._wal_append("kv_del", (body.get("ns", ""),
+                                              body["key"]))
+        return existed
 
     async def rpc_kv_exists(self, body) -> bool:
         return body["key"] in self.kv.get(body.get("ns", ""), {})
@@ -867,6 +891,10 @@ class Controller:
         rec.death_cause = reason
         rec.address = None
         self._mark_dirty()
+        # tombstone: a crash between the kill and the next snapshot must
+        # not replay the registration frame and resurrect the actor —
+        # named_actors would rebind to a dead record (advisor r4, medium)
+        await self._wal_append("actor_dead", (rec.actor_id_hex, reason))
         self.events.emit("ACTOR_DEAD",
                          f"actor {rec.actor_id_hex[:8]}: {reason}",
                          severity="WARNING", actor_id=rec.actor_id_hex,
@@ -1067,6 +1095,10 @@ class Controller:
             job.alive = False
             job.end_time = time.time()
             self._mark_dirty()
+            # tombstone: keep a finished job finished across a crash that
+            # would otherwise replay its registration frame
+            await self._wal_append("job_finish",
+                                   (job.job_id_hex, job.end_time))
             self.events.emit("JOB_FINISHED",
                              f"job {body['job_id_hex'][:8]}",
                              job_id=body["job_id_hex"])

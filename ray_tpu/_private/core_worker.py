@@ -703,7 +703,7 @@ class CoreWorker:
                     stream.event.set()
                     if stream.consumed >= (1 << 31):
                         # reconstruction replay (no live consumer): done
-                        self._streams.pop(task_id, None)
+                        self._drop_sentinel_stream(task_id)
                 any_shared = any_shared or body.get("stream_any_shared", False)
             if spec is not None:
                 self._record_event(spec, "FINISHED")
@@ -843,6 +843,22 @@ class CoreWorker:
             return
         stream.consumed_event.set()  # unblock any backpressure long-poll
         for oid in stream.items[stream.consumed:]:
+            entry = self.objects.get(oid)
+            if entry is not None:
+                self._maybe_free(entry)
+
+    def _drop_sentinel_stream(self, task_id: TaskID) -> None:
+        """Tear down a reconstruction-replay stream (consumed=1<<31
+        sentinel, no live consumer). Every replayed item was re-stored by
+        rpc_stream_item as an owned entry; sweep them through refcounted
+        _maybe_free so ref-less replicas are released while the object
+        that triggered the reconstruction (held by a waiter/borrower)
+        survives — otherwise each reconstruction leaks the rest of the
+        stream's items (advisor r4)."""
+        stream = self._streams.pop(task_id, None)
+        if stream is None:
+            return
+        for oid in stream.items:
             entry = self.objects.get(oid)
             if entry is not None:
                 self._maybe_free(entry)
@@ -1069,7 +1085,7 @@ class CoreWorker:
                 # failed reconstruction replay: no live consumer exists
                 # to release the sentinel state — drop it here or it
                 # leaks per failed reconstruction
-                self._streams.pop(spec.task_id, None)
+                self._drop_sentinel_stream(spec.task_id)
             elif stream is not None and not stream.finished:
                 # items yielded before the failure stay consumable; the
                 # error surfaces after the last of them (reference

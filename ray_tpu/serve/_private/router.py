@@ -23,6 +23,36 @@ from typing import Any, Dict, List, Optional
 import ray_tpu
 
 
+class _WatchedStream(ray_tpu.ObjectRefGenerator):
+    """ObjectRefGenerator that reports its terminal state (clean
+    exhaustion vs task error) back to the router's per-replica failure
+    accounting — a replica that only serves streams must still be
+    observed when it starts failing (advisor r4). Subclasses rather than
+    wraps so handle-side isinstance(ObjectRefGenerator) checks hold."""
+
+    def __init__(self, inner: ray_tpu.ObjectRefGenerator, router: "Router",
+                 replica_key: str):
+        super().__init__(inner._task_id, inner._owner_addr)
+        # take over stream ownership: the inner generator is dropped
+        # right after this call and its __del__ must not release the
+        # still-live stream out from under us
+        inner._released = True
+        self._router = router
+        self._replica_key = replica_key
+
+    def _next(self, timeout=None):
+        try:
+            return super()._next(timeout)
+        except StopIteration:
+            self._router._note_result(self._replica_key, ok=True)
+            raise
+        except BaseException:
+            self._router._note_result(self._replica_key, ok=False)
+            raise
+
+    next = _next  # re-bind: the base class aliases its own _next
+
+
 class Router:
     LONG_POLL_TIMEOUT_S = 30.0
 
@@ -50,6 +80,20 @@ class Router:
         # refreshes while the model may still be loading on that replica
         self._mux_marks: Dict[tuple, float] = {}
         self._mux_last_request = 0.0
+        # replica key -> time of its last observed request failure; fed
+        # by unary completions AND stream terminal states (advisor r4:
+        # a replica that only serves streams must still be observable),
+        # read by _pick to deprioritize recently-failing replicas
+        self._fail_marks: Dict[str, float] = {}
+
+    FAIL_PENALTY_S = 10.0  # how long a failure skews the pow-2 draw
+
+    def _note_result(self, key: str, ok: bool) -> None:
+        with self._lock:
+            if ok:
+                self._fail_marks.pop(key, None)
+            else:
+                self._fail_marks[key] = time.monotonic()
 
     @staticmethod
     def _replica_key(rep) -> str:
@@ -124,9 +168,18 @@ class Router:
             if len(candidates) == 1:
                 idx = candidates[0]
             else:
+                now = time.monotonic()
+
+                def load(i):
+                    # a recent failure outweighs any plausible in-flight
+                    # difference without permanently blacklisting
+                    key = self._replica_key(self._replicas[i])
+                    mark = self._fail_marks.get(key, 0.0)
+                    penalty = 1000 if now - mark < self.FAIL_PENALTY_S else 0
+                    return self._inflight.get(i, 0) + penalty
+
                 a, b = random.sample(candidates, 2)
-                idx = (a if self._inflight.get(a, 0)
-                       <= self._inflight.get(b, 0) else b)
+                idx = a if load(a) <= load(b) else b
             self._inflight[idx] = self._inflight.get(idx, 0) + 1
             return idx, self._replicas[idx]
 
@@ -179,13 +232,15 @@ class Router:
             # in-flight accounting: count the submit only — stream
             # lifetime is tracked replica-side (_active_streams feeds
             # autoscaling), and a long-lived stream must not permanently
-            # skew the pow-2 counter
+            # skew the pow-2 counter. Terminal state still feeds failure
+            # accounting via the watched wrapper (advisor r4).
             with self._lock:
                 if idx in self._inflight and self._inflight[idx] > 0:
                     self._inflight[idx] -= 1
-            return gen, replica
+            return (_WatchedStream(gen, self, self._replica_key(replica)),
+                    replica)
         ref = replica.handle_request.remote(method_name, args, kwargs)
-        self._watch_completion(ref, idx)
+        self._watch_completion(ref, idx, self._replica_key(replica))
         return ref, replica
 
     def _ensure_mux_refresh(self) -> None:
@@ -243,11 +298,15 @@ class Router:
                         fresh.setdefault(mid, set()).update(keep)
                 self._mux_locations = fresh
 
-    def _watch_completion(self, ref, idx: int):
-        def done(_f):
+    def _watch_completion(self, ref, idx: int, key: str):
+        def done(f):
             with self._lock:
                 if idx in self._inflight and self._inflight[idx] > 0:
                     self._inflight[idx] -= 1
+            try:
+                self._note_result(key, ok=f.exception() is None)
+            except Exception:
+                pass
 
         try:
             ref.future().add_done_callback(done)

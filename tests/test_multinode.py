@@ -424,3 +424,50 @@ class TestControllerFaultTolerance:
         b = ray_tpu.get_actor("durable_kv")
         assert ray_tpu.get(b.get.remote("k"), timeout=30) == 7
         ray_tpu.kill(b)
+
+    def test_terminal_transitions_survive_instant_crash(self):
+        """Deletes/kills acked then controller SIGKILLed: tombstone WAL
+        frames must keep them terminal — without them the replayed
+        registration frames resurrect the KV key and the killed actor
+        (named_actors would rebind to a dead record). Snapshot interval
+        is pushed out so ONLY the WAL can carry the transitions."""
+        from ray_tpu._private import internal_kv
+        from ray_tpu._private.config import Config
+        from ray_tpu.cluster_utils import Cluster
+
+        cluster = Cluster(
+            config=Config(controller_snapshot_interval_ms=600_000))
+        try:
+            cluster.add_node(num_cpus=2)
+            cluster.wait_for_nodes(1)
+            ray_tpu.init(address=cluster.address)
+
+            @ray_tpu.remote
+            class Dummy:
+                def ping(self):
+                    return "pong"
+
+            a = Dummy.options(name="doomed", lifetime="detached").remote()
+            assert ray_tpu.get(a.ping.remote()) == "pong"
+            assert internal_kv.kv_put("tomb_key", b"v1")
+            assert internal_kv.kv_del("tomb_key")
+            ray_tpu.kill(a)
+            # wait for the (async) death to land controller-side; the
+            # tombstone is WAL-appended before the state flips
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                try:
+                    ray_tpu.get_actor("doomed")
+                    time.sleep(0.1)
+                except ValueError:
+                    break
+            cluster.restart_controller()
+            cluster.wait_for_nodes(1, timeout=15)
+            assert internal_kv.kv_get("tomb_key") is None, \
+                "acked kv_del resurrected by WAL replay"
+            with pytest.raises(ValueError):
+                ray_tpu.get_actor("doomed")
+        finally:
+            if ray_tpu.is_initialized():
+                ray_tpu.shutdown()
+            cluster.shutdown()

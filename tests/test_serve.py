@@ -281,6 +281,27 @@ class TestStreaming:
                        route_prefix="/ngen2")
         assert list(h2.options(stream=True).remote(3)) == [0, 1, 2]
 
+    def test_router_failure_mark_skews_pick(self):
+        """A replica with a recent request failure (unary or stream
+        terminal error — advisor r4) loses every pow-2 draw until the
+        penalty window lapses."""
+        from ray_tpu.serve._private.router import Router
+
+        r = Router(None, "app", "dep")
+        rep_a, rep_b = object(), object()
+        r._replicas = [rep_a, rep_b]
+        r._inflight = {0: 0, 1: 0}
+        r._key_to_idx = {r._replica_key(rep_a): 0,
+                         r._replica_key(rep_b): 1}
+        r._note_result(r._replica_key(rep_a), ok=False)
+        picks = {r._pick()[0] for _ in range(20)}
+        assert picks == {1}, f"failing replica still drawn: {picks}"
+        # success clears the mark; both replicas are drawable again
+        r._note_result(r._replica_key(rep_a), ok=True)
+        r._inflight = {0: 0, 1: 0}
+        picks = {r._pick()[0] for _ in range(50)}
+        assert picks == {0, 1}
+
     def test_native_stream_error_propagates(self, serve_shutdown):
         @serve.deployment
         class Bad:

@@ -138,6 +138,31 @@ def test_actor_streaming_method(ray_init):
     ray_tpu.kill(a)
 
 
+def test_actor_method_options_compose(ray_init):
+    """Chained .options() preserves unspecified fields: streaming set in
+    one call survives a later backpressure-only call (advisor r4)."""
+
+    @ray_tpu.remote
+    class Streamer:
+        def tokens(self, n):
+            for i in range(n):
+                yield i
+
+    a = Streamer.remote()
+    m = a.tokens.options(num_returns="streaming").options(
+        generator_backpressure=2)
+    assert m._num_returns == -1  # still streaming
+    assert m._backpressure == 2
+    g = m.remote(3)
+    assert [ray_tpu.get(r) for r in g] == [0, 1, 2]
+    # and the reverse order keeps the backpressure window
+    m2 = a.tokens.options(generator_backpressure=3).options(
+        num_returns="streaming")
+    assert m2._backpressure == 3
+    assert m2._num_returns == -1
+    ray_tpu.kill(a)
+
+
 def test_async_actor_async_generator(ray_init):
     """Async actors stream via async generators interleaved on the loop."""
 
