@@ -34,7 +34,7 @@ import time
 from collections import OrderedDict, deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-from ray_tpu._private import serialization
+from ray_tpu._private import device_objects, serialization
 from ray_tpu._private.config import Config
 from ray_tpu._private.exceptions import (
     ActorDiedError,
@@ -78,6 +78,7 @@ def _trace(msg: str) -> None:
 PENDING = "PENDING"
 INLINE = "INLINE"  # packed bytes in the in-process store
 SHARED = "SHARED"  # in a node arena; location recorded
+DEVICE = "DEVICE"  # jax.Array parked in the owner's HBM registry
 FAILED = "FAILED"
 
 
@@ -92,6 +93,10 @@ class ObjectEntry:
     local_refs: int = 0
     borrows: int = 0
     task_pins: int = 0  # pinned as in-flight task args
+    # DEVICE entries: serialized DeviceArrayMeta; for task returns the
+    # holder is the EXECUTOR worker (location = its worker address, the
+    # HBM stays there), for puts the owner itself (location None)
+    device_meta: Optional[bytes] = None
 
 
 @dataclasses.dataclass
@@ -177,6 +182,9 @@ class CoreWorker:
 
         self.in_process = InProcessStore()
         self.objects: Dict[ObjectID, ObjectEntry] = {}
+        # jax.Arrays put through the object layer stay in HBM, owned here
+        # (device_objects.py — the compiled-DAG/channels answer)
+        self.device_objects = device_objects.DeviceObjectRegistry()
         self._fn_cache: Dict[str, Any] = {}
         self._fn_registered: set = set()
         self._leases: Dict[str, List[_Lease]] = {}
@@ -688,6 +696,15 @@ class CoreWorker:
                     self.in_process.put(oid, payload)
                     entry.state = INLINE
                     entry.size = len(payload)
+                elif kind == "device":
+                    # jax.Array return: HBM stays with the executor
+                    # worker; only layout metadata lands here. Lossable
+                    # like SHARED, so lineage applies.
+                    entry.state = DEVICE
+                    entry.size = payload["size"]
+                    entry.location = tuple(payload["worker_addr"])
+                    entry.device_meta = payload["meta"]
+                    any_shared = True
                 else:  # shared
                     entry.state = SHARED
                     entry.size = payload["size"]
@@ -986,7 +1003,7 @@ class CoreWorker:
         (used by both get_object and the batched object_states)."""
         if entry is None:
             return "unknown"
-        return {PENDING: "pending", FAILED: "error",
+        return {PENDING: "pending", FAILED: "error", DEVICE: "device",
                 INLINE: "value"}.get(entry.state, "location")
 
     async def rpc_get_object(self, body):
@@ -1019,7 +1036,29 @@ class CoreWorker:
         if status == "location":
             return {"status": status, "size": entry.size,
                     "node_addr": entry.location}
+        if status == "device":
+            # holder None -> the data is in THIS process's registry
+            return {"status": status,
+                    "meta": entry.device_meta or serialization.dumps(
+                        self.device_objects.meta(oid)),
+                    "holder": entry.location}
         return {"status": status}
+
+    async def rpc_device_read(self, body) -> bytes:
+        """One bounded chunk of a device object's shard, staged host-side
+        by the owner (device->host conversion cached across chunks)."""
+        oid = ObjectID(body["object_id"])
+        index_key = tuple(tuple(p) for p in body["index"])
+        loop = asyncio.get_running_loop()
+        # the device->host staging copy can be many MB: keep it off the
+        # event loop
+        return await loop.run_in_executor(
+            None, self.device_objects.read, oid, index_key,
+            body["offset"], body["length"])
+
+    async def rpc_device_free(self, body) -> None:
+        """Owner GC reached zero refs for a device return we hold."""
+        self.device_objects.drop(ObjectID(body["object_id"]))
 
     async def rpc_object_states(self, body) -> List[str]:
         """Batched status probe for wait(): one RPC covers many refs."""
@@ -1114,9 +1153,21 @@ class CoreWorker:
 
     def put(self, value: Any) -> Tuple[ObjectID, Address]:
         oid = ObjectID.from_put()
+        if device_objects.is_device_array(value):
+            # no host round-trip: HBM ownership stays here; only layout
+            # metadata ever crosses the wire (device_objects.py)
+            self._run(self._async_store_device(oid, value))
+            return oid, self.address
         packed = serialization.pack(value)
         entry = self._run(self._async_store_owned(oid, packed))
         return oid, self.address
+
+    async def _async_store_device(self, oid: ObjectID, arr: Any) -> None:
+        entry = self._ensure_entry(oid)
+        meta = self.device_objects.put(oid, arr)
+        entry.state = DEVICE
+        entry.size = meta.nbytes
+        self._wake(entry)
 
     async def _async_store_owned(self, oid: ObjectID, packed: bytes) -> ObjectEntry:
         entry = self._ensure_entry(oid)
@@ -1173,7 +1224,17 @@ class CoreWorker:
                 raise entry.error
             if entry.state == INLINE:
                 return serialization.unpack(self.in_process.get(oid))
+            if entry.state == DEVICE:
+                local = self.device_objects.get(oid)
+                if local is not None:
+                    return local  # owner-side zero-copy: the live array
             try:
+                if entry.state == DEVICE:
+                    # task-return device object: HBM lives with the
+                    # executor worker; stream it from there
+                    return await self._fetch_device(
+                        oid, entry.location,
+                        serialization.loads(entry.device_meta))
                 return await self._read_shared(oid, entry.size, entry.location)
             except (ObjectLostError, RpcConnectionError, RpcTimeoutError, RemoteError) as e:
                 # The node holding the data is gone: reconstruct by
@@ -1209,6 +1270,31 @@ class CoreWorker:
             status = r["status"]
             if status == "value":
                 return serialization.unpack(r["value"])
+            if status == "device":
+                holder = tuple(r["holder"]) if r.get("holder") else owner
+                try:
+                    return await self._fetch_device(
+                        oid, holder, serialization.loads(r["meta"]))
+                except ObjectLostError as e:
+                    # holder worker died: ask the owner to reconstruct
+                    # from lineage, then keep polling (same stance as
+                    # the SHARED location branch below)
+                    lost_attempts += 1
+                    if lost_attempts > 3:
+                        raise
+                    try:
+                        recoverable = await self.clients.get(owner).call(
+                            "object_lost", {"object_id": oid.binary()})
+                    except Exception:
+                        await asyncio.sleep(0.1)
+                        continue
+                    if not recoverable:
+                        raise ObjectLostError(
+                            oid.hex(),
+                            f"device object lost, not reconstructable: {e}"
+                        ) from e
+                    await asyncio.sleep(0.05)
+                    continue
             if status == "location":
                 try:
                     return await self._read_shared(oid, r["size"], tuple(r["node_addr"]))
@@ -1244,6 +1330,55 @@ class CoreWorker:
             # still pending: the long-poll round expired — go straight
             # back in (no extra client-side backoff on top of it)
             await asyncio.sleep(delay)
+
+    async def _fetch_device(self, oid: ObjectID, holder: Address, meta) -> Any:
+        """Materialize a remote device object locally: stream each shard's
+        host staging buffer in bounded chunks (next chunk prefetched while
+        the current one is appended — the wire stays busy), then assemble
+        with the sender's logical sharding on this process's devices
+        (device_objects.assemble; device_put dispatches asynchronously so
+        uploads overlap the Python-side loop). Holder loss surfaces as
+        ObjectLostError so the callers' reconstruction loops engage."""
+        client = self.clients.get(holder)
+        chunk = self.config.object_transfer_chunk_bytes
+        shard_data = {}
+        pending = nxt = None
+        try:
+            for index_key, nbytes in meta.shards:
+                parts = []
+                pos = 0
+                pending = None
+                if nbytes == 0:  # zero-size shard: nothing on the wire
+                    shard_data[tuple(tuple(p) for p in index_key)] = b""
+                    continue
+                while pos < nbytes or pending is not None:
+                    if pending is None:
+                        pending = asyncio.ensure_future(client.call(
+                            "device_read",
+                            {"object_id": oid.binary(), "index": index_key,
+                             "offset": pos, "length": chunk}, timeout=600))
+                        pos += chunk
+                    nxt = None
+                    if pos < nbytes:  # prefetch the next chunk now
+                        nxt = asyncio.ensure_future(client.call(
+                            "device_read",
+                            {"object_id": oid.binary(), "index": index_key,
+                             "offset": pos, "length": chunk}, timeout=600))
+                        pos += chunk
+                    parts.append(await pending)
+                    pending = nxt
+                    nxt = None
+                shard_data[tuple(tuple(p) for p in index_key)] = b"".join(parts)
+        except (RpcConnectionError, RpcTimeoutError, RemoteError) as e:
+            raise ObjectLostError(
+                oid.hex(), f"device object holder unreachable: {e}") from e
+        finally:
+            for fut in (pending, nxt):
+                if fut is not None and not fut.done():
+                    fut.cancel()
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, device_objects.assemble, meta, shard_data)
 
     async def _read_shared(self, oid: ObjectID, size: int, node_addr: Address) -> Any:
         sup = self.clients.get(self.supervisor_addr or node_addr)
@@ -1322,7 +1457,7 @@ class CoreWorker:
                     done.extend(group)  # owner gone → resolves to error at get
                     continue
                 for r, st in zip(group, states):
-                    if st in ("value", "location", "error"):
+                    if st in ("value", "location", "device", "error"):
                         done.append(r)
                     else:
                         still.append(r)
@@ -1374,11 +1509,24 @@ class CoreWorker:
             entry.local_refs <= 0
             and entry.borrows <= 0
             and entry.task_pins <= 0
-            and entry.state in (INLINE, SHARED, FAILED)
+            and entry.state in (INLINE, SHARED, DEVICE, FAILED)
         ):
             oid = entry.object_id
             self.objects.pop(oid, None)
             self.in_process.free(oid)
+            if entry.state == DEVICE:
+                # owner GC: dropping the registry reference frees the HBM
+                if not self.device_objects.drop(oid) \
+                        and entry.location is not None:
+                    # holder is the executor worker: tell it to release
+                    async def free_device():
+                        try:
+                            await self.clients.get(entry.location).notify(
+                                "device_free", {"object_id": oid.binary()})
+                        except Exception:
+                            pass
+
+                    asyncio.get_running_loop().create_task(free_device())
             if entry.state == SHARED and entry.location is not None:
                 async def free_remote():
                     try:

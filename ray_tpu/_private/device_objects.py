@@ -1,0 +1,276 @@
+"""Device-array objects: jax.Arrays through the object layer without a
+host round-trip at put time.
+
+TPU-first answer to the reference's compiled-DAG mutable plasma channels
+(`python/ray/experimental/channel.py:76`,
+`src/ray/core_worker/experimental_mutable_object_manager.h:36`) and to
+SURVEY §7 hard part 2. The reference moves tensors between processes by
+copying them into mutable shared-memory buffers; on TPU the data already
+lives in HBM with a sharding layout, so the object layer should *keep*
+it there:
+
+- ``put()`` of a jax.Array records only metadata (global shape/dtype +
+  mesh axes + partition spec) and parks the array in the owner's
+  process-local registry — HBM ownership stays with the worker, nothing
+  is serialized.
+- ``get()`` by the owner is a registry lookup: zero-copy, zero host
+  traffic.
+- ``get()`` by another process streams each addressable shard's host
+  staging buffer in bounded chunks and re-materializes on the reader's
+  devices with the *same logical sharding* (equivalent local mesh built
+  from the recorded axes). ``jax.device_put`` dispatches asynchronously,
+  so shard k uploads while shard k+1's bytes are still arriving — the
+  double-buffered pinned-host pattern.
+- Owner-based GC: when the ref count hits zero the registry entry drops
+  and XLA frees the HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+# index key: ((start, stop) per dim) — the normalized form of a shard's
+# global-slice index, stable across sender and receiver
+IndexKey = Tuple[Tuple[int, int], ...]
+
+
+def is_device_array(value: Any) -> bool:
+    """True for a jax.Array (any sharding), without importing jax for
+    non-array values (the object layer must stay importable — and fast —
+    in processes that never touch a device)."""
+    mod = type(value).__module__ or ""
+    if not (mod.startswith("jax") or mod.startswith("jaxlib")):
+        return False
+    try:
+        import jax
+
+        # tracers subclass jax.Array but have no committed buffers
+        return (isinstance(value, jax.Array)
+                and not isinstance(value, jax.core.Tracer))
+    except Exception:
+        return False
+
+
+@dataclasses.dataclass
+class DeviceArrayMeta:
+    """Wire-serializable description of a device array's layout."""
+
+    shape: Tuple[int, ...]
+    dtype: str
+    nbytes: int
+    # mesh axes as ((name, size), ...) — None for single-device arrays
+    mesh_axes: Optional[Tuple[Tuple[str, int], ...]]
+    # partition spec entries: None, axis name, or tuple of axis names
+    pspec: Optional[Tuple[Any, ...]]
+    # per-shard global-slice indices + byte sizes, one per distinct shard
+    shards: List[Tuple[IndexKey, int]] = dataclasses.field(
+        default_factory=list)
+
+
+def _norm_index(index, shape) -> IndexKey:
+    """Normalize a shard's tuple-of-slices index to ((start, stop), ...)."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append((start, stop))
+    # scalar/0-d arrays have empty indices
+    return tuple(out)
+
+
+def extract_meta(arr) -> DeviceArrayMeta:
+    from jax.sharding import NamedSharding
+
+    mesh_axes = None
+    pspec = None
+    sharding = arr.sharding
+    if isinstance(sharding, NamedSharding):
+        mesh = sharding.mesh
+        mesh_axes = tuple((str(n), int(s))
+                          for n, s in zip(mesh.axis_names, mesh.devices.shape))
+        pspec = tuple(
+            tuple(p) if isinstance(p, (tuple, list)) else p
+            for p in sharding.spec)
+    seen: Dict[IndexKey, int] = {}
+    for sh in arr.addressable_shards:
+        key = _norm_index(sh.index, arr.shape)
+        if key not in seen:
+            seen[key] = int(sh.data.nbytes)
+    return DeviceArrayMeta(
+        shape=tuple(int(d) for d in arr.shape),
+        dtype=str(arr.dtype),
+        nbytes=int(arr.nbytes) if arr.size else 0,
+        mesh_axes=mesh_axes,
+        pspec=pspec,
+        shards=list(seen.items()),
+    )
+
+
+def shard_host_bytes(arr, index_key: IndexKey) -> bytes:
+    """Host staging buffer for the shard at *index_key* (first match —
+    replicated shards are bit-identical)."""
+    import numpy as np
+
+    for sh in arr.addressable_shards:
+        if _norm_index(sh.index, arr.shape) == index_key:
+            return np.ascontiguousarray(np.asarray(sh.data)).tobytes()
+    raise KeyError(f"no addressable shard at {index_key}")
+
+
+def _equivalent_local_mesh(mesh_axes):
+    """Build a local mesh with the recorded axis names/sizes from this
+    process's devices; None when not enough devices are attached."""
+    import math
+
+    import jax
+    from jax.sharding import Mesh
+
+    need = math.prod(s for _, s in mesh_axes) if mesh_axes else 1
+    devices = jax.devices()
+    if len(devices) < need:
+        return None
+    import numpy as np
+
+    names = tuple(n for n, _ in mesh_axes)
+    sizes = tuple(s for _, s in mesh_axes)
+    return Mesh(np.array(devices[:need]).reshape(sizes), names)
+
+
+def assemble(meta: DeviceArrayMeta,
+             shard_data: Dict[IndexKey, bytes]):
+    """Re-materialize a device array from per-shard host buffers.
+
+    With enough local devices the array comes back with the SAME logical
+    sharding (axis names, sizes, partition spec) over this process's
+    devices; otherwise it lands on the default device. device_put calls
+    dispatch asynchronously, so the per-shard uploads overlap with any
+    remaining network reads the caller is still doing.
+    """
+    import numpy as np
+
+    dtype = np.dtype(meta.dtype)
+
+    def shard_np(key: IndexKey) -> "np.ndarray":
+        shape = tuple(stop - start for start, stop in key)
+        return np.frombuffer(shard_data[key], dtype=dtype).reshape(shape)
+
+    try:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+    except Exception:  # no jax in this process: plain numpy fallback
+        return _assemble_numpy(meta, shard_np)
+
+    if meta.mesh_axes:
+        mesh = _equivalent_local_mesh(meta.mesh_axes)
+        if mesh is not None:
+            spec = PartitionSpec(*(meta.pspec or ()))
+            sharding = NamedSharding(mesh, spec)
+            index_map = sharding.devices_indices_map(meta.shape)
+            bufs = []
+            try:
+                for dev, index in index_map.items():
+                    key = _norm_index(index, meta.shape)
+                    bufs.append(jax.device_put(shard_np(key), dev))
+                return jax.make_array_from_single_device_arrays(
+                    meta.shape, sharding, bufs)
+            except KeyError:
+                # sender shard layout didn't line up (e.g. partial
+                # addressability); fall through to single-device
+                pass
+    return jax.device_put(_assemble_numpy(meta, shard_np))
+
+
+def _assemble_numpy(meta: DeviceArrayMeta, shard_np):
+    import math
+
+    import numpy as np
+
+    if len(meta.shards) == 1:
+        key = meta.shards[0][0]
+        full = shard_np(key)
+        if tuple(stop - start for start, stop in key) == meta.shape:
+            return full
+    # the recorded shards must tile the whole global shape (NamedSharding
+    # slices partition cleanly, so element counts suffice) — a partial
+    # view (e.g. a sender that addressed only part of a multi-host array)
+    # must fail loudly, never return np.empty() garbage
+    covered = sum(
+        math.prod(stop - start for start, stop in key)
+        for key, _ in meta.shards)
+    total = math.prod(meta.shape)
+    if covered != total:
+        raise ValueError(
+            f"device object shards cover {covered}/{total} elements — "
+            "sender did not address the full array")
+    out = np.empty(meta.shape, dtype=np.dtype(meta.dtype))
+    for key, _ in meta.shards:
+        out[tuple(slice(start, stop) for start, stop in key)] = shard_np(key)
+    return out
+
+
+class DeviceObjectRegistry:
+    """Holder-side HBM registry: oid -> live jax.Array (+ a tiny host
+    staging cache for in-flight remote reads, so a multi-chunk shard
+    transfer converts device->host once, not once per chunk).
+
+    ``read`` runs on executor threads while ``put``/``drop`` run on the
+    event loop — every mutation holds the lock."""
+
+    _STAGE_CACHE = 2
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self._arrays: Dict[Any, Any] = {}
+        self._meta: Dict[Any, DeviceArrayMeta] = {}
+        self._stage: "Dict[Tuple[Any, IndexKey], bytes]" = {}
+
+    def put(self, oid, arr) -> DeviceArrayMeta:
+        meta = extract_meta(arr)
+        with self._lock:
+            self._arrays[oid] = arr
+            self._meta[oid] = meta
+        return meta
+
+    def get(self, oid):
+        with self._lock:
+            return self._arrays.get(oid)
+
+    def meta(self, oid) -> Optional[DeviceArrayMeta]:
+        with self._lock:
+            return self._meta.get(oid)
+
+    def read(self, oid, index_key: IndexKey, offset: int,
+             length: int) -> bytes:
+        cache_key = (oid, index_key)
+        with self._lock:
+            buf = self._stage.get(cache_key)
+            arr = self._arrays.get(oid)
+        if buf is None:
+            if arr is None:
+                raise KeyError(f"device object {oid!r} released")
+            # device->host staging outside the lock (can be many MB)
+            buf = shard_host_bytes(arr, index_key)
+            with self._lock:
+                while len(self._stage) >= self._STAGE_CACHE:
+                    self._stage.pop(next(iter(self._stage)), None)
+                self._stage[cache_key] = buf
+        chunk = buf[offset:offset + length]
+        if offset + length >= len(buf):  # last chunk: staging done
+            with self._lock:
+                self._stage.pop(cache_key, None)
+        return chunk
+
+    def drop(self, oid) -> bool:
+        """GC: releasing the registry reference frees the HBM."""
+        with self._lock:
+            self._meta.pop(oid, None)
+            for k in [k for k in self._stage if k[0] == oid]:
+                self._stage.pop(k, None)
+            return self._arrays.pop(oid, None) is not None
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._arrays)

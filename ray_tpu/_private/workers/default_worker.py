@@ -411,8 +411,26 @@ class Executor:
     # -- result reporting (owner is the submitter) --
 
     def _report_results(self, spec: TaskSpec, values: list) -> None:
+        from ray_tpu._private import device_objects
+
         results = []
         for oid, value in zip(spec.return_ids(), values):
+            if (device_objects.is_device_array(value)
+                    and value.nbytes >
+                    self.core.config.max_direct_call_object_size):
+                # Large jax.Array return: keep the HBM here (this worker
+                # is the holder), report layout metadata only — no host
+                # pickle. The owner frees it via device_free at zero
+                # refs; if this worker dies first, lineage re-executes
+                # the task. Small arrays stay on the inline path: the
+                # host copy is negligible and the value can never be
+                # lost with the worker.
+                meta = self.core.device_objects.put(oid, value)
+                results.append((oid.binary(), "device", {
+                    "size": meta.nbytes,
+                    "worker_addr": self.core.address,
+                    "meta": serialization.dumps(meta)}))
+                continue
             packed = serialization.pack(value)
             if len(packed) <= self.core.config.max_direct_call_object_size:
                 results.append((oid.binary(), "inline", packed))

@@ -1,0 +1,244 @@
+"""Device-array objects through the object layer (VERDICT r4 item 3 —
+the TPU-first answer to compiled-DAG mutable plasma channels,
+ref `python/ray/experimental/channel.py:76`,
+`src/ray/core_worker/experimental_mutable_object_manager.h:36`).
+
+put() of a jax.Array must keep HBM ownership with the worker (no host
+serialization); owner get() is zero-copy; a consumer in another process
+receives the array re-materialized with the SAME logical sharding over
+its own (virtual 8-CPU) mesh; owner GC frees the registry reference.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import device_objects
+
+
+def _sharded_array():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ("x", "y"))
+    arr = jnp.arange(16 * 8, dtype=jnp.float32).reshape(16, 8)
+    return jax.device_put(arr, NamedSharding(mesh, P("x", "y"))), mesh
+
+
+class TestDeviceObjectsLocal:
+    def test_put_get_zero_copy(self, ray_init):
+        arr, _ = _sharded_array()
+        ref = ray_tpu.put(arr)
+        out = ray_tpu.get(ref)
+        assert out is arr  # owner-side get is the SAME live array
+
+    def test_put_stores_no_host_bytes(self, ray_init):
+        """The object entry is DEVICE-state metadata only — nothing in
+        the in-process store or arena."""
+        from ray_tpu._private import api as api_mod
+
+        arr, _ = _sharded_array()
+        ref = ray_tpu.put(arr)
+        core = api_mod._core
+        entry = core.objects[ref._object_id]
+        assert entry.state == "DEVICE"
+        assert core.in_process.get(ref._object_id) is None
+        assert core.device_objects.get(ref._object_id) is arr
+
+    def test_owner_gc_frees_registry(self, ray_init):
+        from ray_tpu._private import api as api_mod
+
+        arr, _ = _sharded_array()
+        ref = ray_tpu.put(arr)
+        core = api_mod._core
+        oid = ref._object_id
+        assert core.device_objects.get(oid) is not None
+        del ref
+        import gc
+
+        gc.collect()
+        deadline = __import__("time").time() + 5
+        while (core.device_objects.get(oid) is not None
+               and __import__("time").time() < deadline):
+            __import__("time").sleep(0.05)
+        assert core.device_objects.get(oid) is None, \
+            "HBM registry entry survived ref drop"
+
+    def test_meta_roundtrip(self):
+        arr, mesh = _sharded_array()
+        meta = device_objects.extract_meta(arr)
+        assert meta.shape == (16, 8)
+        assert meta.mesh_axes == (("x", 2), ("y", 4))
+        assert meta.pspec == ("x", "y")
+        assert len(meta.shards) == 8  # fully sharded: one per device
+        # reassemble from the host staging buffers
+        data = {k: device_objects.shard_host_bytes(arr, k)
+                for k, _ in meta.shards}
+        out = device_objects.assemble(meta, data)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(arr))
+        # and the logical sharding came back identical
+        import jax.sharding as shd
+
+        assert isinstance(out.sharding, shd.NamedSharding)
+        assert dict(zip(out.sharding.mesh.axis_names,
+                        out.sharding.mesh.devices.shape)) == \
+            {"x": 2, "y": 4}
+        assert tuple(out.sharding.spec) == ("x", "y")
+
+    def test_replicated_axes(self):
+        """Partially-replicated layouts (None in the spec) round-trip."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        devs = np.array(jax.devices()[:8]).reshape(2, 4)
+        mesh = Mesh(devs, ("x", "y"))
+        arr = jax.device_put(
+            jnp.arange(32, dtype=jnp.float32).reshape(8, 4),
+            NamedSharding(mesh, P(None, "y")))
+        meta = device_objects.extract_meta(arr)
+        assert len(meta.shards) == 4  # x-replicated: 4 distinct shards
+        data = {k: device_objects.shard_host_bytes(arr, k)
+                for k, _ in meta.shards}
+        out = device_objects.assemble(meta, data)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(arr))
+        assert tuple(out.sharding.spec) == (None, "y")
+
+
+class TestDeviceObjectsCrossProcess:
+    def test_actor_receives_sharded_array(self, ray_init):
+        """Driver puts a sharded array; an actor in ANOTHER process gets
+        it re-materialized with the same logical sharding on its own
+        8-device mesh — the bytes ride the chunked shard transfer, never
+        the arena/pickle path."""
+
+        @ray_tpu.remote
+        class Consumer:
+            def describe(self, ref):
+                arr = ray_tpu.get(ref[0])
+                import jax.sharding as shd
+
+                sharding = arr.sharding
+                return {
+                    "sum": float(arr.sum()),
+                    "shape": tuple(arr.shape),
+                    "named": isinstance(sharding, shd.NamedSharding),
+                    "axes": dict(zip(sharding.mesh.axis_names,
+                                     sharding.mesh.devices.shape))
+                    if isinstance(sharding, shd.NamedSharding) else None,
+                    "spec": tuple(sharding.spec)
+                    if isinstance(sharding, shd.NamedSharding) else None,
+                }
+
+        arr, _ = _sharded_array()
+        ref = ray_tpu.put(arr)
+        c = Consumer.remote()
+        # pass inside a list so the ref is NOT auto-resolved by the
+        # executor into a value argument — the actor resolves it itself
+        out = ray_tpu.get(c.describe.remote([ref]))
+        assert out["sum"] == float(np.asarray(arr).sum())
+        assert out["shape"] == (16, 8)
+        assert out["named"] is True
+        assert out["axes"] == {"x": 2, "y": 4}
+        assert out["spec"] == ("x", "y")
+        ray_tpu.kill(c)
+
+    def test_device_ref_as_plain_task_arg(self, ray_init):
+        """A DEVICE ref passed directly as a task arg resolves through
+        the executor's normal ref-resolution (device fetch included)."""
+
+        @ray_tpu.remote
+        def total(x):
+            return float(np.asarray(x).sum())
+
+        arr, _ = _sharded_array()
+        ref = ray_tpu.put(arr)
+        assert ray_tpu.get(total.remote(ref)) == \
+            float(np.asarray(arr).sum())
+
+    def test_actor_returns_device_array(self, ray_init):
+        """Actor A returns a large jax.Array; the HBM stays with A's
+        worker (the holder), the owner gets metadata only, and the
+        consumer re-materializes the array with its sharding — the
+        actor-to-actor device pass the compiled-DAG channels serve in
+        the reference."""
+
+        @ray_tpu.remote
+        class Producer:
+            def make(self):
+                import jax
+                import jax.numpy as jnp
+                from jax.sharding import (Mesh, NamedSharding,
+                                          PartitionSpec as P)
+
+                devs = np.array(jax.devices()[:8]).reshape(8)
+                mesh = Mesh(devs, ("x",))
+                arr = jnp.arange(512 * 256, dtype=jnp.float32
+                                 ).reshape(512, 256)
+                return jax.device_put(arr, NamedSharding(mesh, P("x")))
+
+        @ray_tpu.remote
+        class Consumer:
+            def total(self, ref):
+                arr = ray_tpu.get(ref[0])
+                import jax.sharding as shd
+
+                assert isinstance(arr.sharding, shd.NamedSharding), \
+                    type(arr.sharding)
+                # float64 host sum: exact, independent of shard order
+                return (float(np.asarray(arr).astype(np.float64).sum()),
+                        tuple(arr.sharding.spec))
+
+        p, c = Producer.remote(), Consumer.remote()
+        ref = p.make.remote()
+        got_sum, spec = ray_tpu.get(c.total.remote([ref]))
+        n = 512 * 256
+        expect = float(np.arange(n, dtype=np.float32)
+                       .astype(np.float64).sum())
+        assert got_sum == expect
+        assert spec == ("x",)
+        # the driver (owner) holds only metadata, no host bytes
+        from ray_tpu._private import api as api_mod
+
+        entry = api_mod._core.objects[ref._object_id]
+        assert entry.state == "DEVICE"
+        assert entry.location is not None  # holder = producer's worker
+        # and the driver itself can materialize it too
+        arr = ray_tpu.get(ref)
+        assert float(np.asarray(arr).astype(np.float64).sum()) == expect
+        ray_tpu.kill(p)
+        ray_tpu.kill(c)
+
+    def test_small_device_array_returns_inline(self, ray_init):
+        """Small jax.Array returns stay on the loss-proof inline path."""
+
+        @ray_tpu.remote
+        def tiny():
+            import jax.numpy as jnp
+
+            return jnp.ones((4, 4), jnp.float32)
+
+        out = ray_tpu.get(tiny.remote())
+        assert float(np.asarray(out).sum()) == 16.0
+
+    def test_large_array_chunked_transfer(self, ray_init):
+        """A shard bigger than one transfer chunk streams correctly."""
+        import jax
+        import jax.numpy as jnp
+
+        @ray_tpu.remote
+        def check(ref):
+            a = ray_tpu.get(ref[0])
+            return float(a[0, 0]), float(a[-1, -1]), tuple(a.shape)
+
+        # single-device array ~8MB (default chunk is 8MB — forces the
+        # multi-chunk path when it rides one shard)
+        arr = jnp.arange(1500 * 1500, dtype=jnp.float32).reshape(1500, 1500)
+        arr = jax.device_put(arr)
+        ref = ray_tpu.put(arr)
+        first, last, shape = ray_tpu.get(check.remote([ref]))
+        assert shape == (1500, 1500)
+        assert first == 0.0
+        assert last == float(1500 * 1500 - 1)
