@@ -105,3 +105,144 @@ def pipeline_apply(stage_fn: Callable[[Any, Any], Any], stacked_params,
         in_specs=(param_specs, in_x_spec),
         out_specs=P(*([None] * x.ndim)),
     )(stacked_params, x)
+
+
+def _shard_map(mesh):
+    import functools
+
+    try:
+        from jax import shard_map as _sm
+
+        return functools.partial(_sm, mesh=mesh, check_vma=False)
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map as _sme
+
+        return functools.partial(_sme, mesh=mesh, check_rep=False)
+
+
+def pipeline_1f1b(stage_fn: Callable[[Any, Any], Any],
+                  loss_fn: Callable[[Any], Any],
+                  stacked_params, x, *, mesh: Mesh, axis: str = "pp"):
+    """Train-step pipeline with the 1F1B (one-forward-one-backward)
+    microbatch schedule (VERDICT r4 item 7; the schedule the reference
+    world gets from MPMD stage processes, here compiled into ONE jit
+    over the `pp` mesh axis).
+
+    Unlike `pipeline_apply` + autodiff — which, like GPipe, keeps every
+    microbatch's boundary activation alive until the backward sweep — the
+    backward for microbatch m starts as soon as the last stage finishes
+    its forward, so each stage holds at most ``2*num_stages`` boundary
+    activations regardless of the microbatch count: the property that
+    lets long accumulation runs fit HBM. Stage forwards are recomputed
+    from the stored boundary input at backward time (the standard
+    remat-in-pipeline tradeoff).
+
+    Schedule (steps t = 0 .. M + 2S - 3, stage s):
+      forward  of microbatch f = t - s            (when 0 <= f < M)
+      backward of microbatch b = t - (2S - 2 - s) (when 0 <= b < M)
+    so the last stage runs loss+backward in the same step as its
+    forward, cotangents ride a reverse `ppermute`, and in steady state
+    every device does one forward and one backward per step.
+
+    stage_fn(stage_params, act) -> act : uniform-width stage.
+    loss_fn(act) -> scalar : per-microbatch loss on the LAST stage's
+        output (mean over microbatches is returned).
+    x: [M, microbatch, ...] inputs, replicated over `axis`.
+
+    Returns (loss, stage_grads) where stage_grads matches
+    `stacked_params` (leading stage axis, sharded over `axis`).
+    """
+    num_stages = mesh.shape[axis]
+    num_micro = x.shape[0]
+    steps = num_micro + 2 * num_stages - 2
+    buf_slots = 2 * num_stages
+
+    param_specs = jax.tree.map(
+        lambda v: P(axis, *([None] * (v.ndim - 1))), stacked_params)
+
+    def local(params_local, x_local):
+        my_params = jax.tree.map(lambda v: v[0], params_local)
+        stage = lax.axis_index(axis)
+        is_first = stage == 0
+        is_last = stage == num_stages - 1
+        fwd_perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+        bwd_perm = [((i + 1) % num_stages, i) for i in range(num_stages)]
+
+        def fwd(p, a):
+            return stage_fn(p, a)
+
+        mb_shape = x_local[0].shape
+        mb_dtype = x_local[0].dtype
+
+        def step(carry, t):
+            fwd_buf, bwd_buf, act_store, grad_acc, loss_acc = carry
+
+            # ---- forward slot: microbatch f = t - stage
+            f = t - stage
+            f_active = (f >= 0) & (f < num_micro)
+            feed = x_local[jnp.clip(f, 0, num_micro - 1)]
+            act_in = jnp.where(is_first, feed, fwd_buf)
+            act_out = fwd(my_params, act_in)
+            # park the boundary input for this microbatch's backward
+            act_store = jnp.where(
+                f_active,
+                act_store.at[jnp.clip(f, 0, num_micro - 1) % buf_slots]
+                .set(act_in),
+                act_store)
+
+            # ---- backward slot: microbatch b = t - (2S - 2 - stage)
+            b = t - (2 * num_stages - 2 - stage)
+            b_active = (b >= 0) & (b < num_micro)
+            # at the last stage b == f, so this step's fresh boundary
+            # input serves its own backward; other stages read the
+            # parked input of microbatch b
+            act_in_b = jnp.where(
+                is_last, act_in,
+                act_store[jnp.clip(b, 0, num_micro - 1) % buf_slots])
+            # recompute-forward VJP at the boundary input (remat)
+            act_out_b, vjp = jax.vjp(fwd, my_params, act_in_b)
+            # cotangent: last stage differentiates its own loss; others
+            # consume the cotangent ppermuted from stage+1 last step
+            loss_val, cot_last = jax.value_and_grad(loss_fn)(act_out_b)
+            cot_b = jnp.where(is_last,
+                              cot_last.astype(act_out_b.dtype),
+                              bwd_buf.astype(act_out_b.dtype))
+            g_params, g_act = vjp(cot_b)
+            gate = b_active.astype(jnp.float32)
+            grad_acc = jax.tree.map(
+                lambda acc, g: acc + gate * g.astype(acc.dtype),
+                grad_acc, g_params)
+            loss_acc = loss_acc + jnp.where(
+                is_last & b_active, loss_val.astype(jnp.float32), 0.0)
+
+            fwd_buf_next = lax.ppermute(act_out, axis, fwd_perm)
+            bwd_buf_next = lax.ppermute(
+                jnp.where(b_active, g_act, jnp.zeros_like(g_act)),
+                axis, bwd_perm)
+            return (fwd_buf_next, bwd_buf_next, act_store, grad_acc,
+                    loss_acc), ()
+
+        carry0 = (
+            jnp.zeros(mb_shape, mb_dtype),
+            jnp.zeros(mb_shape, jnp.float32),
+            jnp.zeros((buf_slots,) + mb_shape, mb_dtype),
+            jax.tree.map(
+                lambda v: jnp.zeros(v.shape[1:], jnp.float32), params_local),
+            jnp.float32(0.0),
+        )
+        (_, _, _, grad_acc, loss_acc), _ = lax.scan(
+            step, carry0, jnp.arange(steps))
+        # every stage's loss_acc is zero except the last; replicate it
+        loss = lax.psum(loss_acc, axis) / num_micro
+        # grads: each device holds its own stage's slice -> stack axis
+        grads = jax.tree.map(
+            lambda g: (g / num_micro)[None], grad_acc)
+        return loss, grads
+
+    out_grad_specs = jax.tree.map(
+        lambda v: P(axis, *([None] * (v.ndim - 1))), stacked_params)
+    return _shard_map(mesh)(
+        local,
+        in_specs=(param_specs, P(*([None] * x.ndim))),
+        out_specs=(P(), out_grad_specs),
+    )(stacked_params, x)

@@ -120,6 +120,82 @@ class TestPipeline:
         assert g["w"].shape == (2,)
         assert (jnp.abs(g["w"]) > 0).all()
 
+    def test_1f1b_matches_single_device_grads(self):
+        """1F1B over 4 stages reproduces plain autodiff's loss AND param
+        grads (VERDICT r4 item 7: microbatched 1F1B, gradient-correct)."""
+        from ray_tpu.parallel.pipeline import pipeline_1f1b
+
+        devs = np.array(jax.devices()[:4])
+        mesh = Mesh(devs, ("pp",))
+        rng = np.random.RandomState(0)
+        per_stage = [
+            {"w": jnp.asarray(rng.randn(8, 8), jnp.float32) * 0.5,
+             "b": jnp.asarray(rng.randn(8), jnp.float32) * 0.1}
+            for _ in range(4)]
+        stacked = stack_stage_params(per_stage)
+
+        def stage_fn(p, x):
+            return jnp.tanh(x @ p["w"] + p["b"])
+
+        def loss_fn(act):
+            return jnp.mean(act ** 2)
+
+        M = 6
+        x = jnp.asarray(rng.randn(M, 4, 8), jnp.float32)
+
+        loss, grads = jax.jit(
+            lambda sp, xx: pipeline_1f1b(
+                stage_fn, loss_fn, sp, xx, mesh=mesh))(stacked, x)
+
+        # single-device reference: sequential stages, mean loss over
+        # microbatches, autodiff end to end
+        def ref_loss(sp):
+            total = 0.0
+            for m in range(M):
+                h = x[m]
+                for s in range(4):
+                    p = jax.tree.map(lambda v: v[s], sp)
+                    h = stage_fn(p, h)
+                total = total + loss_fn(h)
+            return total / M
+
+        ref_l, ref_g = jax.value_and_grad(ref_loss)(stacked)
+        np.testing.assert_allclose(float(loss), float(ref_l), rtol=1e-5)
+        for k in ("w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(grads[k]), np.asarray(ref_g[k]),
+                rtol=1e-4, atol=1e-6)
+
+    def test_1f1b_bounded_activation_store(self):
+        """The act store is 2*S slots — independent of microbatch count:
+        a 32-microbatch run must still be correct (slots are reused)."""
+        from ray_tpu.parallel.pipeline import pipeline_1f1b
+
+        devs = np.array(jax.devices()[:4])
+        mesh = Mesh(devs, ("pp",))
+        per_stage = [{"w": jnp.float32(0.9 + 0.05 * i)} for i in range(4)]
+        stacked = stack_stage_params(per_stage)
+
+        def stage_fn(p, x):
+            return x * p["w"]
+
+        def loss_fn(act):
+            return jnp.mean(act ** 2)
+
+        M = 32  # >> 2*S = 8 slots
+        x = jnp.linspace(0.1, 1.0, M * 4).reshape(M, 4).astype(jnp.float32)
+        loss, grads = pipeline_1f1b(
+            stage_fn, loss_fn, stacked, x, mesh=mesh)
+
+        def ref_loss(sp):
+            scale = sp["w"][0] * sp["w"][1] * sp["w"][2] * sp["w"][3]
+            return jnp.mean((x * scale) ** 2, axis=1).mean()
+
+        ref_l, ref_g = jax.value_and_grad(ref_loss)(stacked)
+        np.testing.assert_allclose(float(loss), float(ref_l), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(grads["w"]),
+                                   np.asarray(ref_g["w"]), rtol=1e-4)
+
     def test_pipelined_transformer_blocks_match_sequential(self):
         """4 blocks split 2x2 over pp must reproduce the sequential
         forward exactly (same params, same input)."""
