@@ -82,6 +82,11 @@ class Config:
     dashboard_port: int = 0
     # controller durable-state snapshot cadence (actors/PGs/jobs/KV)
     controller_snapshot_interval_ms: int = 500
+    # durable control-plane store target: "" = session-dir files; any
+    # external-storage URI (file://, mock://, s3://) puts snapshots+WAL
+    # in that backend so head-disk loss is recoverable
+    # (≈ src/ray/gcs/store_client/redis_store_client.h)
+    controller_store_uri: str = ""
     # ---- TPU ----
     tpu_chips_per_host: int = 0  # 0 = autodetect via jax
     tpu_topology: str = ""  # e.g. "v5p-64"; "" = autodetect

@@ -37,6 +37,13 @@ class ExternalStorage:
     def get(self, uri: str) -> bytes:
         raise NotImplementedError
 
+    def list_keys(self, prefix: str):
+        """(key, uri) pairs for stored objects whose key starts with
+        *prefix* — the discovery primitive control-plane recovery needs
+        (every real object store has a list op). Latest write per key
+        wins when a backend versions its objects."""
+        raise NotImplementedError
+
     def delete(self, uri: str) -> None:
         raise NotImplementedError
 
@@ -59,6 +66,15 @@ class FileSystemStorage(ExternalStorage):
     def get(self, uri: str) -> bytes:
         with open(uri[len("file://"):], "rb") as f:
             return f.read()
+
+    def list_keys(self, prefix: str):
+        try:
+            names = os.listdir(self._dir)
+        except OSError:
+            return []
+        return [(n, "file://" + os.path.join(self._dir, n))
+                for n in names
+                if n.startswith(prefix) and not n.endswith(".tmp")]
 
     def delete(self, uri: str) -> None:
         try:
@@ -88,12 +104,32 @@ class MockRemoteStorage(ExternalStorage):
         token = f"{key}-{uuid.uuid4().hex[:8]}"
         with open(os.path.join(self._dir, token), "wb") as f:
             f.write(data)
-        return "mock://" + token
+        uri = "mock://" + token
+        # durable key index (a real remote serves list from its own
+        # metadata; the fake needs one so a NEW process can discover
+        # keys after the writer died — the control-plane recovery path)
+        with open(os.path.join(self._dir, "_index"), "a") as f:
+            f.write(f"{key}\t{uri}\n")
+        return uri
 
     def get(self, uri: str) -> bytes:
         self.gets += 1
         with open(self._path(uri), "rb") as f:
             return f.read()
+
+    def list_keys(self, prefix: str):
+        out = {}
+        try:
+            with open(os.path.join(self._dir, "_index")) as f:
+                for line in f:
+                    key, _, uri = line.rstrip("\n").partition("\t")
+                    if key.startswith(prefix) and uri:
+                        out[key] = uri  # latest write per key wins
+        except OSError:
+            return []
+        # drop entries whose object was deleted
+        return [(k, u) for k, u in out.items()
+                if os.path.exists(self._path(u))]
 
     def delete(self, uri: str) -> None:
         self.deletes += 1
@@ -133,6 +169,23 @@ class S3Storage(ExternalStorage):
         out = self._client.get_object(Bucket=self._bucket,
                                       Key=self._key(uri))
         return out["Body"].read()
+
+    def list_keys(self, prefix: str):
+        full = (self._prefix + "/" + prefix).lstrip("/")
+        out = []
+        token = None
+        while True:
+            kw = {"Bucket": self._bucket, "Prefix": full}
+            if token:
+                kw["ContinuationToken"] = token
+            resp = self._client.list_objects_v2(**kw)
+            for obj in resp.get("Contents", []):
+                key = obj["Key"]
+                short = key[len(self._prefix) + 1:] if self._prefix else key
+                out.append((short, f"s3://{self._bucket}/{key}"))
+            if not resp.get("IsTruncated"):
+                return out
+            token = resp.get("NextContinuationToken")
 
     def delete(self, uri: str) -> None:
         try:

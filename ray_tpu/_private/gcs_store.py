@@ -1,0 +1,223 @@
+"""Pluggable control-plane persistence (≈ the reference's GCS store
+clients: `src/ray/gcs/store_client/redis_store_client.h` for the remote
+case, `gcs_init_data.h` for recovery composition).
+
+The controller persists two things: interval snapshots (full durable
+state, compaction) and a write-ahead log of registration/tombstone
+frames acked between snapshots. This module puts both behind one
+``ControlStore`` interface so the storage can be:
+
+- ``FileControlStore`` — fsynced files in the session dir (default;
+  single-disk, fast appends);
+- ``UriControlStore`` — any `external_storage.py` URI backend
+  (file://, mock://, s3://): every WAL frame is its own sequenced
+  object and snapshots are epoch-keyed objects, which is exactly the
+  one-write-per-op shape Redis gives the reference's GCS — and means
+  head-node loss no longer loses the control plane.
+
+Keys are unique-write (``snap.<epoch>``, ``wal.<epoch>.<seq>``), so no
+backend needs overwrite or native append; recovery lists by prefix and
+takes the newest snapshot plus every frame of newer epochs.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+from ray_tpu._private import external_storage
+
+_LEN = 4  # file-WAL frame header bytes
+
+
+class ControlStore:
+    """Durable snapshot + WAL storage for the controller."""
+
+    def write_snapshot(self, epoch: int, blob: bytes) -> None:
+        raise NotImplementedError
+
+    def load_latest_snapshot(self) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def append_wal(self, epoch: int, frame: bytes) -> None:
+        """Durable before return (the ack-implies-durability contract)."""
+        raise NotImplementedError
+
+    def read_wal(self, epoch: int) -> List[bytes]:
+        raise NotImplementedError
+
+    def sweep_wals(self, max_epoch: int) -> None:
+        raise NotImplementedError
+
+    def sweep_snapshots(self, keep_epoch: int) -> None:
+        pass
+
+
+class FileControlStore(ControlStore):
+    """Session-dir files: one fsynced snapshot file per epoch (atomic
+    tmp-then-replace) and one append-only fsynced WAL file per epoch.
+    A torn WAL tail — crash mid-append — ends the replay cleanly."""
+
+    def __init__(self, base_dir: str):
+        self._dir = base_dir
+        os.makedirs(base_dir, exist_ok=True)
+
+    def _snap_path(self, epoch: int) -> str:
+        return os.path.join(self._dir, f"snap.{epoch:012d}")
+
+    def _wal_path(self, epoch: int) -> str:
+        return os.path.join(self._dir, f"wal.{epoch:012d}")
+
+    def write_snapshot(self, epoch: int, blob: bytes) -> None:
+        path = self._snap_path(epoch)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def _snap_epochs(self) -> List[int]:
+        out = []
+        try:
+            names = os.listdir(self._dir)
+        except OSError:
+            return out
+        for n in names:
+            if n.startswith("snap.") and not n.endswith(".tmp"):
+                try:
+                    out.append(int(n[len("snap."):]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def load_latest_snapshot(self) -> Optional[bytes]:
+        for epoch in reversed(self._snap_epochs()):
+            try:
+                with open(self._snap_path(epoch), "rb") as f:
+                    return f.read()
+            except OSError:
+                continue
+        return None
+
+    def append_wal(self, epoch: int, frame: bytes) -> None:
+        with open(self._wal_path(epoch), "ab") as f:
+            f.write(len(frame).to_bytes(_LEN, "big") + frame)
+            f.flush()
+            os.fsync(f.fileno())
+
+    def read_wal(self, epoch: int) -> List[bytes]:
+        try:
+            with open(self._wal_path(epoch), "rb") as f:
+                data = f.read()
+        except OSError:
+            return []
+        frames, off = [], 0
+        while off + _LEN <= len(data):
+            n = int.from_bytes(data[off:off + _LEN], "big")
+            if off + _LEN + n > len(data):
+                break  # torn tail
+            frames.append(data[off + _LEN:off + _LEN + n])
+            off += _LEN + n
+        return frames
+
+    def sweep_wals(self, max_epoch: int) -> None:
+        try:
+            names = os.listdir(self._dir)
+        except OSError:
+            return
+        for n in names:
+            if n.startswith("wal."):
+                try:
+                    if int(n[len("wal."):]) <= max_epoch:
+                        os.unlink(os.path.join(self._dir, n))
+                except (ValueError, OSError):
+                    continue
+
+    def sweep_snapshots(self, keep_epoch: int) -> None:
+        for epoch in self._snap_epochs():
+            if epoch < keep_epoch:
+                try:
+                    os.unlink(self._snap_path(epoch))
+                except OSError:
+                    pass
+
+
+class UriControlStore(ControlStore):
+    """Control plane on an external (possibly remote) object store.
+
+    One object per WAL frame (``wal.<epoch>.<seq>``) — the Redis write
+    shape — and one object per snapshot epoch. Requires the backend to
+    support ``list_keys`` (all real object stores do)."""
+
+    def __init__(self, backend: external_storage.ExternalStorage):
+        self._backend = backend
+        self._seq: Optional[int] = None  # lazily seeded per epoch
+        self._seq_epoch: Optional[int] = None
+        # key -> uri memo so reads skip a list round-trip when possible
+        self._uris: dict = {}
+
+    def _put(self, key: str, blob: bytes) -> None:
+        self._uris[key] = self._backend.put(key, blob)
+
+    def _list(self, prefix: str) -> List[Tuple[str, str]]:
+        return sorted(self._backend.list_keys(prefix))
+
+    def write_snapshot(self, epoch: int, blob: bytes) -> None:
+        self._put(f"snap.{epoch:012d}", blob)
+
+    def load_latest_snapshot(self) -> Optional[bytes]:
+        entries = self._list("snap.")
+        for key, uri in reversed(entries):
+            try:
+                return self._backend.get(uri)
+            except Exception:
+                continue
+        return None
+
+    def append_wal(self, epoch: int, frame: bytes) -> None:
+        if self._seq is None or self._seq_epoch != epoch:
+            # resume past any frames a previous incarnation wrote to
+            # this epoch (crash after snapshot, appends, crash again):
+            # starting at 1 would overwrite them
+            existing = self._list(f"wal.{epoch:012d}.")
+            self._seq = max(
+                (int(k.split(".")[2]) for k, _ in existing), default=0)
+            self._seq_epoch = epoch
+        self._seq += 1
+        self._put(f"wal.{epoch:012d}.{self._seq:012d}", frame)
+
+    def read_wal(self, epoch: int) -> List[bytes]:
+        out = []
+        for key, uri in self._list(f"wal.{epoch:012d}."):
+            try:
+                out.append(self._backend.get(uri))
+            except Exception:
+                break  # a torn/missing frame ends the replay, like a file
+        return out
+
+    def sweep_wals(self, max_epoch: int) -> None:
+        for key, uri in self._list("wal."):
+            try:
+                if int(key.split(".")[1]) <= max_epoch:
+                    self._backend.delete(uri)
+            except (ValueError, IndexError):
+                continue
+
+    def sweep_snapshots(self, keep_epoch: int) -> None:
+        for key, uri in self._list("snap."):
+            try:
+                if int(key.split(".", 1)[1]) < keep_epoch:
+                    self._backend.delete(uri)
+            except (ValueError, IndexError):
+                continue
+
+
+def control_store_for(target: str, default_dir: str) -> ControlStore:
+    """Build the controller's store: empty target -> session-dir files;
+    any external-storage URI -> that backend (config flag
+    ``controller_store_uri``, ref `redis_store_client.h`)."""
+    if not target:
+        return FileControlStore(default_dir)
+    return UriControlStore(
+        external_storage.storage_from_spill_target(target, default_dir))
