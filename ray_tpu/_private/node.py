@@ -60,7 +60,12 @@ def _wait_for_address_file(path: str, timeout: float = 30.0) -> Address:
 def new_session_dir() -> str:
     base = os.path.join(tempfile.gettempdir(), "ray_tpu")
     os.makedirs(base, exist_ok=True)
-    session = os.path.join(base, f"session_{int(time.time())}_{os.getpid()}")
+    # ns resolution: two inits in the same second (fast test cycles) must
+    # NOT share a dir — a stale controller_address file from the earlier
+    # session would short-circuit _wait_for_address_file and hand the new
+    # driver a dead controller's port
+    session = os.path.join(base,
+                           f"session_{time.time_ns()}_{os.getpid()}")
     os.makedirs(os.path.join(session, "logs"), exist_ok=True)
     return session
 

@@ -111,7 +111,8 @@ def resolve_runtime_env(env: Optional[Dict[str, Any]], core) -> Optional[Dict[st
 
 
 class WorkerEnvSpec:
-    """What _spawn_worker needs: interpreter, cwd, extra env."""
+    """What _spawn_worker needs: interpreter, cwd, extra env, and (for
+    container envs) how to wrap the worker command in an engine run."""
 
     def __init__(self, python: str = sys.executable,
                  cwd: Optional[str] = None,
@@ -119,6 +120,29 @@ class WorkerEnvSpec:
         self.python = python
         self.cwd = cwd
         self.env_vars = env_vars or {}
+        # set for container runtime envs: {"engine","image","run_options"}
+        self.container: Optional[Dict[str, Any]] = None
+
+    def wrap_command(self, cmd: List[str], env: Dict[str, str],
+                     mounts: List[str]) -> List[str]:
+        """Wrap the worker argv in an engine invocation (ref
+        `python/ray/_private/runtime_env/container.py` worker-command
+        injection). Host networking + IPC so the worker reaches the
+        supervisor/controller sockets and maps the /dev/shm arena; env
+        is forwarded explicitly (containers do not inherit)."""
+        if not self.container:
+            return cmd
+        argv = [self.container["engine"], "run", "--rm",
+                "--network=host", "--ipc=host"]
+        for m in mounts:
+            argv += ["-v", f"{m}:{m}"]
+        if self.cwd:
+            argv += ["--workdir", self.cwd]
+        for k, v in env.items():
+            argv += ["--env", f"{k}={v}"]
+        argv += list(self.container.get("run_options") or [])
+        argv.append(self.container["image"])
+        return argv + cmd
 
 
 class RuntimeEnvManager:
@@ -156,12 +180,105 @@ class RuntimeEnvManager:
             staged = await self._ensure_package(uri)
             paths.append(staged)
         pip = runtime_env.get("pip")
+        conda = runtime_env.get("conda")
+        if pip and conda:
+            raise ValueError(
+                "runtime_env: 'pip' and 'conda' are mutually exclusive "
+                "(install pip packages inside the conda spec)")
         if pip:
             spec.python = await self._ensure_venv(pip)
+        if conda:
+            spec.python = await self._ensure_conda(conda)
+        container = runtime_env.get("container")
+        if container:
+            spec.container = self._container_spec(container)
         if paths:
             spec.env_vars["RAY_TPU_RUNTIME_ENV_PYTHONPATH"] = os.pathsep.join(
                 paths)
         return spec
+
+    async def _ensure_conda(self, conda) -> str:
+        """Conda env interpreter (ref
+        `python/ray/_private/runtime_env/conda.py`): a string names an
+        EXISTING env (or prefix path); a dict is an environment spec
+        created once per content hash under the session dir. Gated on a
+        conda binary (RAY_TPU_CONDA_EXE overrides discovery)."""
+        import shutil
+
+        conda_exe = os.environ.get("RAY_TPU_CONDA_EXE") or \
+            shutil.which("conda") or shutil.which("mamba")
+        if not conda_exe:
+            raise RuntimeError(
+                "runtime_env 'conda' requires a conda/mamba binary on "
+                "PATH (or RAY_TPU_CONDA_EXE); none found on this node")
+        if isinstance(conda, str):
+            # named env or explicit prefix path
+            if os.sep in conda:
+                prefix = conda
+            else:
+                base = (await self._run_out(
+                    [conda_exe, "info", "--base"])).strip()
+                prefix = os.path.join(base, "envs", conda)
+            python = os.path.join(prefix, "bin", "python")
+            if not os.path.exists(python):
+                raise RuntimeError(
+                    f"conda env {conda!r} has no interpreter at {python}")
+            return python
+        # dict spec -> content-addressed created env
+        import json
+
+        key = "conda_" + hashlib.sha256(
+            json.dumps(conda, sort_keys=True).encode()).hexdigest()[:16]
+        async with self._lock(key):
+            ready = self._ready.get(key)
+            if ready:
+                return ready
+            prefix = os.path.join(self._root, key)
+            python = os.path.join(prefix, "bin", "python")
+            if not os.path.exists(python):
+                spec_path = os.path.join(self._root, key + ".yml")
+                with open(spec_path, "w") as f:
+                    f.write(_conda_spec_yaml(conda))
+                await self._run_cmd([conda_exe, "env", "create", "-y",
+                                     "-p", prefix, "-f", spec_path])
+                if not os.path.exists(python):
+                    raise RuntimeError(
+                        f"conda env create produced no interpreter "
+                        f"at {python}")
+            self._ready[key] = python
+            return python
+
+    @staticmethod
+    def _container_spec(container) -> Dict[str, Any]:
+        """Validate + resolve the container engine (ref
+        `python/ray/_private/runtime_env/container.py`). Gated on a
+        podman/docker binary (RAY_TPU_CONTAINER_RUNTIME overrides)."""
+        import shutil
+
+        if isinstance(container, str):
+            container = {"image": container}
+        image = container.get("image")
+        if not image:
+            raise ValueError("runtime_env 'container' needs an 'image'")
+        engine = os.environ.get("RAY_TPU_CONTAINER_RUNTIME") or \
+            shutil.which("podman") or shutil.which("docker")
+        if not engine:
+            raise RuntimeError(
+                "runtime_env 'container' requires podman or docker on "
+                "PATH (or RAY_TPU_CONTAINER_RUNTIME); none found")
+        return {"engine": engine, "image": image,
+                "run_options": list(container.get("run_options") or [])}
+
+    @staticmethod
+    async def _run_out(cmd: List[str]) -> str:
+        proc = await asyncio.create_subprocess_exec(
+            *cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        out, _ = await proc.communicate()
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"runtime_env command failed ({' '.join(cmd[:4])}): "
+                f"{out.decode(errors='replace')[-2000:]}")
+        return out.decode(errors="replace")
 
     async def _ensure_package(self, uri: str) -> str:
         async with self._lock(uri):
@@ -234,14 +351,44 @@ class RuntimeEnvManager:
                 f"{out.decode(errors='replace')[-2000:]}")
 
 
+def _conda_spec_yaml(spec: Dict[str, Any]) -> str:
+    """Minimal YAML emitter for conda environment specs (name,
+    channels, dependencies incl. nested pip lists) — avoids a yaml
+    dependency for the one shape `conda env create -f` accepts."""
+    lines = []
+    if spec.get("name"):
+        lines.append(f"name: {spec['name']}")
+    for key in ("channels", "dependencies"):
+        vals = spec.get(key)
+        if not vals:
+            continue
+        lines.append(f"{key}:")
+        for v in vals:
+            if isinstance(v, dict):  # {"pip": [...]}
+                for k2, sub in v.items():
+                    lines.append(f"  - {k2}:")
+                    for s in sub:
+                        lines.append(f"      - {s}")
+            else:
+                lines.append(f"  - {v}")
+    return "\n".join(lines) + "\n"
+
+
 def runtime_env_cache_key(runtime_env: Optional[Dict[str, Any]]) -> tuple:
     """The parts of a runtime env that make worker processes
     non-interchangeable (used in the supervisor's worker-pool env key)."""
     if not runtime_env:
         return ()
+    conda = runtime_env.get("conda")
+    container = runtime_env.get("container")
+    if isinstance(container, str):
+        container = {"image": container}
     return (
         runtime_env.get("working_dir") or "",
         tuple(runtime_env.get("py_modules") or ()),
         tuple(sorted(runtime_env.get("pip") or ())),
         tuple(sorted((runtime_env.get("env_vars") or {}).items())),
+        repr(conda) if conda else "",
+        (container.get("image"),
+         tuple(container.get("run_options") or ())) if container else (),
     )

@@ -26,6 +26,7 @@ import logging
 import os
 import subprocess
 import sys
+import tempfile
 import time
 from collections import deque
 from typing import Any, Deque, Dict, List, Optional, Set, Tuple
@@ -687,6 +688,12 @@ class Supervisor:
             env["PYTHONPATH"] = (
                 env["PYTHONPATH"] + os.pathsep + pkg_root
                 if env.get("PYTHONPATH") else pkg_root)
+        if env_spec.container:
+            # wrap in an engine run: host net/IPC, session dir + package
+            # root + /dev/shm mounted, env forwarded explicitly
+            cmd = env_spec.wrap_command(
+                cmd, env, mounts=[self.session_dir, pkg_root, "/dev/shm",
+                                  tempfile.gettempdir()])
         proc = subprocess.Popen(cmd, env=env, stdout=out, stderr=err,
                                 cwd=env_spec.cwd)
         out.close()  # child holds its own duplicates; keeping ours leaks fds
