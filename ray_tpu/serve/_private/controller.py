@@ -32,10 +32,16 @@ class _ReplicaHolder:
     probing them with the steady-state timeout would replace them forever."""
 
     INIT_TIMEOUT_S = 120.0
+    # consecutive missed probes before a READY replica is replaced
+    # (≈ the reference's health_check_failure_threshold): one missed
+    # 5s probe is routine for a replica busy jit-compiling a new batch
+    # shape — killing it then turns every cold shape into an outage
+    HEALTH_FAIL_THRESHOLD = 3
 
     def __init__(self, handle):
         self.handle = handle
         self.created_at = time.time()
+        self.health_failures = 0
         self.ready = False
 
 
@@ -167,20 +173,32 @@ class ServeController:
                 ok = await asyncio.wait_for(
                     holder.handle.check_health.remote(), timeout=5)
                 if ok:
+                    holder.health_failures = 0
                     if not holder.ready:
                         holder.ready = True
                         st.version += 1  # routers learn of the new replica
                         self._notify_change()
                 elif holder.ready or self._init_expired(holder):
+                    # the replica RESPONDED unhealthy: no benefit of the
+                    # doubt — it told us itself
                     logger.warning(
                         "replica of %s reported unhealthy; replacing", st.name)
                     dead.append(holder)
-            except Exception:
+            except Exception as e:
+                from ray_tpu._private.exceptions import ActorDiedError
+
                 if holder.ready:
-                    logger.warning(
-                        "replica of %s failed health check; replacing",
-                        st.name)
-                    dead.append(holder)
+                    holder.health_failures += 1
+                    if isinstance(e, ActorDiedError) or \
+                            holder.health_failures >= \
+                            holder.HEALTH_FAIL_THRESHOLD:
+                        # a dead actor is replaced immediately; a slow
+                        # probe needs the full consecutive-miss budget
+                        logger.warning(
+                            "replica of %s failed health check (%d "
+                            "consecutive, %s); replacing", st.name,
+                            holder.health_failures, type(e).__name__)
+                        dead.append(holder)
                 elif self._init_expired(holder):
                     logger.warning(
                         "replica of %s never became ready in %.0fs; replacing",

@@ -281,6 +281,35 @@ class TestStreaming:
                        route_prefix="/ngen2")
         assert list(h2.options(stream=True).remote(3)) == [0, 1, 2]
 
+    def test_busy_replica_survives_missed_health_probes(self,
+                                                        serve_shutdown):
+        """A replica that blocks its loop longer than one probe timeout
+        (e.g. jit-compiling a new batch shape) must NOT be replaced —
+        replacement needs HEALTH_FAIL_THRESHOLD consecutive misses.
+        Regression: one missed 5s probe used to kill the replica and
+        fail every in-flight request with ActorDiedError."""
+        import time as _time
+
+        @serve.deployment
+        class Slow:
+            def __call__(self, seconds):
+                import os as _os
+                import time as _t
+
+                # synchronous sleep BLOCKS the replica loop: health
+                # probes time out while this runs
+                _t.sleep(seconds)
+                return _os.getpid()
+
+        h = serve.run(Slow.bind(), name="slowhp", route_prefix="/slowhp")
+        pid_before = h.remote(0).result(timeout=30)
+        # block for ~1.5 probe timeouts; the sweep (0.5s period, 5s
+        # probe timeout) misses at least once during this window
+        pid_during = h.remote(7).result(timeout=60)
+        assert pid_during == pid_before, \
+            "replica was replaced during a single blocked probe window"
+        assert h.remote(0).result(timeout=30) == pid_before
+
     def test_router_failure_mark_skews_pick(self):
         """A replica with a recent request failure (unary or stream
         terminal error — advisor r4) loses every pow-2 draw until the
