@@ -1179,9 +1179,17 @@ class CoreWorker:
             entry.size = len(packed)
         else:
             sup = self.clients.get(self.supervisor_addr)
-            r = await sup.call("store_create", {"object_id": oid.binary(), "size": len(packed)})
-            self.arena.write(r["offset"], packed)
-            await sup.call("store_seal", {"object_id": oid.binary()})
+            # 600s: creating a GiB-class object can sit behind another
+            # object's multi-GB spill on the store thread
+            r = await sup.call("store_create",
+                               {"object_id": oid.binary(),
+                                "size": len(packed)}, timeout=600)
+            loop = asyncio.get_running_loop()
+            # multi-GB memcpy into the arena: keep it off the event loop
+            await loop.run_in_executor(
+                None, self.arena.write, r["offset"], packed)
+            await sup.call("store_seal", {"object_id": oid.binary()},
+                           timeout=600)
             entry.state = SHARED
             entry.size = len(packed)
             entry.location = self.supervisor_addr
@@ -1390,7 +1398,10 @@ class CoreWorker:
             )
         # pin so the range cannot be spilled/recycled between the locate reply
         # and our copy out of the mmap
-        loc = await sup.call("store_locate", {"object_id": oid.binary(), "pin": True})
+        # 600s: locate may RESTORE a spilled GiB-class object first
+        loc = await sup.call("store_locate",
+                             {"object_id": oid.binary(), "pin": True},
+                             timeout=600)
         if loc is None:
             raise ObjectLostError(oid.hex(), "not in local store")
         if self.arena is not None and self.supervisor_addr is not None:

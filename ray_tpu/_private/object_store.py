@@ -234,14 +234,11 @@ class NodeObjectStore:
             if meta.state != CREATING:
                 raise ValueError(f"object {object_id.hex()} already exists")
             return meta.offset
-        offset = self._alloc.alloc(size)
+        offset = self._alloc_with_spill(size)
         if offset is None:
-            self._make_room(size)
-            offset = self._alloc.alloc(size)
-            if offset is None:
-                raise OutOfMemoryError(
-                    f"object store full: need {size}, free {self._alloc.free_bytes()}"
-                )
+            raise OutOfMemoryError(
+                f"object store full: need {size}, free {self._alloc.free_bytes()}"
+            )
         self._objects[object_id] = ObjectMeta(
             object_id, size, CREATING, offset, last_access=time.monotonic()
         )
@@ -315,8 +312,17 @@ class NodeObjectStore:
         elif meta.offset >= 0:
             self._alloc.free(meta.offset, meta.size)
 
-    def _make_room(self, need: int) -> None:
-        """Spill least-recently-used sealed objects until `need` fits."""
+    def _alloc_with_spill(self, need: int) -> Optional[int]:
+        """Allocate `need` bytes, spilling least-recently-used sealed
+        objects as required. Retries the allocation as objects spill:
+        total free bytes are NOT enough — the allocator needs one
+        CONTIGUOUS range, and a GiB-class restore into an arena dotted
+        with small live objects only succeeds once the spills have
+        coalesced a large-enough hole (the fragmentation case the old
+        free_bytes()-threshold check missed)."""
+        offset = self._alloc.alloc(need)
+        if offset is not None:
+            return offset
         candidates = sorted(
             (
                 m
@@ -325,10 +331,14 @@ class NodeObjectStore:
             ),
             key=lambda m: m.last_access,
         )
+        aligned = _align(need)
         for meta in candidates:
-            if self._alloc.free_bytes() >= _align(need):
-                return
             self._spill(meta)
+            if self._alloc.free_bytes() >= aligned:
+                offset = self._alloc.alloc(need)
+                if offset is not None:
+                    return offset
+        return self._alloc.alloc(need)
 
     def _spill(self, meta: ObjectMeta) -> None:
         # pass the arena view straight through (bytes-like): spilling
@@ -343,12 +353,9 @@ class NodeObjectStore:
         self.num_spilled += 1
 
     def _restore(self, meta: ObjectMeta) -> None:
-        offset = self._alloc.alloc(meta.size)
+        offset = self._alloc_with_spill(meta.size)
         if offset is None:
-            self._make_room(meta.size)
-            offset = self._alloc.alloc(meta.size)
-            if offset is None:
-                raise OutOfMemoryError("cannot restore spilled object: store full")
+            raise OutOfMemoryError("cannot restore spilled object: store full")
         self.arena.write(offset, self.spill_storage.get(meta.spill_path))
         self.spill_storage.delete(meta.spill_path)
         meta.offset = offset
@@ -357,8 +364,11 @@ class NodeObjectStore:
         self.num_restored += 1
 
     def stats(self) -> Dict[str, float]:
-        in_mem = sum(1 for m in self._objects.values() if m.state == IN_MEMORY)
-        spilled = sum(1 for m in self._objects.values() if m.state == SPILLED)
+        # snapshot first: stats() is read from metric/sync paths off the
+        # store thread; iterating the live dict would race its mutations
+        metas = list(self._objects.values())
+        in_mem = sum(1 for m in metas if m.state == IN_MEMORY)
+        spilled = sum(1 for m in metas if m.state == SPILLED)
         return {
             "capacity": self.capacity,
             "free_bytes": self._alloc.free_bytes(),

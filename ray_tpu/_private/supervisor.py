@@ -149,6 +149,13 @@ class Supervisor:
             spill_storage=storage_from_spill_target(
                 config.object_spilling_uri, spill_dir),
         )
+        # ALL store access rides this one thread (see _store_op): long
+        # spills/restores must not block the RPC loop, and one worker
+        # keeps the (non-thread-safe) store serialized
+        import concurrent.futures
+
+        self._store_exec = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="store")
         # worker pool
         self.workers: Dict[str, WorkerHandle] = {}
         self.idle: Dict[str, Deque[WorkerHandle]] = {}  # env_key -> idle workers
@@ -1045,38 +1052,54 @@ class Supervisor:
 
     # ------------------------------------------------------------- object store
 
+    async def _store_op(self, fn, *args):
+        """Run a store mutation on the dedicated single store thread.
+        Spill/restore of a GiB-class object is a long synchronous disk
+        copy — executed inline it wedges the whole supervisor loop and
+        every concurrent RPC times out (scale-envelope failure mode).
+        One worker thread = store ops stay mutually serialized (the
+        store is not thread-safe) while the loop keeps serving."""
+        return await asyncio.get_running_loop().run_in_executor(
+            self._store_exec, fn, *args)
+
     async def rpc_store_create(self, body) -> dict:
         oid = ObjectID(body["object_id"])
-        offset = self.store.create(oid, body["size"])
+        offset = await self._store_op(self.store.create, oid, body["size"])
         return {"offset": offset}
 
     async def rpc_store_seal(self, body) -> None:
-        self.store.seal(ObjectID(body["object_id"]))
+        await self._store_op(self.store.seal, ObjectID(body["object_id"]))
 
     async def rpc_store_abort(self, body) -> None:
-        self.store.abort(ObjectID(body["object_id"]))
+        await self._store_op(self.store.abort, ObjectID(body["object_id"]))
 
     async def rpc_store_locate(self, body):
-        loc = self.store.locate(ObjectID(body["object_id"]), pin=body.get("pin", False))
+        loc = await self._store_op(
+            lambda: self.store.locate(ObjectID(body["object_id"]),
+                                      pin=body.get("pin", False)))
         return None if loc is None else {"offset": loc[0], "size": loc[1]}
 
     async def rpc_store_unpin(self, body) -> None:
-        self.store.unpin(ObjectID(body["object_id"]))
+        await self._store_op(self.store.unpin, ObjectID(body["object_id"]))
 
     async def rpc_store_contains(self, body) -> bool:
-        return self.store.contains(ObjectID(body["object_id"]))
+        return await self._store_op(
+            self.store.contains, ObjectID(body["object_id"]))
 
     async def rpc_store_free(self, body) -> None:
-        for raw in body["object_ids"]:
-            self.store.free(ObjectID(raw))
+        def free_all():
+            for raw in body["object_ids"]:
+                self.store.free(ObjectID(raw))
+
+        await self._store_op(free_all)
 
     async def rpc_store_read_chunk(self, body) -> bytes:
-        return self.store.read_chunk(
-            ObjectID(body["object_id"]), body["offset"], body["length"]
-        )
+        return await self._store_op(
+            self.store.read_chunk, ObjectID(body["object_id"]),
+            body["offset"], body["length"])
 
     async def rpc_store_stats(self, body=None) -> dict:
-        return self.store.stats()
+        return await self._store_op(self.store.stats)
 
     async def rpc_pull_object(self, body) -> dict:
         """Fetch an object from a remote node into the local store.
@@ -1084,8 +1107,8 @@ class Supervisor:
         ≈ PullManager (object_manager/pull_manager.cc): chunked, deduped.
         """
         oid = ObjectID(body["object_id"])
-        if self.store.contains(oid):
-            loc = self.store.locate(oid)
+        if await self._store_op(self.store.contains, oid):
+            loc = await self._store_op(self.store.locate, oid)
             return {"offset": loc[0], "size": loc[1]}
         pending = self._pulls_in_flight.get(oid)
         if pending is not None:
@@ -1105,7 +1128,7 @@ class Supervisor:
                 fut.cancel()
 
     async def _do_pull(self, oid: ObjectID, source: Address, size: int) -> dict:
-        offset = self.store.create(oid, size)
+        offset = await self._store_op(self.store.create, oid, size)
         src = self.clients.get(source)
         chunk = self.config.object_transfer_chunk_bytes
         pinned = False
@@ -1124,10 +1147,11 @@ class Supervisor:
                     {"object_id": oid.binary(), "offset": pos, "length": chunk},
                     timeout=60,
                 )
-                self.store.arena.write(offset + pos, data)
+                await self._store_op(self.store.arena.write,
+                                     offset + pos, data)
                 pos += len(data)
         except Exception:
-            self.store.abort(oid)
+            await self._store_op(self.store.abort, oid)
             raise
         finally:
             if pinned:
@@ -1135,7 +1159,7 @@ class Supervisor:
                     await src.notify("store_unpin", {"object_id": oid.binary()})
                 except Exception:
                     pass
-        self.store.seal(oid)
+        await self._store_op(self.store.seal, oid)
         return {"offset": offset, "size": size}
 
 

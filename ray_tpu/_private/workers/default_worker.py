@@ -447,9 +447,14 @@ class Executor:
 
     async def _store_shared(self, oid: ObjectID, packed: bytes) -> None:
         sup = self.core.clients.get(self.core.supervisor_addr)
-        r = await sup.call("store_create", {"object_id": oid.binary(), "size": len(packed)})
+        # 600s: a GiB-class create can queue behind another object's
+        # spill on the supervisor's store thread
+        r = await sup.call("store_create", {"object_id": oid.binary(),
+                                            "size": len(packed)},
+                           timeout=600)
         self.core.arena.write(r["offset"], packed)
-        await sup.call("store_seal", {"object_id": oid.binary()})
+        await sup.call("store_seal", {"object_id": oid.binary()},
+                       timeout=600)
 
     def _report_error(self, spec: TaskSpec, err: Exception, retryable: bool) -> None:
         self._send_done(

@@ -13,6 +13,7 @@ from ray_tpu.data.dataset import (  # noqa: F401
     from_pandas,
     range,
     range_tensor,
+    read_bigquery,
     read_binary_files,
     read_csv,
     read_images,

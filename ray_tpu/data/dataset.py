@@ -554,9 +554,35 @@ def read_webdataset(paths, **_kw) -> Dataset:
                            datasource_name="webdataset")])
 
 
-def read_sql(sql: str, connection_factory, **_kw) -> Dataset:
-    """DBAPI-2 query -> dataset. ≈ `ray.data.read_sql`."""
+def read_sql(sql: str, connection_factory, *, partition_column=None,
+             lower_bound=None, upper_bound=None, parallelism: int = 1,
+             **_kw) -> Dataset:
+    """DBAPI-2 query -> dataset. ≈ `ray.data.read_sql`. With
+    `partition_column` + bounds the read fans out into `parallelism`
+    range-partitioned queries (warehouse parallel-read recipe)."""
     from ray_tpu.data.datasource import sql_tasks
 
-    return Dataset([L.Read(read_tasks=sql_tasks(sql, connection_factory),
-                           datasource_name="sql")])
+    return Dataset([L.Read(
+        read_tasks=sql_tasks(sql, connection_factory,
+                             partition_column=partition_column,
+                             lower_bound=lower_bound,
+                             upper_bound=upper_bound,
+                             parallelism=parallelism),
+        datasource_name="sql")])
+
+
+def read_bigquery(project_id: str, *, dataset: str = None, query: str = None,
+                  parallelism: int = 4, client_factory=None,
+                  **_kw) -> Dataset:
+    """Cloud-warehouse read (≈ `ray.data.read_bigquery`,
+    `python/ray/data/datasource/bigquery_datasource.py`): a query's
+    destination table (or a named table) read with one task per
+    row-range stream. `client_factory` injects the client (production
+    default: google.cloud.bigquery.Client, gated on the library)."""
+    from ray_tpu.data.datasource import bigquery_tasks
+
+    return Dataset([L.Read(
+        read_tasks=bigquery_tasks(project_id, dataset=dataset, query=query,
+                                  parallelism=parallelism,
+                                  client_factory=client_factory),
+        datasource_name="bigquery")])

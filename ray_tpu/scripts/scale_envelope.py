@@ -1,0 +1,195 @@
+"""Single-node scale envelope (VERDICT r4 weak #3).
+
+Pushes the control plane, arena, and codec to the reference's published
+single-node envelope (`release/benchmarks/README.md:25-31`: 10k task
+args, 3k returns, 10k-ref get, ~1M queued tasks, 100 GiB objects) at
+sizes that fit this host, and records the result as SCALE.json:
+
+    python -m ray_tpu.scripts.scale_envelope [--out SCALE.json]
+        [--queued 100000] [--big-gib 8]
+
+Every check reports value + elapsed + ok; a crash in any check is
+recorded, not fatal to the rest.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import platform
+import time
+from typing import Any, Dict, List
+
+import numpy as np
+
+
+def _check(results: List[Dict[str, Any]], name: str, unit: str):
+    def deco(fn):
+        def run(*a, **kw):
+            t0 = time.perf_counter()
+            try:
+                value = fn(*a, **kw)
+                results.append({
+                    "check": name, "value": value, "unit": unit,
+                    "elapsed_s": round(time.perf_counter() - t0, 2),
+                    "ok": True})
+            except Exception as e:  # record, keep going
+                results.append({
+                    "check": name, "value": None, "unit": unit,
+                    "elapsed_s": round(time.perf_counter() - t0, 2),
+                    "ok": False, "error": f"{type(e).__name__}: {e}"})
+        return run
+    return deco
+
+
+def run_envelope(queued: int, big_gib: float) -> List[Dict[str, Any]]:
+    import ray_tpu
+
+    results: List[Dict[str, Any]] = []
+
+    # ---- 10k object-ref args to ONE task (ref envelope: 10_000)
+    @_check(results, "args_10k_refs_one_task", "args")
+    def ten_k_args():
+        @ray_tpu.remote
+        def count(*xs):
+            return len(xs)
+
+        refs = [ray_tpu.put(i) for i in range(10_000)]
+        n = ray_tpu.get(count.remote(*refs), timeout=600)
+        assert n == 10_000, n
+        return n
+
+    ten_k_args()
+
+    # ---- 3k returns from ONE task (ref envelope: 3_000)
+    @_check(results, "returns_3k_one_task", "returns")
+    def three_k_returns():
+        @ray_tpu.remote(num_returns=3000)
+        def burst():
+            return tuple(range(3000))
+
+        refs = burst.remote()
+        assert len(refs) == 3000
+        vals = ray_tpu.get(refs, timeout=600)
+        assert vals[0] == 0 and vals[-1] == 2999
+        return len(vals)
+
+    three_k_returns()
+
+    # ---- one get() over 10k refs: 8k inline + 2k arena (>100KB) objects
+    @_check(results, "get_10k_refs", "refs")
+    def ten_k_get():
+        small = [ray_tpu.put(b"s" * 128) for _ in range(8000)]
+        big = [ray_tpu.put(np.full(64 * 1024, i % 251, np.uint8))
+               for i in range(2000)]  # 256KB: arena path
+        out = ray_tpu.get(small + big, timeout=600)
+        assert len(out) == 10_000
+        assert out[-1][0] == 1999 % 251
+        return len(out)
+
+    ten_k_get()
+
+    # ---- queued tasks: submit `queued` nops before draining
+    @_check(results, "queued_tasks", "tasks")
+    def queue_deep():
+        @ray_tpu.remote
+        def nop(i):
+            return i
+
+        t0 = time.perf_counter()
+        refs = [nop.remote(i) for i in range(queued)]
+        submit_dt = time.perf_counter() - t0
+        out = ray_tpu.get(refs[-1], timeout=1200)
+        assert out == queued - 1
+        # spot-check a stripe, then release
+        stripe = ray_tpu.get(refs[:: max(1, queued // 100)], timeout=1200)
+        assert stripe[0] == 0
+        results.append({
+            "check": "queued_tasks_submit_rate",
+            "value": round(queued / submit_dt), "unit": "tasks/s",
+            "elapsed_s": round(submit_dt, 2), "ok": True})
+        return queued
+
+    queue_deep()
+    return results
+
+
+def run_big_object(big_gib: float) -> List[Dict[str, Any]]:
+    """Own session: a GiB-class spill must not contend with the 100k-task
+    check's teardown chatter (and a wedge here must not poison it)."""
+    import ray_tpu
+
+    results: List[Dict[str, Any]] = []
+
+    # ---- GiB-class single object through the arena, then spill + restore
+    @_check(results, "big_object_gib", "GiB")
+    def big_object():
+        n = int(big_gib * 1024 ** 3)
+        arr = np.frombuffer(np.random.bytes(16 * 1024 * 1024), np.uint8)
+        big = np.tile(arr, n // arr.size + 1)[:n]
+        ref = ray_tpu.put(big)
+        out = ray_tpu.get(ref, timeout=1200)
+        assert out.nbytes == n
+        assert np.array_equal(out[:1024], big[:1024])
+        assert np.array_equal(out[-1024:], big[-1024:])
+        del out
+        # force the big object out of the arena (LRU spill), then read it
+        # back through the restore path
+        filler = [ray_tpu.put(np.random.bytes(32 * 1024 * 1024))
+                  for _ in range(int(big_gib * 1024 / 32) + 8)]
+        out2 = ray_tpu.get(ref, timeout=1200)
+        assert out2.nbytes == n and np.array_equal(out2[:1024], big[:1024])
+        del filler, out2
+        return round(n / 1024 ** 3, 2)
+
+    big_object()
+    return results
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description="single-node scale envelope")
+    parser.add_argument("--out", default="SCALE.json")
+    parser.add_argument("--queued", type=int, default=100_000)
+    parser.add_argument("--big-gib", type=float, default=8.0)
+    parser.add_argument("--num-cpus", type=int, default=8)
+    args = parser.parse_args(argv)
+
+    import ray_tpu
+
+    t0 = time.time()
+    ray_tpu.init(num_cpus=args.num_cpus,
+                 object_store_memory=2 * 1024 ** 3)
+    try:
+        results = run_envelope(args.queued, args.big_gib)
+    finally:
+        ray_tpu.shutdown()
+    # arena sized for the big object plus spill headroom
+    arena = int(args.big_gib * 1.5 * 1024 ** 3)
+    ray_tpu.init(num_cpus=args.num_cpus, object_store_memory=arena)
+    try:
+        results += run_big_object(args.big_gib)
+    finally:
+        ray_tpu.shutdown()
+    doc = {
+        "suite": "single_node_scale_envelope",
+        "reference": "release/benchmarks/README.md:25-31",
+        "host": {"cpus": __import__("os").cpu_count(),
+                 "platform": platform.platform()},
+        "elapsed_s": round(time.time() - t0, 1),
+        "checks": results,
+        "all_ok": all(r["ok"] for r in results),
+    }
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    print(json.dumps({"all_ok": doc["all_ok"],
+                      "checks": len(results), "out": args.out}))
+    for r in results:
+        print(f"  {r['check']:<28} "
+              f"{'ok' if r['ok'] else 'FAIL':<5} {r.get('value')} "
+              f"{r['unit']} in {r['elapsed_s']}s"
+              + ("" if r["ok"] else f"  [{r.get('error', '')[:120]}]"))
+
+
+if __name__ == "__main__":
+    main()

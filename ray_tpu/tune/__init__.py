@@ -31,6 +31,10 @@ from ray_tpu.tune.search import (  # noqa: F401
     sample_from,
     uniform,
 )
+from ray_tpu.tune.search_external import (  # noqa: F401
+    AskTellSearcher,
+    OptunaSearcher,
+)
 from ray_tpu.tune.tuner import (  # noqa: F401
     ResultGrid,
     TuneConfig,

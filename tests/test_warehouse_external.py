@@ -1,0 +1,250 @@
+"""Warehouse datasource + external searcher plug surface (VERDICT r4
+item 10; refs `python/ray/data/datasource/bigquery_datasource.py`,
+`python/ray/tune/search/optuna/optuna_search.py`).
+
+Neither google-cloud-bigquery nor optuna ship in this image, so the
+tests drive the exact client surfaces through fakes — proving the
+framework-side glue (stream fan-out, query-job handling, ask/tell
+bookkeeping, domain translation), which is what this repo owns."""
+
+import sqlite3
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.data import read_bigquery, read_sql
+from ray_tpu.tune.search_external import AskTellSearcher
+
+
+# --------------------------------------------------------------- bigquery
+
+
+class FakeRowIterator:
+    def __init__(self, table, start, maxr):
+        self.rows = table.rows[start:start + maxr]
+
+    def to_arrow(self):
+        if not self.rows:
+            return pa.table({})
+        return pa.table({
+            "id": [r[0] for r in self.rows],
+            "value": [r[1] for r in self.rows]})
+
+
+class FakeTable:
+    def __init__(self, rows):
+        self.rows = rows
+        self.num_rows = len(rows)
+
+
+class FakeQueryJob:
+    def __init__(self, client, sql):
+        self.client = client
+        self.sql = sql
+        self.destination = "_anon_dest"
+
+    def result(self):
+        self.client.tables["_anon_dest"] = FakeTable(
+            [(i, i * 10) for i in range(37)])
+        return self
+
+
+class FakeBQClient:
+    """Honors the call surface bigquery_tasks drives: query().result(),
+    get_table().num_rows, list_rows(start_index, max_results).to_arrow."""
+
+    def __init__(self):
+        self.tables = {"ds.events": FakeTable(
+            [(i, i * 2) for i in range(23)])}
+        self.list_calls = []
+
+    def query(self, sql):
+        return FakeQueryJob(self, sql)
+
+    def get_table(self, name):
+        return self.tables[name]
+
+    def list_rows(self, name, start_index=0, max_results=None):
+        self.list_calls.append((start_index, max_results))
+        return FakeRowIterator(self.tables[name], start_index, max_results)
+
+
+class TestBigQuery:
+    def test_table_read_parallel_streams(self, ray_init):
+        ds = read_bigquery("proj", dataset="ds.events", parallelism=4,
+                           client_factory=FakeBQClient)
+        rows = ds.take_all()
+        assert len(rows) == 23
+        assert sorted(r["id"] for r in rows) == list(range(23))
+        assert all(r["value"] == r["id"] * 2 for r in rows)
+
+    def test_query_reads_destination_table(self, ray_init):
+        ds = read_bigquery("proj", query="SELECT * FROM x",
+                           parallelism=3, client_factory=FakeBQClient)
+        rows = ds.take_all()
+        assert len(rows) == 37
+        assert sorted(r["value"] for r in rows) == [i * 10
+                                                    for i in range(37)]
+
+    def test_exactly_one_of_dataset_query(self):
+        with pytest.raises(ValueError, match="exactly one"):
+            read_bigquery("proj")
+        with pytest.raises(ValueError, match="exactly one"):
+            read_bigquery("proj", dataset="a.b", query="SELECT 1")
+
+    def test_default_client_path_is_gated(self, ray_init):
+        """Without an injected client the default path builds a real
+        bigquery.Client: in this image the library resolves but ADC
+        credentials don't — either failure mode must surface clearly,
+        never hang or return empty data."""
+        ds = read_bigquery("proj", dataset="ds.events")
+        with pytest.raises(Exception,
+                           match="google-cloud-bigquery|credentials"):
+            ds.take_all()
+
+
+# --------------------------------------------------------- partitioned sql
+
+
+class TestPartitionedSql:
+    def test_range_partitions_cover_exactly(self, ray_init, tmp_path):
+        db = str(tmp_path / "w.db")
+        conn = sqlite3.connect(db)
+        conn.execute("CREATE TABLE t (k INTEGER, v TEXT)")
+        conn.executemany("INSERT INTO t VALUES (?, ?)",
+                         [(i, f"row{i}") for i in range(100)])
+        conn.commit()
+        conn.close()
+
+        ds = read_sql("SELECT * FROM t", lambda: sqlite3.connect(db),
+                      partition_column="k", lower_bound=0, upper_bound=99,
+                      parallelism=4)
+        rows = ds.take_all()
+        assert len(rows) == 100  # no dupes, no gaps at the seams
+        assert sorted(r["k"] for r in rows) == list(range(100))
+
+    def test_partitioned_requires_bounds(self):
+        with pytest.raises(ValueError, match="lower_bound"):
+            read_sql("SELECT 1", lambda: None, partition_column="k",
+                     parallelism=2)
+
+
+# ------------------------------------------------------- external searcher
+
+
+class FakeAskTellOptimizer:
+    """Stands in for optuna/ax/nevergrad: proposes points, records
+    observations."""
+
+    def __init__(self, xs):
+        self.queue = list(xs)
+        self.told = []
+
+    def ask(self):
+        if not self.queue:
+            return None
+        x = self.queue.pop(0)
+        return ({"x": x}, {"x": x})  # (token, values)
+
+    def tell(self, token, value):
+        self.told.append((token["x"], value))
+
+
+class TestAskTellSearcher:
+    def test_drives_real_trials(self, ray_init):
+        opt = FakeAskTellOptimizer([0.1, 0.5, 0.9])
+        searcher = AskTellSearcher(opt.ask, opt.tell)
+
+        def objective(config):
+            tune.report({"score": 1.0 - (config["x"] - 0.5) ** 2})
+
+        tuner = tune.Tuner(
+            objective,
+            param_space={"x": tune.uniform(0, 1), "const": 7},
+            tune_config=tune.TuneConfig(metric="score", mode="max",
+                                        num_samples=3,
+                                        search_alg=searcher),
+        )
+        grid = tuner.fit()
+        assert len(grid) == 3
+        # every external proposal ran as a trial and was told its result
+        assert sorted(x for x, _ in opt.told) == [0.1, 0.5, 0.9]
+        for x, score in opt.told:
+            assert score == pytest.approx(1.0 - (x - 0.5) ** 2)
+        # constants pass through untouched
+        best = grid.get_best_result()
+        assert best.config["const"] == 7
+        assert best.config["x"] == 0.5
+
+    def test_unset_leaf_fails_loudly(self):
+        s = AskTellSearcher(lambda: ({"wrong": 1}, {"wrong": 1}),
+                            lambda *_: None)
+        s.set_objective("score", "max")
+        s.set_search_space({"x": tune.uniform(0, 1)})
+        with pytest.raises(KeyError, match="x"):
+            s.suggest("t1")
+
+    def test_optuna_domain_translation(self):
+        """The optuna searcher's domain translation + study driving,
+        through a fake study honoring ask(distributions)/tell."""
+        import sys
+        import types
+
+        # minimal fake optuna: distributions + the study surface
+        fake = types.ModuleType("optuna")
+        dists = types.SimpleNamespace(
+            FloatDistribution=lambda lo, hi, log=False, step=None: (
+                "float", lo, hi, log, step),
+            IntDistribution=lambda lo, hi: ("int", lo, hi),
+            CategoricalDistribution=lambda cats: ("cat", tuple(cats)),
+        )
+        fake.distributions = dists
+        fake.trial = types.SimpleNamespace(
+            TrialState=types.SimpleNamespace(FAIL="FAIL"))
+
+        class FakeTrial:
+            def __init__(self, params):
+                self.params = params
+
+        class FakeStudy:
+            def __init__(self):
+                self.told = []
+                self.i = 0
+
+            def ask(self, distributions):
+                self.i += 1
+                assert distributions["lr"][0] == "float"
+                assert distributions["lr"][3] is True  # log
+                assert distributions["layers"] == ("int", 1, 3)
+                assert distributions["act"][0] == "cat"
+                return FakeTrial({"lr": 10 ** -self.i, "layers": 2,
+                                  "act": "relu"})
+
+            def tell(self, trial, value, state=None):
+                self.told.append((trial.params["lr"], value, state))
+
+        study = FakeStudy()
+        fake.create_study = lambda direction: study
+        sys.modules["optuna"] = fake
+        try:
+            from ray_tpu.tune.search_external import OptunaSearcher
+
+            s = OptunaSearcher(study_factory=lambda direction: study)
+            s.set_objective("loss", "min")
+            s.set_search_space({
+                "lr": tune.loguniform(1e-5, 1e-1),
+                "layers": tune.randint(1, 4),
+                "act": tune.choice(["relu", "gelu"]),
+                "fixed": "adam",
+            })
+            cfg = s.suggest("t1")
+            assert cfg["lr"] == 0.1 and cfg["layers"] == 2
+            assert cfg["act"] == "relu" and cfg["fixed"] == "adam"
+            s.on_trial_complete("t1", {"loss": 0.25})
+            assert study.told == [(0.1, 0.25, None)] or \
+                study.told == [(0.1, 0.25)]
+        finally:
+            del sys.modules["optuna"]
