@@ -101,6 +101,7 @@ def start_supervisor(
     controller_addr: Address,
     resources: Optional[Dict[str, float]] = None,
     node_name: str = "",
+    labels: Optional[Dict[str, str]] = None,
 ) -> Tuple[subprocess.Popen, Address]:
     tag = node_name or f"node{int(time.monotonic_ns() % 1_000_000)}"
     addr_file = os.path.join(session_dir, f"supervisor_{tag}_address")
@@ -120,6 +121,8 @@ def start_supervisor(
     ]
     if resources is not None:
         cmd += ["--resources", json.dumps(resources)]
+    if labels:
+        cmd += ["--labels", json.dumps(labels)]
     proc = subprocess.Popen(
         cmd, env=_daemon_env(config.to_env()), stdout=log, stderr=subprocess.STDOUT
     )

@@ -20,6 +20,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from ray_tpu._private.resources import ResourceSet
 from ray_tpu._private.task_spec import (
     NodeAffinityStrategy,
+    NodeLabelStrategy,
     PlacementGroupStrategy,
     SchedulingStrategy,
     SpreadStrategy,
@@ -65,10 +66,41 @@ def pick_node(
                     else None
                 )
         return _hybrid(nodes, rs, local_node_hex, spread_threshold) if strategy.soft else None
+    if isinstance(strategy, NodeLabelStrategy):
+        # hard constraints FILTER; soft constraints ORDER (the composite
+        # shape of composite_scheduling_policy.h: label policy narrows,
+        # hybrid decides within the narrowed set)
+        def soft_score(n: NodeView) -> int:
+            return sum(op.matches(n.labels.get(k))
+                       for k, op in strategy.soft.items())
+
+        eligible = [n for n in nodes
+                    if node_satisfies_labels(strategy, n.labels)]
+        if not eligible:
+            return None  # infeasible by labels: queue, don't misplace
+        if strategy.soft:
+            best = max(soft_score(n) for n in eligible)
+            preferred = [n for n in eligible if soft_score(n) == best]
+            chosen = _hybrid(preferred, rs, local_node_hex,
+                             spread_threshold)
+            if chosen is not None:
+                return chosen
+        return _hybrid(eligible, rs, local_node_hex, spread_threshold)
     if isinstance(strategy, SpreadStrategy):
         return _spread(nodes, rs, rng)
     # PlacementGroupStrategy demand is rewritten to bundle resources upstream.
     return _hybrid(nodes, rs, local_node_hex, spread_threshold)
+
+
+def node_satisfies_labels(strategy: SchedulingStrategy,
+                          labels: Dict[str, str]) -> bool:
+    """True unless *strategy* carries hard label constraints the node's
+    labels fail — the local-grant guard supervisors apply before leasing
+    on themselves."""
+    if not isinstance(strategy, NodeLabelStrategy):
+        return True
+    return all(op.matches(labels.get(k))
+               for k, op in strategy.hard.items())
 
 
 def _hybrid(

@@ -475,15 +475,22 @@ class Supervisor:
                     "hops": hops + 1,
                 }
 
-        if not self._feasible(demand, pg_key):
+        from ray_tpu._private.scheduling import node_satisfies_labels
+
+        labels_ok = node_satisfies_labels(
+            spec.strategy, {**self.labels, "node_name": self.node_name})
+        if not self._feasible(demand, pg_key) or not labels_ok:
             # No error: park it (reference keeps an infeasible queue and
             # warns, cluster_task_manager). A node that can host it may
             # join / sync in later; until then the demand is advertised to
-            # the controller for the autoscaler.
+            # the controller for the autoscaler. A hard label mismatch is
+            # infeasible HERE no matter the resources — granting locally
+            # would silently violate the constraint.
             logger.warning(
-                "infeasible demand %s on node %s (total=%s) — queued until "
-                "the cluster view offers a feasible node",
-                dict(demand), self.node_id.hex()[:8], dict(self.total))
+                "infeasible demand %s on node %s (total=%s, labels_ok=%s) "
+                "— queued until the cluster view offers a feasible node",
+                dict(demand), self.node_id.hex()[:8], dict(self.total),
+                labels_ok)
             fut = asyncio.get_running_loop().create_future()
             self._infeasible_leases.append(
                 _QueuedLease(spec, fut, demand, pg_key, hops,
@@ -522,6 +529,7 @@ class Supervisor:
             address=self.server.address,
             total=self.total,
             available=avail,
+            labels={**self.labels, "node_name": self.node_name},
             alive=True,
         )
 
@@ -1172,6 +1180,7 @@ def main() -> None:
     parser.add_argument("--address-file", default="")
     parser.add_argument("--resources", default="")  # JSON
     parser.add_argument("--node-name", default="")
+    parser.add_argument("--labels", default="")  # JSON {key: value}
     args = parser.parse_args()
 
     logging.basicConfig(
@@ -1193,6 +1202,7 @@ def main() -> None:
             args.port,
             resources=resources,
             node_name=args.node_name,
+            labels=json.loads(args.labels) if args.labels else None,
         )
         addr = await sup.start()
         if args.address_file:

@@ -69,6 +69,74 @@ class PlacementGroupStrategy(SchedulingStrategy):
     bundle_index: int = -1  # -1 = any bundle
 
 
+# Label operators (≈ the reference's label-selector grammar behind
+# NodeLabelSchedulingStrategy, `bundle_label_selector`/
+# `node_label_scheduling_policy`). Each constraint maps a label key to
+# one of these; plain lists/strings shorthand to In.
+
+
+@dataclasses.dataclass
+class In:
+    values: tuple
+
+    def __init__(self, *values):
+        self.values = tuple(values)
+
+    def matches(self, v) -> bool:
+        return v is not None and v in self.values
+
+
+@dataclasses.dataclass
+class NotIn:
+    values: tuple
+
+    def __init__(self, *values):
+        self.values = tuple(values)
+
+    def matches(self, v) -> bool:
+        return v is None or v not in self.values
+
+
+@dataclasses.dataclass
+class Exists:
+    def matches(self, v) -> bool:
+        return v is not None
+
+
+@dataclasses.dataclass
+class DoesNotExist:
+    def matches(self, v) -> bool:
+        return v is None
+
+
+def _norm_label_ops(constraints):
+    out = {}
+    for k, op in (constraints or {}).items():
+        if isinstance(op, (list, tuple)):
+            op = In(*op)
+        elif isinstance(op, str):
+            op = In(op)
+        out[k] = op
+    return out
+
+
+@dataclasses.dataclass
+class NodeLabelStrategy(SchedulingStrategy):
+    """Schedule by node labels (≈ NodeLabelSchedulingStrategy /
+    `node_label_scheduling_policy.h`): `hard` constraints filter the
+    candidate set (infeasible if none match), `soft` ones order it —
+    the heterogeneous-TPU-generations case (label chips by `tpu-gen`)
+    the plain resource model can't express."""
+
+    name: str = "NODE_LABEL"
+    hard: dict = dataclasses.field(default_factory=dict)
+    soft: dict = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        self.hard = _norm_label_ops(self.hard)
+        self.soft = _norm_label_ops(self.soft)
+
+
 @dataclasses.dataclass
 class TaskSpec:
     task_id: TaskID

@@ -57,6 +57,7 @@ class Cluster:
         resources: Optional[Dict[str, float]] = None,
         object_store_memory: Optional[int] = None,
         name: str = "",
+        labels: Optional[Dict[str, str]] = None,
     ) -> ClusterNode:
         node_resources = {"CPU": float(num_cpus), "memory": 2.0 * 1024**3}
         if num_tpus:
@@ -70,6 +71,7 @@ class Cluster:
             self.controller_addr,
             resources=node_resources,
             node_name=name,
+            labels=labels,
         )
         node = ClusterNode(proc, addr, name)
         self.nodes.append(node)

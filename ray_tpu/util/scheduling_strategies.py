@@ -1,0 +1,20 @@
+"""Public scheduling-strategy surface (≈ `ray.util.scheduling_strategies`:
+NodeAffinitySchedulingStrategy, NodeLabelSchedulingStrategy + the
+In/NotIn/Exists/DoesNotExist label operators)."""
+
+from ray_tpu._private.task_spec import (  # noqa: F401
+    DoesNotExist,
+    Exists,
+    In,
+    NodeAffinityStrategy,
+    NodeLabelStrategy,
+    NotIn,
+    PlacementGroupStrategy,
+    SchedulingStrategy,
+    SpreadStrategy,
+)
+
+# reference-compatible aliases
+NodeAffinitySchedulingStrategy = NodeAffinityStrategy
+NodeLabelSchedulingStrategy = NodeLabelStrategy
+PlacementGroupSchedulingStrategy = PlacementGroupStrategy

@@ -425,6 +425,31 @@ class TestControllerFaultTolerance:
         assert ray_tpu.get(b.get.remote("k"), timeout=30) == 7
         ray_tpu.kill(b)
 
+    def test_label_scheduling_end_to_end(self, ray_cluster):
+        """A task with a hard NodeLabelStrategy lands on the labeled
+        node even when another node is less loaded."""
+        from ray_tpu.util.scheduling_strategies import (
+            In, NodeLabelSchedulingStrategy)
+
+        ray_cluster.add_node(num_cpus=4, labels={"tpu-gen": "v5e"})
+        ray_cluster.add_node(num_cpus=4, labels={"tpu-gen": "v6e"})
+        ray_cluster.wait_for_nodes(2)
+        ray_tpu.init(address=ray_cluster.address)
+
+        @ray_tpu.remote(scheduling_strategy=NodeLabelSchedulingStrategy(
+            hard={"tpu-gen": In("v6e")}))
+        def where():
+            import ray_tpu as rt
+
+            return rt.get_runtime_context().get_node_id()
+
+        target = next(
+            n for n in ray_tpu.nodes()
+            if n.get("labels", {}).get("tpu-gen") == "v6e")
+        for _ in range(4):
+            assert ray_tpu.get(where.remote(), timeout=60) == \
+                target["node_id_hex"]
+
     def test_remote_store_head_recovery(self, tmp_path):
         """Control plane on a REMOTE URI backend (mock:// fake remote):
         the controller is SIGKILLed and restarted, recovering actors and

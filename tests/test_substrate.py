@@ -14,7 +14,10 @@ from ray_tpu._private.object_store import NodeObjectStore, OutOfMemoryError, _Fr
 from ray_tpu._private.resources import ResourceSet
 from ray_tpu._private.rpc import RemoteError, RpcClient, RpcServer
 from ray_tpu._private.scheduling import NodeView, PlacementError, pick_node, place_bundles
-from ray_tpu._private.task_spec import NodeAffinityStrategy, SchedulingStrategy, SpreadStrategy
+from ray_tpu._private.task_spec import (DoesNotExist, Exists, In,
+                                        NodeAffinityStrategy,
+                                        NodeLabelStrategy, NotIn,
+                                        SchedulingStrategy, SpreadStrategy)
 
 
 class TestIDs:
@@ -189,6 +192,44 @@ class TestSchedulingPolicies:
         views = _views(({"CPU": 4}, {"CPU": 2}), ({"CPU": 4}, {"CPU": 4}))
         picked = pick_node(views, {"CPU": 1}, SpreadStrategy())
         assert picked.node_id_hex == views[1].node_id_hex
+
+    def test_node_labels_hard_filters(self):
+        """Hard label constraints narrow the candidate set; no match =
+        infeasible (queue), never a misplaced task (ref
+        node_label_scheduling_policy.h)."""
+        views = _views(({"CPU": 4}, {"CPU": 4}), ({"CPU": 4}, {"CPU": 4}))
+        views[0].labels = {"tpu-gen": "v5e", "zone": "a"}
+        views[1].labels = {"tpu-gen": "v6e", "zone": "b"}
+        strat = NodeLabelStrategy(hard={"tpu-gen": In("v6e")})
+        assert pick_node(views, {"CPU": 1}, strat).node_id_hex == \
+            views[1].node_id_hex
+        # shorthand: a list means In
+        strat2 = NodeLabelStrategy(hard={"tpu-gen": ["v5e"]})
+        assert pick_node(views, {"CPU": 1}, strat2).node_id_hex == \
+            views[0].node_id_hex
+        # no node satisfies -> None (task queues)
+        assert pick_node(views, {"CPU": 1}, NodeLabelStrategy(
+            hard={"tpu-gen": In("v4")})) is None
+        # NotIn / Exists / DoesNotExist operators
+        assert pick_node(views, {"CPU": 1}, NodeLabelStrategy(
+            hard={"tpu-gen": NotIn("v5e")})).node_id_hex == \
+            views[1].node_id_hex
+        assert pick_node(views, {"CPU": 1}, NodeLabelStrategy(
+            hard={"zone": Exists()})) is not None
+        assert pick_node(views, {"CPU": 1}, NodeLabelStrategy(
+            hard={"gpu": DoesNotExist()})) is not None
+
+    def test_node_labels_soft_orders(self):
+        """Soft constraints prefer matching nodes but never block."""
+        views = _views(({"CPU": 4}, {"CPU": 4}), ({"CPU": 4}, {"CPU": 4}))
+        views[0].labels = {"tpu-gen": "v5e"}
+        views[1].labels = {"tpu-gen": "v6e"}
+        strat = NodeLabelStrategy(soft={"tpu-gen": In("v6e")})
+        assert pick_node(views, {"CPU": 1}, strat).node_id_hex == \
+            views[1].node_id_hex
+        # soft with no satisfying node falls back to any feasible one
+        strat2 = NodeLabelStrategy(soft={"tpu-gen": In("v4")})
+        assert pick_node(views, {"CPU": 1}, strat2) is not None
 
     def test_bundle_strict_pack(self):
         views = _views(({"CPU": 8}, {"CPU": 8}), ({"CPU": 2}, {"CPU": 2}))
