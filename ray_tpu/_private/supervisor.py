@@ -784,6 +784,23 @@ class Supervisor:
         w = self.workers.get(body["worker_id_hex"])
         return w.tpu_chips if w else []
 
+    async def rpc_worker_profile(self, body) -> dict:
+        """Relay an on-demand live profile request to one of our workers
+        (ref dashboard reporter_agent.py:391; collectors in
+        _private/profiling.py). Also lists workers when none named."""
+        wid = body.get("worker_id_hex", "")
+        if not wid:
+            return {"workers": [
+                {"worker_id_hex": w.worker_id_hex, "pid": w.pid,
+                 "is_actor": w.is_actor, "actor_id_hex": w.actor_id_hex}
+                for w in self.workers.values()]}
+        w = self.workers.get(wid)
+        if w is None:
+            raise ValueError(f"no worker {wid} on this node")
+        return await self.clients.get(w.address).call(
+            "profile", {"kind": body.get("kind", "stack"),
+                        "limit": body.get("limit", 20)}, timeout=30)
+
     async def _monitor_loop(self) -> None:
         """Detect worker process exits (≈ raylet socket-disconnect detection,
         node_manager.cc:1432). The loop must survive any handler error —

@@ -657,6 +657,16 @@ def main() -> None:
     core.server.register("push_task_batch", executor.push_task_batch)
     core.server.register("cancel", executor.cancel)
 
+    async def profile(body):
+        """Live in-process profiling (stacks / memory / device HBM);
+        ref dashboard reporter_agent.py:391 py-spy attach."""
+        from ray_tpu._private import profiling
+
+        return profiling.collect(body.get("kind", "stack"),
+                                 body.get("limit", 20))
+
+    core.server.register("profile", profile)
+
     # make the worker-side public API work inside tasks
     from ray_tpu._private import api
 

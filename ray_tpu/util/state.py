@@ -126,3 +126,54 @@ def timeline(path: Optional[str] = None) -> List[Dict[str, Any]]:
         with open(path, "w") as f:
             json.dump(trace, f)
     return trace
+
+
+# ------------------------------------------------- live worker profiling
+
+
+def _supervisor_call(node_id_hex: str, method: str, body: dict):
+    core = api._require_core()
+    node = next((n for n in _call("node_views")
+                 if n["node_id_hex"] == node_id_hex), None)
+    if node is None:
+        raise ValueError(f"node {node_id_hex} not in cluster view")
+    return core._run(
+        core.clients.get(tuple(node["address"])).call(method, body))
+
+
+def list_workers(node_id_hex: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Live worker processes per node (pid, actor binding)."""
+    out = []
+    for node in _call("node_views"):
+        if node_id_hex and node["node_id_hex"] != node_id_hex:
+            continue
+        r = _supervisor_call(node["node_id_hex"], "worker_profile", {})
+        for w in r["workers"]:
+            out.append(dict(w, node_id_hex=node["node_id_hex"]))
+    return out
+
+
+def profile_worker(node_id_hex: str, worker_id_hex: str,
+                   kind: str = "stack", limit: int = 20) -> Dict[str, Any]:
+    """On-demand live profile of a RUNNING worker — no restart, no
+    external profiler (≈ the dashboard's py-spy/memray attach,
+    `dashboard/modules/reporter/reporter_agent.py:391`; collectors in
+    `_private/profiling.py`). Kinds: "stack" (all thread stacks),
+    "memory" (RSS + tracemalloc top sites), "device" (live jax.Array
+    HBM breakdown — the TPU question generic profilers can't answer)."""
+    return _supervisor_call(node_id_hex, "worker_profile",
+                            {"worker_id_hex": worker_id_hex,
+                             "kind": kind, "limit": limit})
+
+
+def profile_actor(name_or_id: str, kind: str = "stack",
+                  limit: int = 20) -> Dict[str, Any]:
+    """Profile the worker currently hosting an actor (by name or id)."""
+    for rec in _call("actor_list"):
+        if rec["actor_id_hex"] == name_or_id or rec["name"] == name_or_id:
+            if rec["state"] != "ALIVE":
+                raise ValueError(
+                    f"actor {name_or_id} is {rec['state']}, not ALIVE")
+            return profile_worker(rec["node_id_hex"],
+                                  rec["worker_id_hex"], kind, limit)
+    raise ValueError(f"no actor {name_or_id!r}")
