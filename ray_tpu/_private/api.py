@@ -366,6 +366,14 @@ def shutdown() -> None:
     global _core, _node_handle
     with _global_lock:
         _uninstall_client()
+        if _node_handle is not None:
+            # local usage report (usage.py; collector POST is opt-in)
+            try:
+                from ray_tpu._private import usage
+
+                usage.write_report(_node_handle.session_dir)
+            except Exception:
+                pass
         if _core is not None:
             try:
                 _core._run(

@@ -4,3 +4,8 @@ from ray_tpu.air.config import (  # noqa: F401
     RunConfig,
     ScalingConfig,
 )
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+
+_rlu("air")
+del _rlu

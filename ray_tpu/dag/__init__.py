@@ -174,3 +174,8 @@ def _resolve(node: DAGNode, inputs: List[Any], cache: Dict[int, Any]):
         raise TypeError(f"not a DAG node: {node!r}")
     cache[id(node)] = out
     return out
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+
+_rlu("dag")
+del _rlu

@@ -25,3 +25,8 @@ from ray_tpu.data.dataset import (  # noqa: F401
     read_webdataset,
 )
 from ray_tpu.data.iterator import DataIterator  # noqa: F401
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+
+_rlu("data")
+del _rlu

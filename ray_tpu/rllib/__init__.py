@@ -55,3 +55,8 @@ __all__ = [
     "MultiAgentEnvRunner",
     "MultiAgentEnvRunnerGroup",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+
+_rlu("rllib")
+del _rlu

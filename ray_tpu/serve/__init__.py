@@ -239,3 +239,8 @@ def shutdown() -> None:
             ray_tpu.kill(ray_tpu.get_actor(actor_name))
         except Exception:
             pass
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+
+_rlu("serve")
+del _rlu

@@ -26,3 +26,8 @@ from ray_tpu.train.trainer import (  # noqa: F401
     JaxTrainer,
     Result,
 )
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+
+_rlu("train")
+del _rlu

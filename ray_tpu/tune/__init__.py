@@ -41,3 +41,8 @@ from ray_tpu.tune.tuner import (  # noqa: F401
     Tuner,
     with_resources,
 )
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+
+_rlu("tune")
+del _rlu

@@ -267,3 +267,8 @@ def delete(workflow_id: str, storage: Optional[str] = None) -> None:
 
     shutil.rmtree(os.path.join(_storage_root(storage), workflow_id),
                   ignore_errors=True)
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+
+_rlu("workflow")
+del _rlu

@@ -239,3 +239,38 @@ class TestLiveProfiling:
         assert any(w["is_actor"] for w in workers)
         assert all("pid" in w and "node_id_hex" in w for w in workers)
         ray_tpu.kill(a)
+
+
+class TestUsageTelemetry:
+    """Usage stats (ref python/ray/_private/usage/usage_lib.py; local
+    report always, collector POST opt-in via RAY_TPU_USAGE_REPORT_URL)."""
+
+    def test_report_written_at_shutdown(self, tmp_path):
+        import subprocess
+        import sys
+
+        script = (
+            "import ray_tpu, ray_tpu.train, json, glob\n"
+            "info = ray_tpu.init(num_cpus=1,"
+            " object_store_memory=64*1024*1024)\n"
+            "session = info['session_dir']\n"
+            "ray_tpu.shutdown()\n"
+            "r = json.load(open(session + '/usage_report.json'))\n"
+            "assert 'train' in r['libraries_used'], r\n"
+            "assert r['cluster'].get('num_nodes') == 1, r\n"
+            "print('REPORT-OK')\n")
+        out = subprocess.run([sys.executable, "-c", script],
+                             capture_output=True, text=True, timeout=120)
+        assert "REPORT-OK" in out.stdout, out.stderr[-2000:]
+
+    def test_disable_env(self):
+        import os
+
+        from ray_tpu._private import usage
+
+        try:
+            os.environ["RAY_TPU_USAGE_STATS_ENABLED"] = "0"
+            usage.record_library_usage("secret_lib")
+            assert "secret_lib" not in usage.build_report()["libraries_used"]
+        finally:
+            os.environ.pop("RAY_TPU_USAGE_STATS_ENABLED", None)
