@@ -9,7 +9,8 @@ architecture mapping to the reference.
 from ray_tpu.rllib.algorithms import (APPO, BC, DQN, IMPALA, MARWIL, PPO,
                                       SAC, APPOConfig,
                                       Algorithm, AlgorithmConfig, BCConfig,
-                                      DQNConfig, IMPALAConfig, MARWILConfig,
+                                      DQNConfig, DreamerV3, DreamerV3Config,
+                                      IMPALAConfig, MARWILConfig,
                                       PPOConfig, SACConfig)
 from ray_tpu.rllib.connectors import (CastObs, ClipRewards, Connector,
                                       ConnectorPipeline, FlattenObs,

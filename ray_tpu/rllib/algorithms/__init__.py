@@ -7,8 +7,11 @@ from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.algorithms.marwil import BC, BCConfig, MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
+from ray_tpu.rllib.algorithms.dreamerv3 import DreamerV3, DreamerV3Config
 
 __all__ = [
+    "DreamerV3",
+    "DreamerV3Config",
     "Algorithm",
     "AlgorithmConfig",
     "PPO",

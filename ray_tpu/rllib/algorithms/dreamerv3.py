@@ -1,0 +1,533 @@
+"""DreamerV3 — model-based RL on imagined rollouts (VERDICT r4 missing
+#9; ref `rllib/algorithms/dreamerv3/` + the DreamerV3 paper's published
+recipe: RSSM with categorical latents, KL balancing with free bits,
+symlog predictions, lambda-return actor-critic on imagination).
+
+TPU-first shape: the three training phases are each ONE jitted program —
+world-model learning scans the RSSM over [B, T] sequences, imagination
+scans actor+prior H steps ahead from every posterior state, and the
+actor/critic losses backprop through the same scan. No Python stepping
+inside training; the only per-step Python is real-env acting, which
+carries its (deter, stoch) state across env.step like the reference's
+ActorCriticEncoder does.
+
+Discrete-action version (the paper's Atari/control configuration:
+reinforce gradients + entropy on imagined returns)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+
+
+def symlog(x):
+    import jax.numpy as jnp
+
+    return jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def symexp(x):
+    import jax.numpy as jnp
+
+    return jnp.sign(x) * (jnp.exp(jnp.abs(x)) - 1.0)
+
+
+class DreamerV3Config(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.batch_size_B: int = 16      # sequences per world-model batch
+        self.batch_length_T: int = 16    # timesteps per sequence
+        self.horizon_H: int = 15         # imagination depth
+        self.gamma: float = 0.997
+        self.gae_lambda: float = 0.95
+        self.entropy_coeff: float = 3e-3
+        self.free_bits: float = 1.0
+        self.kl_balance: float = 0.8     # dyn-vs-rep loss split
+        self.deter_dim: int = 128
+        self.stoch_classes: int = 8      # 8x8 categorical latent
+        self.stoch_groups: int = 8
+        self.hidden: int = 128
+        self.model_lr: float = 1e-3
+        self.actor_lr: float = 3e-4
+        self.critic_lr: float = 3e-4
+        self.updates_per_iteration: int = 8
+        self.rollout_fragment_length = 64
+        self.replay_capacity_steps: int = 50_000
+        self.warmup_steps: int = 500
+
+    def rl_module_spec(self):  # satisfies the base surface; unused here
+        return None
+
+
+def _mlp_init(key, sizes):
+    import jax
+    import jax.numpy as jnp
+
+    params = []
+    for i in range(len(sizes) - 1):
+        key, k = jax.random.split(key)
+        params.append({
+            "w": jax.random.normal(k, (sizes[i], sizes[i + 1]),
+                                   jnp.float32)
+            * np.sqrt(2.0 / sizes[i]),
+            "b": jnp.zeros((sizes[i + 1],), jnp.float32)})
+    return params
+
+
+def _mlp(params, x, final_act=False):
+    import jax.numpy as jnp
+
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1 or final_act:
+            x = jnp.tanh(x)
+    return x
+
+
+class WorldModel:
+    """RSSM + heads. State = (deter h, stoch z); z is groups x classes
+    one-hot categoricals with straight-through gradients."""
+
+    def __init__(self, cfg: DreamerV3Config, obs_dim: int, n_act: int):
+        self.cfg = cfg
+        self.obs_dim = obs_dim
+        self.n_act = n_act
+        self.stoch_dim = cfg.stoch_groups * cfg.stoch_classes
+        self.feat_dim = cfg.deter_dim + self.stoch_dim
+
+    def init_params(self, key):
+        import jax
+
+        cfg = self.cfg
+        ks = list(jax.random.split(key, 10))
+        h, d, s = cfg.hidden, cfg.deter_dim, self.stoch_dim
+        return {
+            "encoder": _mlp_init(ks[0], (self.obs_dim, h, h)),
+            # GRU: input [stoch + action_onehot], hidden deter
+            "gru": _gru_init(ks[1], s + self.n_act, d),
+            "prior": _mlp_init(ks[2], (d, h, s)),
+            "posterior": _mlp_init(ks[3], (d + h, h, s)),
+            "decoder": _mlp_init(ks[4], (self.feat_dim, h, self.obs_dim)),
+            "reward": _mlp_init(ks[5], (self.feat_dim, h, 1)),
+            "cont": _mlp_init(ks[6], (self.feat_dim, h, 1)),
+        }
+
+    # ---- latent machinery
+
+    def _logits_to_stoch(self, logits, key):
+        """Sample one-hot categoricals with straight-through grads."""
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        lg = logits.reshape(logits.shape[:-1]
+                            + (cfg.stoch_groups, cfg.stoch_classes))
+        # unimix: 1% uniform keeps every class reachable (paper trick)
+        probs = 0.99 * jax.nn.softmax(lg, -1) + 0.01 / cfg.stoch_classes
+        lg = jnp.log(probs)
+        idx = jax.random.categorical(key, lg)
+        one_hot = jax.nn.one_hot(idx, cfg.stoch_classes)
+        st = one_hot + probs - jax.lax.stop_gradient(probs)
+        return st.reshape(st.shape[:-2] + (self.stoch_dim,)), lg
+
+    def obs_step(self, params, deter, stoch, action_1h, obs, key):
+        """One posterior step: advance deter, infer z from the real obs."""
+        import jax.numpy as jnp
+
+        deter = _gru(params["gru"],
+                     jnp.concatenate([stoch, action_1h], -1), deter)
+        prior_logits = _mlp(params["prior"], deter)
+        embed = _mlp(params["encoder"], symlog(obs), final_act=True)
+        post_in = jnp.concatenate([deter, embed], -1)
+        post_logits = _mlp(params["posterior"], post_in)
+        stoch, post_lg = self._logits_to_stoch(post_logits, key)
+        _, prior_lg = self._logits_to_stoch(prior_logits, key)
+        return deter, stoch, post_lg, prior_lg
+
+    def img_step(self, params, deter, stoch, action_1h, key):
+        """One prior (imagination) step: no observation."""
+        import jax.numpy as jnp
+
+        deter = _gru(params["gru"],
+                     jnp.concatenate([stoch, action_1h], -1), deter)
+        prior_logits = _mlp(params["prior"], deter)
+        stoch, _ = self._logits_to_stoch(prior_logits, key)
+        return deter, stoch
+
+    def feat(self, deter, stoch):
+        import jax.numpy as jnp
+
+        return jnp.concatenate([deter, stoch], -1)
+
+
+def _gru_init(key, in_dim, hid):
+    import jax
+    import jax.numpy as jnp
+
+    k1, k2 = jax.random.split(key)
+    scale = np.sqrt(1.0 / (in_dim + hid))
+    return {
+        "wi": jax.random.normal(k1, (in_dim, 3 * hid), jnp.float32) * scale,
+        "wh": jax.random.normal(k2, (hid, 3 * hid), jnp.float32) * scale,
+        "b": jnp.zeros((3 * hid,), jnp.float32)}
+
+
+def _gru(p, x, h):
+    import jax
+    import jax.numpy as jnp
+
+    gates = x @ p["wi"] + h @ p["wh"] + p["b"]
+    r, z, n = jnp.split(gates, 3, -1)
+    r, z = jax.nn.sigmoid(r), jax.nn.sigmoid(z)
+    n = jnp.tanh(r * n)
+    return (1 - z) * n + z * h
+
+
+class DreamerV3(Algorithm):
+    """Self-contained driver (single-process sampling like SAC's local
+    path): replay of real sequences -> one jitted world-model update ->
+    one jitted imagination actor-critic update per train batch."""
+
+    def __init__(self, config: DreamerV3Config):
+        import time as _time
+
+        import gymnasium as gym
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.config = config
+        self.iteration = 0
+        self._total_env_steps = 0
+        self._start = _time.time()
+        self._env = gym.make(config.env, **config.env_config)
+        obs_dim = int(np.prod(self._env.observation_space.shape))
+        n_act = int(self._env.action_space.n)
+        self.wm = WorldModel(config, obs_dim, n_act)
+        key = jax.random.PRNGKey(config.seed or 0)
+        k_wm, k_actor, k_critic, self._key = jax.random.split(key, 4)
+        self.params = {
+            "wm": self.wm.init_params(k_wm),
+            "actor": _mlp_init(k_actor, (self.wm.feat_dim, config.hidden,
+                                         n_act)),
+            "critic": _mlp_init(k_critic, (self.wm.feat_dim, config.hidden,
+                                           1)),
+        }
+        self._opts = {
+            "wm": optax.adam(config.model_lr),
+            "actor": optax.adam(config.actor_lr),
+            "critic": optax.adam(config.critic_lr),
+        }
+        self._opt_state = {k: self._opts[k].init(self.params[k])
+                           for k in self._opts}
+        # episode replay: list of dicts of np arrays (obs/action/reward/cont)
+        self._episodes = []
+        self._replay_steps = 0
+        self._rng = np.random.default_rng(config.seed)
+        self._act_state = None  # (deter, stoch) carried across env steps
+        self._ep_return = 0.0
+        self._returns = []
+        self._obs = None
+        self._wm_update = jax.jit(self._make_wm_update())
+        self._ac_update = jax.jit(self._make_ac_update())
+        self._act_fn = jax.jit(self._make_act_fn())
+        self._jnp = jnp
+
+    @classmethod
+    def get_default_config(cls) -> DreamerV3Config:
+        return DreamerV3Config()
+
+    # ------------------------------------------------------------ acting
+
+    def _make_act_fn(self):
+        import jax
+
+        wm = self.wm
+
+        def act(params, deter, stoch, prev_action_1h, obs, key):
+            k1, k2 = jax.random.split(key)
+            deter, stoch, _, _ = wm.obs_step(
+                params["wm"], deter, stoch, prev_action_1h, obs, k1)
+            logits = _mlp(params["actor"], wm.feat(deter, stoch))
+            action = jax.random.categorical(k2, logits)
+            return deter, stoch, action
+
+        return act
+
+    def _sample_steps(self, n: int) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        wm = self.wm
+        if self._obs is None:
+            self._obs, _ = self._env.reset(seed=cfg.seed)
+            self._ep = {"obs": [], "action": [], "reward": [], "cont": []}
+            self._act_state = (jnp.zeros((cfg.deter_dim,)),
+                               jnp.zeros((wm.stoch_dim,)))
+            self._prev_a = jnp.zeros((wm.n_act,))
+        for _ in range(n):
+            self._key, k = jax.random.split(self._key)
+            if self._total_env_steps < cfg.warmup_steps:
+                a = int(self._rng.integers(wm.n_act))
+                # keep the filter state advancing during warmup too
+                deter, stoch, _ = self._act_fn(
+                    self.params, *self._act_state, self._prev_a,
+                    jnp.asarray(self._obs, jnp.float32), k)
+            else:
+                deter, stoch, a_dev = self._act_fn(
+                    self.params, *self._act_state, self._prev_a,
+                    jnp.asarray(self._obs, jnp.float32), k)
+                a = int(a_dev)
+            self._act_state = (deter, stoch)
+            nxt, r, term, trunc, _ = self._env.step(a)
+            self._ep["obs"].append(np.asarray(self._obs, np.float32))
+            self._ep["action"].append(a)
+            self._ep["reward"].append(float(r))
+            self._ep["cont"].append(0.0 if term else 1.0)
+            self._prev_a = jax.nn.one_hot(a, wm.n_act)
+            self._ep_return += float(r)
+            self._total_env_steps += 1
+            self._obs = nxt
+            if term or trunc:
+                ep = {k2: np.asarray(v) for k2, v in self._ep.items()}
+                self._episodes.append(ep)
+                self._replay_steps += len(ep["reward"])
+                while self._replay_steps > cfg.replay_capacity_steps \
+                        and len(self._episodes) > 1:
+                    gone = self._episodes.pop(0)
+                    self._replay_steps -= len(gone["reward"])
+                self._returns.append(self._ep_return)
+                self._ep_return = 0.0
+                self._obs, _ = self._env.reset()
+                self._ep = {"obs": [], "action": [], "reward": [],
+                            "cont": []}
+                self._act_state = (jnp.zeros((cfg.deter_dim,)),
+                                   jnp.zeros((wm.stoch_dim,)))
+                self._prev_a = jnp.zeros((wm.n_act,))
+
+    def _sample_batch(self):
+        """[B, T] subsequences drawn uniformly over replayed episodes."""
+        cfg = self.config
+        B, T = cfg.batch_size_B, cfg.batch_length_T
+        obs = np.zeros((B, T, self.wm.obs_dim), np.float32)
+        act = np.zeros((B, T), np.int32)
+        rew = np.zeros((B, T), np.float32)
+        cont = np.zeros((B, T), np.float32)
+        eligible = [e for e in self._episodes if len(e["reward"]) >= 2]
+        for b in range(B):
+            ep = eligible[self._rng.integers(len(eligible))]
+            L = len(ep["reward"])
+            take = min(T, L)
+            start = self._rng.integers(0, L - take + 1)
+            sl = slice(start, start + take)
+            obs[b, :take] = ep["obs"][sl].reshape(take, -1)
+            act[b, :take] = ep["action"][sl]
+            rew[b, :take] = ep["reward"][sl]
+            cont[b, :take] = ep["cont"][sl]
+            if take < T:  # pad by repeating the last frame, cont=0
+                obs[b, take:] = obs[b, take - 1]
+                cont[b, take:] = 0.0
+        return {"obs": obs, "action": act, "reward": rew, "cont": cont}
+
+    # ------------------------------------------------- world-model update
+
+    def _make_wm_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        wm = self.wm
+
+        def wm_loss(wparams, batch, key):
+            B, T = batch["action"].shape
+            a1h = jax.nn.one_hot(batch["action"], wm.n_act)
+            # previous action feeds each step; step 0 gets zeros
+            a_prev = jnp.concatenate(
+                [jnp.zeros_like(a1h[:, :1]), a1h[:, :-1]], 1)
+
+            def step(carry, t):
+                deter, stoch, key = carry
+                key, k = jax.random.split(key)
+                deter, stoch, post_lg, prior_lg = wm.obs_step(
+                    wparams, deter, stoch, a_prev[:, t], batch["obs"][:, t],
+                    k)
+                return (deter, stoch, key), (deter, stoch, post_lg,
+                                             prior_lg)
+
+            carry0 = (jnp.zeros((B, cfg.deter_dim)),
+                      jnp.zeros((B, wm.stoch_dim)), key)
+            _, (deters, stochs, post_lg, prior_lg) = jax.lax.scan(
+                step, carry0, jnp.arange(T))
+            # scan stacks time first: [T, B, ...]
+            feats = wm.feat(deters, stochs)
+            recon = _mlp(wparams["decoder"], feats)
+            obs_t = jnp.swapaxes(batch["obs"], 0, 1)
+            recon_loss = jnp.mean(jnp.sum(
+                (recon - symlog(obs_t)) ** 2, -1))
+            rew_pred = _mlp(wparams["reward"], feats)[..., 0]
+            rew_loss = jnp.mean(
+                (rew_pred - symlog(jnp.swapaxes(batch["reward"], 0, 1)))
+                ** 2)
+            cont_logit = _mlp(wparams["cont"], feats)[..., 0]
+            cont_t = jnp.swapaxes(batch["cont"], 0, 1)
+            cont_loss = jnp.mean(
+                optax.sigmoid_binary_cross_entropy(cont_logit, cont_t))
+
+            # KL balancing with free bits (paper eq. 5)
+            def kl(lg_p, lg_q):  # KL(p || q), categorical per group
+                p = jnp.exp(lg_p)
+                return jnp.sum(p * (lg_p - lg_q), -1).sum(-1)
+
+            dyn = kl(jax.lax.stop_gradient(post_lg), prior_lg)
+            rep = kl(post_lg, jax.lax.stop_gradient(prior_lg))
+            kl_loss = (cfg.kl_balance * jnp.maximum(dyn, cfg.free_bits)
+                       + (1 - cfg.kl_balance)
+                       * jnp.maximum(rep, cfg.free_bits)).mean()
+            loss = recon_loss + rew_loss + cont_loss + kl_loss
+            return loss, {"wm_loss": loss, "recon_loss": recon_loss,
+                          "kl_loss": kl_loss,
+                          "starts": (jax.lax.stop_gradient(deters),
+                                     jax.lax.stop_gradient(stochs))}
+
+        def update(params, opt_state, batch, key):
+            (loss, aux), grads = jax.value_and_grad(
+                wm_loss, has_aux=True)(params["wm"], batch, key)
+            updates, new_opt = self._opts["wm"].update(
+                grads, opt_state["wm"], params["wm"])
+            new_wm = optax.apply_updates(params["wm"], updates)
+            return new_wm, new_opt, aux
+
+        return update
+
+    # ------------------------------------------- imagination actor-critic
+
+    def _make_ac_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        wm = self.wm
+
+        def imagine(params, starts, key):
+            deter, stoch = starts
+            deter = deter.reshape(-1, cfg.deter_dim)
+            stoch = stoch.reshape(-1, wm.stoch_dim)
+
+            def step(carry, _):
+                deter, stoch, key = carry
+                key, k1, k2 = jax.random.split(key, 3)
+                feat = wm.feat(deter, stoch)
+                logits = _mlp(params["actor"], feat)
+                a = jax.random.categorical(k1, logits)
+                a1h = jax.nn.one_hot(a, wm.n_act)
+                lp = jnp.take_along_axis(
+                    jax.nn.log_softmax(logits), a[:, None], 1)[:, 0]
+                ent = -jnp.sum(jax.nn.softmax(logits)
+                               * jax.nn.log_softmax(logits), -1)
+                deter, stoch = wm.img_step(params["wm"], deter, stoch,
+                                           a1h, k2)
+                return (deter, stoch, key), (feat, lp, ent, deter, stoch)
+
+            (_, _, _), (feats, lps, ents, deters, stochs) = jax.lax.scan(
+                step, (deter, stoch, key), None, length=cfg.horizon_H)
+            return feats, lps, ents, deters, stochs
+
+        def ac_loss(ac_params, wm_params, starts, key):
+            params = {"actor": ac_params["actor"], "wm": wm_params}
+            feats, lps, ents, deters, stochs = imagine(params, starts, key)
+            nxt_feats = wm.feat(deters, stochs)
+            rew = symexp(_mlp(wm_params["reward"], nxt_feats)[..., 0])
+            cont = jax.nn.sigmoid(_mlp(wm_params["cont"],
+                                       nxt_feats)[..., 0])
+            disc = cfg.gamma * cont
+            values = _mlp(ac_params["critic"], feats)[..., 0]
+            nxt_values = _mlp(ac_params["critic"], nxt_feats)[..., 0]
+
+            # lambda-returns, computed backwards through the horizon
+            def back(nxt_ret, t):
+                ret = (rew[t] + disc[t]
+                       * ((1 - cfg.gae_lambda) * nxt_values[t]
+                          + cfg.gae_lambda * nxt_ret))
+                return ret, ret
+
+            _, rets = jax.lax.scan(
+                back, nxt_values[-1], jnp.arange(cfg.horizon_H - 1, -1, -1))
+            rets = rets[::-1]
+            adv = jax.lax.stop_gradient(rets - values)
+            actor_loss = -(jnp.mean(lps * adv)
+                           + cfg.entropy_coeff * jnp.mean(ents))
+            critic_loss = jnp.mean(
+                (values - jax.lax.stop_gradient(rets)) ** 2)
+            loss = actor_loss + critic_loss
+            return loss, {"actor_loss": actor_loss,
+                          "critic_loss": critic_loss,
+                          "imagined_return_mean": jnp.mean(rets)}
+
+        def update(params, opt_state, starts, key):
+            ac = {"actor": params["actor"], "critic": params["critic"]}
+            (loss, aux), grads = jax.value_and_grad(
+                ac_loss, has_aux=True)(ac, params["wm"], starts, key)
+            out_p, out_o = {}, {}
+            for name in ("actor", "critic"):
+                updates, new_o = self._opts[name].update(
+                    grads[name], opt_state[name], params[name])
+                out_p[name] = optax.apply_updates(params[name], updates)
+                out_o[name] = new_o
+            return out_p, out_o, aux
+
+        return update
+
+    # ------------------------------------------------------------- train
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+
+        cfg = self.config
+        self._sample_steps(cfg.rollout_fragment_length)
+        metrics: Dict[str, Any] = {}
+        if self._replay_steps < max(cfg.batch_length_T * 2,
+                                    cfg.warmup_steps // 4):
+            return {"learner": {}, "waiting_for_replay": True}
+        for _ in range(cfg.updates_per_iteration):
+            batch = {k: self._jnp.asarray(v)
+                     for k, v in self._sample_batch().items()}
+            self._key, k1, k2 = jax.random.split(self._key, 3)
+            new_wm, new_wm_opt, wm_aux = self._wm_update(
+                self.params, self._opt_state, batch, k1)
+            self.params["wm"] = new_wm
+            self._opt_state["wm"] = new_wm_opt
+            starts = wm_aux.pop("starts")
+            ac_p, ac_o, ac_aux = self._ac_update(
+                self.params, self._opt_state, starts, k2)
+            self.params.update(ac_p)
+            self._opt_state.update(ac_o)
+            metrics = {k: float(v) for k, v in {**wm_aux, **ac_aux}.items()}
+        return {"learner": {"default_policy": metrics}}
+
+    def train(self) -> Dict[str, Any]:
+        import time as _time
+
+        result = self.training_step()
+        self.iteration += 1
+        recent = self._returns[-20:]
+        result.update({
+            "training_iteration": self.iteration,
+            "num_env_steps_sampled_lifetime": self._total_env_steps,
+            "episode_return_mean": (float(np.mean(recent))
+                                    if recent else None),
+            "time_total_s": _time.time() - self._start,
+        })
+        return result
+
+    def stop(self) -> None:
+        try:
+            self._env.close()
+        except Exception:
+            pass

@@ -1,0 +1,112 @@
+"""DreamerV3 model-based RL (VERDICT r4 missing #9; ref
+`rllib/algorithms/dreamerv3/`)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from ray_tpu.rllib.algorithms.dreamerv3 import (DreamerV3, DreamerV3Config,
+                                                WorldModel, symexp, symlog)
+
+
+def _tiny_config():
+    cfg = DreamerV3Config()
+    cfg.env = "CartPole-v1"
+    cfg.seed = 0
+    cfg.deter_dim = 32
+    cfg.hidden = 32
+    cfg.stoch_groups = 4
+    cfg.stoch_classes = 4
+    cfg.batch_size_B = 4
+    cfg.batch_length_T = 8
+    cfg.horizon_H = 5
+    cfg.warmup_steps = 64
+    cfg.rollout_fragment_length = 200
+    cfg.updates_per_iteration = 4
+    return cfg
+
+
+def test_symlog_roundtrip():
+    import jax.numpy as jnp
+
+    x = jnp.asarray([-100.0, -1.0, 0.0, 0.5, 1000.0])
+    np.testing.assert_allclose(np.asarray(symexp(symlog(x))),
+                               np.asarray(x), rtol=1e-5)
+
+
+def test_rssm_shapes_and_straight_through():
+    """Posterior/prior steps produce the declared shapes, and gradients
+    flow through the categorical sample (straight-through)."""
+    import jax.numpy as jnp
+
+    cfg = _tiny_config()
+    wm = WorldModel(cfg, obs_dim=4, n_act=2)
+    params = wm.init_params(jax.random.PRNGKey(0))
+    deter = jnp.zeros((3, cfg.deter_dim))
+    stoch = jnp.zeros((3, wm.stoch_dim))
+    a1h = jnp.zeros((3, 2))
+    obs = jnp.ones((3, 4))
+    d2, s2, post_lg, prior_lg = wm.obs_step(
+        params, deter, stoch, a1h, obs, jax.random.PRNGKey(1))
+    assert d2.shape == (3, cfg.deter_dim)
+    assert s2.shape == (3, wm.stoch_dim)
+    assert post_lg.shape == (3, cfg.stoch_groups, cfg.stoch_classes)
+    # one-hot-ish with unimix smoothing baked into the ST pass-through
+    sums = np.asarray(s2.reshape(3, cfg.stoch_groups, cfg.stoch_classes)
+                      .sum(-1))
+    np.testing.assert_allclose(sums, 1.0, atol=1e-5)
+
+    def loss(p):
+        _, s, _, _ = wm.obs_step(p, deter, stoch, a1h, obs,
+                                 jax.random.PRNGKey(1))
+        return jnp.sum(s ** 2)
+
+    g = jax.grad(loss)(params)
+    enc_g = sum(float(jnp.abs(layer["w"]).sum())
+                for layer in g["encoder"])
+    assert enc_g > 0, "no gradient through the categorical sample"
+
+
+def test_world_model_loss_decreases():
+    """A few updates on a fixed batch must drive the WM loss down —
+    recon/reward/cont/KL all train."""
+    cfg = _tiny_config()
+    algo = DreamerV3(cfg)
+    try:
+        algo._sample_steps(300)  # gather real episodes
+        batch = {k: algo._jnp.asarray(v)
+                 for k, v in algo._sample_batch().items()}
+        losses = []
+        key = jax.random.PRNGKey(7)
+        for i in range(30):
+            key, k = jax.random.split(key)
+            new_wm, new_opt, aux = algo._wm_update(
+                algo.params, algo._opt_state, batch, k)
+            algo.params["wm"] = new_wm
+            algo._opt_state["wm"] = new_opt
+            losses.append(float(aux["wm_loss"]))
+        assert losses[-1] < losses[0] * 0.9, losses[:3] + losses[-3:]
+    finally:
+        algo.stop()
+
+
+def test_train_iterations_end_to_end():
+    """Full loop: sample -> world model -> imagination actor-critic.
+    Metrics come back finite and env steps accumulate."""
+    cfg = _tiny_config()
+    algo = DreamerV3(cfg)
+    try:
+        result = None
+        for _ in range(3):
+            result = algo.train()
+        assert result["training_iteration"] == 3
+        assert result["num_env_steps_sampled_lifetime"] >= 3 * 200
+        learner = result["learner"].get("default_policy", {})
+        assert learner, f"no learner metrics: {result}"
+        for k in ("wm_loss", "actor_loss", "critic_loss",
+                  "imagined_return_mean"):
+            assert np.isfinite(learner[k]), (k, learner)
+        assert result["episode_return_mean"] is not None
+    finally:
+        algo.stop()
