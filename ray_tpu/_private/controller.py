@@ -505,10 +505,39 @@ class Controller:
                 return {"stopped": stopped}
             raise ValueError(f"unknown action {tail!r}")
 
+        async def api_events():
+            return await self.rpc_events_list({"limit": 100})
+
+        async def api_task_summary():
+            tasks = await self.rpc_state_tasks({"limit": 5000})
+            summary: Dict[str, Dict[str, int]] = {}
+            for t in tasks:
+                row = summary.setdefault(t.get("name", "?"), {})
+                st = t.get("state", "?")
+                row[st] = row.get(st, 0) + 1
+            return [{"name": n, **states} for n, states in summary.items()]
+
+        async def api_workers():
+            out = []
+            for rec in self.nodes.values():
+                if not rec.alive:
+                    continue
+                try:
+                    r = await self.clients.get(rec.address).call(
+                        "worker_profile", {}, timeout=5)
+                    for w in r["workers"]:
+                        out.append(dict(w, node_id_hex=rec.node_id_hex))
+                except Exception:
+                    continue
+            return out
+
         srv.route("/api/cluster", api_cluster)
         srv.route("/api/nodes", api_nodes)
         srv.route("/api/actors", api_actors)
         srv.route("/api/tasks", api_tasks)
+        srv.route("/api/task_summary", api_task_summary)
+        srv.route("/api/events", api_events)
+        srv.route("/api/workers", api_workers)
         srv.route("/api/jobs", api_jobs_list)
         srv.route("/api/jobs", api_jobs_submit, method="POST")
         srv.route("/api/jobs/*", api_job_detail)
@@ -1174,6 +1203,9 @@ td,th{border:1px solid #444;padding:4px 10px;text-align:left}
 <h1>ray_tpu</h1>
 <div id=cluster></div><h2>Nodes</h2><div id=nodes></div>
 <h2>Actors</h2><div id=actors></div><h2>Jobs</h2><div id=jobs></div>
+<h2>Workers</h2><div id=workers></div>
+<h2>Task summary</h2><div id=tasksum></div>
+<h2>Events</h2><div id=events></div>
 <script>
 function esc(s){return String(s).replace(/&/g,'&amp;').replace(/</g,'&lt;')
  .replace(/>/g,'&gt;').replace(/"/g,'&quot;');}
@@ -1194,6 +1226,18 @@ async function refresh(){
  const j=await (await fetch('/api/jobs')).json();
  document.getElementById('jobs').innerHTML=
   table(j,['job_id','status','entrypoint']);
+ const w=await (await fetch('/api/workers')).json();
+ document.getElementById('workers').innerHTML=
+  table(w,['node_id_hex','worker_id_hex','pid','is_actor',
+           'actor_id_hex']);
+ const ts=await (await fetch('/api/task_summary')).json();
+ const cols=new Set(['name']);
+ for(const r of ts)Object.keys(r).forEach(k=>cols.add(k));
+ document.getElementById('tasksum').innerHTML=table(ts,[...cols]);
+ const ev=await (await fetch('/api/events')).json();
+ document.getElementById('events').innerHTML=
+  table(ev.slice(-40).reverse(),
+        ['severity','source_type','event_type','message']);
 }
 refresh();setInterval(refresh,2000);
 </script></body></html>"""

@@ -110,6 +110,18 @@ class TestDashboard:
         actors = _http(port, "/api/actors")
         assert any(r["class_name"] == "Marker" for r in actors)
         assert _http(port, "/api/tasks") is not None
+        # r5 additions: live workers, task rollup, structured events
+        workers = _http(port, "/api/workers")
+        assert any(w["is_actor"] for w in workers)
+        assert all("node_id_hex" in w and "pid" in w for w in workers)
+        summary = _http(port, "/api/task_summary")
+        assert isinstance(summary, list)
+        events = _http(port, "/api/events")
+        assert any(e["event_type"] == "WORKER_SPAWNED" for e in events)
+        # the page references every section it renders
+        for needle in ("Workers", "Task summary", "Events",
+                       "/api/workers", "/api/task_summary", "/api/events"):
+            assert needle in html
         ray_tpu.kill(a)
 
     def test_unknown_route_404(self, ray_init):
