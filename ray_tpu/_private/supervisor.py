@@ -391,11 +391,19 @@ class Supervisor:
         """Rescue parked leases once the view offers a feasible node."""
         if not self._infeasible_leases:
             return
+        from ray_tpu._private.scheduling import node_satisfies_labels
+
+        my_labels = {**self.labels, "node_name": self.node_name}
         still: List[_QueuedLease] = []
         for q in self._infeasible_leases:
             if q.future.done():
                 continue
-            if self._feasible(q.demand, q.pg_key):
+            # local requeue needs BOTH resources and labels: a lease
+            # parked for a hard label mismatch stays infeasible HERE no
+            # matter how much capacity frees up — only a spill to a
+            # label-satisfying node can serve it
+            if self._feasible(q.demand, q.pg_key) and \
+                    node_satisfies_labels(q.spec.strategy, my_labels):
                 self._lease_queue.append(q)
                 self._pump_lease_queue()
                 continue

@@ -450,6 +450,37 @@ class TestControllerFaultTolerance:
             assert ray_tpu.get(where.remote(), timeout=60) == \
                 target["node_id_hex"]
 
+    def test_label_task_waits_for_matching_node(self, ray_cluster):
+        """A hard-labeled task parked on a non-matching node must stay
+        parked across view-sync ticks (the infeasible requeue used to
+        forget WHY it was parked and grant locally once resources fit),
+        then land on a matching node the moment one joins."""
+        from ray_tpu.util.scheduling_strategies import (
+            In, NodeLabelSchedulingStrategy)
+
+        ray_cluster.add_node(num_cpus=4, labels={"tpu-gen": "v5e"})
+        ray_cluster.wait_for_nodes(1)
+        ray_tpu.init(address=ray_cluster.address)
+
+        @ray_tpu.remote(scheduling_strategy=NodeLabelSchedulingStrategy(
+            hard={"tpu-gen": In("v6e")}))
+        def where():
+            import ray_tpu as rt
+
+            return rt.get_runtime_context().get_node_id()
+
+        ref = where.remote()
+        # several 0.2s sync ticks pass; the bug granted on v5e here
+        ready, _ = ray_tpu.wait([ref], timeout=2.0)
+        assert not ready, "label-infeasible task ran on a non-matching node"
+
+        ray_cluster.add_node(num_cpus=4, labels={"tpu-gen": "v6e"})
+        ray_cluster.wait_for_nodes(2)
+        node_id = ray_tpu.get(ref, timeout=60)
+        target = next(n for n in ray_tpu.nodes()
+                      if n.get("labels", {}).get("tpu-gen") == "v6e")
+        assert node_id == target["node_id_hex"]
+
     def test_remote_store_head_recovery(self, tmp_path):
         """Control plane on a REMOTE URI backend (mock:// fake remote):
         the controller is SIGKILLed and restarted, recovering actors and
