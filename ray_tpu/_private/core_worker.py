@@ -460,6 +460,7 @@ class CoreWorker:
         cap = max(1, self.config.max_tasks_in_flight_per_worker)
         # Least-loaded dispatch: spread tasks across granted leases; only
         # stack (pipeline) onto a busy lease when no more leases are coming.
+        per_lease: Dict[int, Tuple[_Lease, List[_PendingTask]]] = {}
         while queue:
             candidates = [
                 l for l in leases if not l.broken and l.in_flight < cap
@@ -472,7 +473,14 @@ class CoreWorker:
             task = queue.popleft()
             lease.in_flight += 1
             task.lease = lease
-            asyncio.get_running_loop().create_task(self._push(task, lease))
+            per_lease.setdefault(id(lease), (lease, []))[1].append(task)
+        for lease, tasks in per_lease.values():
+            # one push RPC per lease per pump: bursts of pipelined tasks
+            # coalesce into push_task_batch frames exactly like actor
+            # calls do (per-frame socket cost dominated the tasks_async
+            # microbenchmark the same way it did actor calls in r4)
+            asyncio.get_running_loop().create_task(
+                self._push_many(tasks, lease))
         # One lease per queued task (for cluster-wide parallelism), bounded;
         # excess tasks ride pipelining slots on granted leases as they free
         # (≈ direct_task_transport lease amortization + per-task leases).
@@ -617,6 +625,27 @@ class CoreWorker:
             self._record_event(spec, "PUSHED")
         except (RpcConnectionError, RpcTimeoutError, RemoteError) as e:
             await self._on_push_failure(task, lease, e)
+
+    async def _push_many(self, tasks: List[_PendingTask],
+                         lease: _Lease) -> None:
+        """Push a burst destined for one lease as one push_task_batch
+        frame; singletons and batch-delivery failures fall back to the
+        per-task path (the executor dedupes by task id, so re-pushing
+        after an ambiguous batch failure is safe)."""
+        if len(tasks) == 1:
+            await self._push(tasks[0], lease)
+            return
+        try:
+            await self.clients.get(lease.worker_addr).call(
+                "push_task_batch",
+                {"specs": [serialization.dumps(t.spec) for t in tasks]},
+                timeout=self.config.task_push_timeout_s)
+            for t in tasks:
+                self._record_event(t.spec, "PUSHED")
+        except (RpcConnectionError, RpcTimeoutError, RemoteError):
+            for t in tasks:
+                if t.spec.task_id in self._inflight_tasks:
+                    await self._push(t, lease)
 
     async def _on_push_failure(self, task: _PendingTask, lease: _Lease, err) -> None:
         lease.broken = True
