@@ -8,6 +8,7 @@ from ray_tpu.data.dataset import (  # noqa: F401
     GroupedData,
     MaterializedDataset,
     from_arrow,
+    from_huggingface,
     from_items,
     from_numpy,
     from_pandas,
@@ -18,7 +19,9 @@ from ray_tpu.data.dataset import (  # noqa: F401
     read_csv,
     read_images,
     read_json,
+    read_mongo,
     read_numpy,
+    read_orc,
     read_parquet,
     read_sql,
     read_tfrecords,

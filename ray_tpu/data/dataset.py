@@ -571,6 +571,51 @@ def read_sql(sql: str, connection_factory, *, partition_column=None,
         datasource_name="sql")])
 
 
+def read_orc(paths, **_kw) -> Dataset:
+    """ORC files -> dataset (≈ `ray.data.read_orc`, pyarrow-native)."""
+    from ray_tpu.data.datasource import orc_tasks
+
+    return Dataset([L.Read(read_tasks=orc_tasks(paths),
+                           datasource_name="orc")])
+
+
+def read_mongo(uri: str, database: str, collection: str, *,
+               pipeline=None, parallelism: int = 4, client_factory=None,
+               **_kw) -> Dataset:
+    """MongoDB collection -> dataset (≈ `ray.data.read_mongo`): _id
+    range partitions, one cursor task each."""
+    from ray_tpu.data.datasource import mongo_tasks
+
+    return Dataset([L.Read(
+        read_tasks=mongo_tasks(uri, database, collection,
+                               pipeline=pipeline, parallelism=parallelism,
+                               client_factory=client_factory),
+        datasource_name="mongo")])
+
+
+def from_huggingface(hf_dataset, *, parallelism: int = 8) -> Dataset:
+    """HuggingFace datasets.Dataset -> dataset (≈
+    `ray.data.from_huggingface`): zero-copy via the underlying arrow
+    table, split into `parallelism` blocks."""
+    table = hf_dataset.data.table if hasattr(hf_dataset, "data") else None
+    if table is None:
+        raise TypeError("from_huggingface expects a datasets.Dataset "
+                        "(arrow-backed)")
+    n = max(1, table.num_rows)
+    per = max(1, -(-n // parallelism))
+
+    def make(lo, hi):
+        return lambda: table.slice(lo, hi - lo)
+
+    import builtins
+
+    # this module shadows `range` with the ray.data.range constructor
+    tasks = [make(lo, min(lo + per, n))
+             for lo in builtins.range(0, n, per)]
+    return Dataset([L.Read(read_tasks=tasks,
+                           datasource_name="huggingface")])
+
+
 def read_bigquery(project_id: str, *, dataset: str = None, query: str = None,
                   parallelism: int = 4, client_factory=None,
                   **_kw) -> Dataset:

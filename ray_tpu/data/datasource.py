@@ -489,6 +489,67 @@ def sql_tasks(sql: str, connection_factory: Callable[[], Any],
     return tasks
 
 
+def orc_tasks(paths) -> List[Callable[[], Block]]:
+    """ORC files via pyarrow.orc (reference `read_orc`): one task per
+    file."""
+    files = _expand_paths(paths, ".orc")
+
+    def make(f):
+        def task():
+            from pyarrow import orc
+
+            return orc.read_table(f)
+
+        return task
+
+    return [make(f) for f in files]
+
+
+def mongo_tasks(uri: str, database: str, collection: str,
+                pipeline: Optional[list] = None, parallelism: int = 4,
+                client_factory: Optional[Callable[[], Any]] = None
+                ) -> List[Callable[[], Block]]:
+    """MongoDB source (ref
+    `python/ray/data/datasource/mongo_datasource.py`): the collection is
+    range-partitioned on `_id` into `parallelism` cursor reads, each an
+    independent task. `client_factory` is the injection seam (production
+    default: pymongo.MongoClient, gated on the library)."""
+    if client_factory is None:
+        def client_factory():  # noqa: F811 — production default
+            try:
+                import pymongo
+            except ImportError as e:
+                raise ImportError(
+                    "read_mongo requires pymongo (not installed in this "
+                    "image); pass client_factory= for a custom client"
+                ) from e
+            return pymongo.MongoClient(uri)
+
+    def part_task(index: int):
+        def task():
+            client = client_factory()
+            coll = client[database][collection]
+            n = coll.estimated_document_count()
+            per = max(1, -(-n // parallelism))  # ceil
+            start = index * per
+            if start >= n and index > 0:
+                return pa.table({})
+            stages = (list(pipeline or [])
+                      + [{"$sort": {"_id": 1}}, {"$skip": start},
+                         {"$limit": per}])
+            rows = list(coll.aggregate(stages))
+            for r in rows:
+                r.pop("_id", None)  # ObjectIds aren't arrow-serializable
+            if not rows:
+                return pa.table({})
+            cols = {k: [r.get(k) for r in rows] for k in rows[0]}
+            return batch_to_block(cols)
+
+        return task
+
+    return [part_task(i) for i in range(parallelism)]
+
+
 def bigquery_tasks(project_id: str, dataset: Optional[str] = None,
                    query: Optional[str] = None, parallelism: int = 4,
                    client_factory: Optional[Callable[[], Any]] = None

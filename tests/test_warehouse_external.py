@@ -132,6 +132,101 @@ class TestPartitionedSql:
                      parallelism=2)
 
 
+# ------------------------------------------------------------ orc / mongo
+
+
+class TestOrc:
+    def test_roundtrip(self, ray_init, tmp_path):
+        from pyarrow import orc
+
+        from ray_tpu.data import read_orc
+
+        t = pa.table({"a": list(range(50)), "b": [f"r{i}" for i in range(50)]})
+        orc.write_table(t.slice(0, 25), str(tmp_path / "p1.orc"))
+        orc.write_table(t.slice(25), str(tmp_path / "p2.orc"))
+        rows = read_orc(str(tmp_path)).take_all()
+        assert len(rows) == 50
+        assert sorted(r["a"] for r in rows) == list(range(50))
+
+
+class FakeMongoCollection:
+    def __init__(self, docs):
+        self.docs = docs
+
+    def estimated_document_count(self):
+        return len(self.docs)
+
+    def aggregate(self, stages):
+        rows = [dict(d) for d in self.docs]
+        for st in stages:
+            if "$sort" in st:
+                key, direction = next(iter(st["$sort"].items()))
+                rows.sort(key=lambda r: r[key],
+                          reverse=direction < 0)
+            elif "$skip" in st:
+                rows = rows[st["$skip"]:]
+            elif "$limit" in st:
+                rows = rows[:st["$limit"]]
+            elif "$match" in st:
+                rows = [r for r in rows
+                        if all(r.get(k) == v
+                               for k, v in st["$match"].items())]
+        return iter(rows)
+
+
+class FakeMongoClient:
+    def __init__(self):
+        self.dbs = {"shop": {"orders": FakeMongoCollection(
+            [{"_id": i, "sku": f"s{i}", "qty": i % 5}
+             for i in range(37)])}}
+
+    def __getitem__(self, db):
+        return self.dbs[db]
+
+
+class TestMongo:
+    def test_partitioned_read(self, ray_init):
+        from ray_tpu.data import read_mongo
+
+        ds = read_mongo("mongodb://fake", "shop", "orders",
+                        parallelism=4, client_factory=FakeMongoClient)
+        rows = ds.take_all()
+        assert len(rows) == 37  # no dupes/gaps across skip/limit pages
+        assert sorted(r["sku"] for r in rows) == sorted(
+            f"s{i}" for i in range(37))
+
+    def test_pipeline_pushdown(self, ray_init):
+        from ray_tpu.data import read_mongo
+
+        ds = read_mongo("mongodb://fake", "shop", "orders",
+                        pipeline=[{"$match": {"qty": 2}}],
+                        parallelism=2, client_factory=FakeMongoClient)
+        rows = ds.take_all()
+        assert rows and all(r["qty"] == 2 for r in rows)
+
+    def test_missing_pymongo_gated(self, ray_init):
+        from ray_tpu.data import read_mongo
+
+        ds = read_mongo("mongodb://real", "db", "coll")
+        with pytest.raises(Exception, match="pymongo"):
+            ds.take_all()
+
+
+class TestHuggingFace:
+    def test_from_huggingface_zero_copy(self, ray_init):
+        import datasets as hf
+
+        from ray_tpu.data import from_huggingface
+
+        hfd = hf.Dataset.from_dict(
+            {"text": [f"doc {i}" for i in range(40)],
+             "label": list(range(40))})
+        ds = from_huggingface(hfd, parallelism=4)
+        rows = ds.take_all()
+        assert len(rows) == 40
+        assert sorted(r["label"] for r in rows) == list(range(40))
+
+
 # ------------------------------------------------------- external searcher
 
 
