@@ -1,0 +1,39 @@
+// Demo: invoke a ray_tpu Serve app from native C++ over the RPC ingress.
+//
+//   g++ -O2 -std=c++17 -o serve_demo demo.cpp
+//   ./serve_demo <host> <port> <app> [prompt]
+//
+// Prints the reply's "text" field (LLM apps) or a rendering of the
+// whole result.
+
+#include <iostream>
+
+#include "serve_client.hpp"
+
+using ray_tpu_serve::ServeRpcClient;
+using ray_tpu_serve::Value;
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    std::cerr << "usage: " << argv[0] << " <host> <port> <app> [prompt]\n";
+    return 2;
+  }
+  try {
+    ServeRpcClient client(argv[1], std::stoi(argv[2]));
+    std::map<std::string, ray_tpu_serve::ValuePtr> payload;
+    payload["prompt"] = Value::str(argc > 4 ? argv[4] : "hello from c++");
+    auto result = client.invoke(argv[3], payload);
+    if (result->has("text")) {
+      std::cout << result->at("text").s << "\n";
+    } else {
+      std::cout << ServeRpcClient::describe(*result) << "\n";
+      for (const auto& kv : result->dict)
+        std::cout << "  " << kv.first << " = "
+                  << ServeRpcClient::describe(*kv.second) << "\n";
+    }
+    return 0;
+  } catch (const std::exception& e) {
+    std::cerr << "error: " << e.what() << "\n";
+    return 1;
+  }
+}

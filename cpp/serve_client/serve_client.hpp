@@ -1,0 +1,418 @@
+// Native C++ client for the ray_tpu Serve RPC ingress.
+//
+// Role-parity with the reference's C++ frontend (`cpp/src/ray/api.cc`)
+// at the boundary a TPU serving user actually needs: a dependency-free
+// client (POSIX sockets, no Python, no gRPC) that speaks the
+// framework's length-prefixed wire protocol (`_private/rpc.py`:
+// 4-byte big-endian length + pickle of (kind, msg_id, method, body)).
+//
+// Requests are emitted as protocol-2 pickles (the server's
+// pickle.loads accepts any protocol); replies are decoded with a
+// bounded pickle-subset reader covering the plain-data opcodes the
+// serve result path produces (dict/list/tuple/str/bytes/int/float/
+// bool/None, protocols 2-5 incl. FRAME and memoization).
+
+#pragma once
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace ray_tpu_serve {
+
+// ------------------------------------------------------------------ Value
+
+struct Value;
+using ValuePtr = std::shared_ptr<Value>;
+
+struct Value {
+  enum class Kind { None, Bool, Int, Float, Str, Bytes, List, Dict };
+  Kind kind = Kind::None;
+  bool b = false;
+  int64_t i = 0;
+  double f = 0.0;
+  std::string s;                       // Str and Bytes
+  std::vector<ValuePtr> list;          // List (and tuples)
+  std::map<std::string, ValuePtr> dict;
+
+  static ValuePtr none() { return std::make_shared<Value>(); }
+  static ValuePtr str(std::string v) {
+    auto p = std::make_shared<Value>();
+    p->kind = Kind::Str;
+    p->s = std::move(v);
+    return p;
+  }
+  static ValuePtr num(int64_t v) {
+    auto p = std::make_shared<Value>();
+    p->kind = Kind::Int;
+    p->i = v;
+    return p;
+  }
+
+  const Value& at(const std::string& key) const {
+    auto it = dict.find(key);
+    if (it == dict.end()) throw std::runtime_error("no key: " + key);
+    return *it->second;
+  }
+  bool has(const std::string& key) const { return dict.count(key) > 0; }
+};
+
+// ------------------------------------------------------- pickle encoding
+
+class PickleWriter {
+ public:
+  std::string out;
+
+  void proto2() { out += "\x80\x02"; }
+  void none() { out += 'N'; }
+  void boolean(bool v) { out += v ? '\x88' : '\x89'; }
+  void int32(int64_t v) {
+    out += 'J';  // BININT, little-endian signed 4 bytes
+    uint32_t u = static_cast<uint32_t>(static_cast<int32_t>(v));
+    for (int k = 0; k < 4; k++) out += static_cast<char>((u >> (8 * k)) & 0xff);
+  }
+  void str(const std::string& v) {
+    out += 'X';  // BINUNICODE: 4-byte LE length + utf8
+    uint32_t n = v.size();
+    for (int k = 0; k < 4; k++) out += static_cast<char>((n >> (8 * k)) & 0xff);
+    out += v;
+  }
+  void mark() { out += '('; }
+  void tuple() { out += 't'; }      // from mark
+  void empty_dict() { out += '}'; }
+  void setitems() { out += 'u'; }   // from mark: k v k v ...
+  void stop() { out += '.'; }
+
+  void value(const Value& v) {
+    switch (v.kind) {
+      case Value::Kind::None: none(); break;
+      case Value::Kind::Bool: boolean(v.b); break;
+      case Value::Kind::Int: int32(v.i); break;
+      case Value::Kind::Str: str(v.s); break;
+      case Value::Kind::Dict: {
+        empty_dict();
+        mark();
+        for (const auto& kv : v.dict) {
+          str(kv.first);
+          value(*kv.second);
+        }
+        setitems();
+        break;
+      }
+      default:
+        throw std::runtime_error("unsupported request value kind");
+    }
+  }
+};
+
+// ------------------------------------------------------- pickle decoding
+
+class PickleReader {
+ public:
+  explicit PickleReader(const std::string& data) : d_(data) {}
+
+  ValuePtr parse() {
+    std::vector<ValuePtr> stack;
+    std::vector<size_t> marks;
+    while (pos_ < d_.size()) {
+      unsigned char op = u8();
+      switch (op) {
+        case 0x80: u8(); break;                  // PROTO n
+        case 0x95: skip(8); break;               // FRAME len
+        case '.':                                 // STOP
+          if (stack.empty()) throw err("empty stack at STOP");
+          return stack.back();
+        case 'N': stack.push_back(Value::none()); break;
+        case 0x88: stack.push_back(mk_bool(true)); break;   // NEWTRUE
+        case 0x89: stack.push_back(mk_bool(false)); break;  // NEWFALSE
+        case 'J': stack.push_back(Value::num(i32())); break;    // BININT
+        case 'K': stack.push_back(Value::num(u8())); break;     // BININT1
+        case 'M': stack.push_back(Value::num(u16())); break;    // BININT2
+        case 0x8a: {                              // LONG1
+          unsigned n = u8();
+          int64_t v = 0;
+          for (unsigned k = 0; k < n; k++)
+            v |= static_cast<int64_t>(u8()) << (8 * k);
+          if (n && (d_[pos_ - 1] & 0x80))          // sign-extend
+            for (unsigned k = n; k < 8; k++)
+              v |= static_cast<int64_t>(0xff) << (8 * k);
+          stack.push_back(Value::num(v));
+          break;
+        }
+        case 'G': {                               // BINFLOAT (big-endian)
+          uint64_t u = 0;
+          for (int k = 0; k < 8; k++) u = (u << 8) | u8();
+          double f;
+          std::memcpy(&f, &u, 8);
+          auto p = std::make_shared<Value>();
+          p->kind = Value::Kind::Float;
+          p->f = f;
+          stack.push_back(p);
+          break;
+        }
+        case 0x8c: stack.push_back(Value::str(take(u8()))); break;
+        case 'X': stack.push_back(Value::str(take(u32()))); break;
+        case 0x8d: stack.push_back(Value::str(take(u64()))); break;
+        case 'C': stack.push_back(mk_bytes(take(u8()))); break;
+        case 'B': stack.push_back(mk_bytes(take(u32()))); break;
+        case 0x8e: stack.push_back(mk_bytes(take(u64()))); break;
+        case 0x94:                                 // MEMOIZE
+          memo_.push_back(stack.back());
+          break;
+        case 'q': memo_put(u8(), stack.back()); break;
+        case 'r': memo_put(u32(), stack.back()); break;
+        case 'h': stack.push_back(memo_get(u8())); break;
+        case 'j': stack.push_back(memo_get(u32())); break;
+        case '(': marks.push_back(stack.size()); break;
+        case 't': collect_tuple(stack, pop_mark(marks)); break;
+        case 0x85: collect_tuple(stack, stack.size() - 1); break;
+        case 0x86: collect_tuple(stack, stack.size() - 2); break;
+        case 0x87: collect_tuple(stack, stack.size() - 3); break;
+        case ')': stack.push_back(mk_list()); break;  // EMPTY_TUPLE
+        case ']': stack.push_back(mk_list()); break;  // EMPTY_LIST
+        case 'e': {                                // APPENDS
+          size_t m = pop_mark(marks);
+          auto& lst = *stack[m - 1];
+          for (size_t k = m; k < stack.size(); k++) lst.list.push_back(stack[k]);
+          stack.resize(m);
+          break;
+        }
+        case 'a': {                                // APPEND
+          auto v = stack.back();
+          stack.pop_back();
+          stack.back()->list.push_back(v);
+          break;
+        }
+        case '}': {
+          auto p = std::make_shared<Value>();
+          p->kind = Value::Kind::Dict;
+          stack.push_back(p);
+          break;
+        }
+        case 'u': {                                // SETITEMS
+          size_t m = pop_mark(marks);
+          auto& dct = *stack[m - 1];
+          for (size_t k = m; k + 1 < stack.size(); k += 2)
+            dct.dict[key_of(stack[k])] = stack[k + 1];
+          stack.resize(m);
+          break;
+        }
+        case 's': {                                // SETITEM
+          auto v = stack.back();
+          stack.pop_back();
+          auto k = stack.back();
+          stack.pop_back();
+          stack.back()->dict[key_of(k)] = v;
+          break;
+        }
+        default:
+          throw err("unsupported pickle opcode 0x" + hex(op));
+      }
+    }
+    throw err("pickle ended without STOP");
+  }
+
+ private:
+  const std::string& d_;
+  size_t pos_ = 0;
+  std::vector<ValuePtr> memo_;
+
+  std::runtime_error err(const std::string& m) const {
+    return std::runtime_error("pickle decode: " + m);
+  }
+  static std::string hex(unsigned char c) {
+    const char* digits = "0123456789abcdef";
+    return std::string() + digits[c >> 4] + digits[c & 0xf];
+  }
+  unsigned char u8() {
+    if (pos_ >= d_.size()) throw err("truncated");
+    return static_cast<unsigned char>(d_[pos_++]);
+  }
+  uint16_t u16() { uint16_t v = u8(); return v | (u8() << 8); }
+  uint32_t u32() {
+    uint32_t v = 0;
+    for (int k = 0; k < 4; k++) v |= static_cast<uint32_t>(u8()) << (8 * k);
+    return v;
+  }
+  uint64_t u64() {
+    uint64_t v = 0;
+    for (int k = 0; k < 8; k++) v |= static_cast<uint64_t>(u8()) << (8 * k);
+    return v;
+  }
+  int32_t i32() { return static_cast<int32_t>(u32()); }
+  void skip(size_t n) {
+    if (pos_ + n > d_.size()) throw err("truncated skip");
+    pos_ += n;
+  }
+  std::string take(size_t n) {
+    if (pos_ + n > d_.size()) throw err("truncated string");
+    std::string out = d_.substr(pos_, n);
+    pos_ += n;
+    return out;
+  }
+  static ValuePtr mk_bool(bool b) {
+    auto p = std::make_shared<Value>();
+    p->kind = Value::Kind::Bool;
+    p->b = b;
+    return p;
+  }
+  static ValuePtr mk_bytes(std::string s) {
+    auto p = std::make_shared<Value>();
+    p->kind = Value::Kind::Bytes;
+    p->s = std::move(s);
+    return p;
+  }
+  static ValuePtr mk_list() {
+    auto p = std::make_shared<Value>();
+    p->kind = Value::Kind::List;
+    return p;
+  }
+  static std::string key_of(const ValuePtr& v) {
+    if (v->kind != Value::Kind::Str)
+      throw std::runtime_error("non-string dict key in reply");
+    return v->s;
+  }
+  void memo_put(size_t idx, ValuePtr v) {
+    if (memo_.size() <= idx) memo_.resize(idx + 1);
+    memo_[idx] = std::move(v);
+  }
+  ValuePtr memo_get(size_t idx) {
+    if (idx >= memo_.size() || !memo_[idx]) throw err("bad memo ref");
+    return memo_[idx];
+  }
+  size_t pop_mark(std::vector<size_t>& marks) {
+    if (marks.empty()) throw err("no mark");
+    size_t m = marks.back();
+    marks.pop_back();
+    return m;
+  }
+  void collect_tuple(std::vector<ValuePtr>& stack, size_t from) {
+    auto p = mk_list();  // tuples surface as lists
+    for (size_t k = from; k < stack.size(); k++) p->list.push_back(stack[k]);
+    stack.resize(from);
+    stack.push_back(p);
+  }
+};
+
+// ------------------------------------------------------------- transport
+
+class ServeRpcClient {
+ public:
+  ServeRpcClient(const std::string& host, int port) {
+    addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    if (getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints,
+                    &res) != 0 || res == nullptr)
+      throw std::runtime_error("resolve failed: " + host);
+    fd_ = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+    if (fd_ < 0 || ::connect(fd_, res->ai_addr, res->ai_addrlen) != 0) {
+      freeaddrinfo(res);
+      throw std::runtime_error("connect failed: " + host + ":" +
+                               std::to_string(port));
+    }
+    freeaddrinfo(res);
+  }
+  ~ServeRpcClient() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  // invoke(app, payload): payload is a string->Value dict shipped as the
+  // deployment's request; returns the "result" value of the reply.
+  ValuePtr invoke(const std::string& app,
+                  const std::map<std::string, ValuePtr>& payload) {
+    Value body;
+    body.kind = Value::Kind::Dict;
+    auto pay = std::make_shared<Value>();
+    pay->kind = Value::Kind::Dict;
+    pay->dict = payload;
+    body.dict["app"] = Value::str(app);
+    body.dict["payload"] = pay;
+    body.dict["method"] = Value::none();
+    body.dict["multiplexed_model_id"] = Value::str("");
+    body.dict["args"] = Value::none();
+    body.dict["kwargs"] = Value::none();
+
+    PickleWriter w;
+    w.proto2();
+    w.mark();
+    w.int32(0);            // kind = REQUEST
+    w.int32(++msg_id_);    // msg id
+    w.str("invoke");
+    w.value(body);
+    w.tuple();
+    w.stop();
+    send_frame(w.out);
+
+    std::string reply = recv_frame();
+    auto tup = PickleReader(reply).parse();
+    if (tup->list.size() != 4) throw std::runtime_error("bad reply tuple");
+    int64_t kind = tup->list[0]->i;
+    const auto& payload_out = tup->list[3];
+    if (kind == 2)  // ERROR
+      throw std::runtime_error("server error: " + describe(*payload_out));
+    return payload_out->dict.count("result") ? payload_out->dict["result"]
+                                             : payload_out;
+  }
+
+  static std::string describe(const Value& v) {
+    switch (v.kind) {
+      case Value::Kind::Str: return v.s;
+      case Value::Kind::Int: return std::to_string(v.i);
+      case Value::Kind::Float: return std::to_string(v.f);
+      case Value::Kind::Bool: return v.b ? "true" : "false";
+      case Value::Kind::None: return "none";
+      default: return "<composite>";
+    }
+  }
+
+ private:
+  int fd_ = -1;
+  int msg_id_ = 0;
+
+  // the wire length prefix is LITTLE-endian (struct "<I" in rpc.py)
+  void send_frame(const std::string& payload) {
+    uint32_t n = payload.size();
+    char hdr[4];
+    for (int k = 0; k < 4; k++) hdr[k] = static_cast<char>((n >> (8 * k)) & 0xff);
+    write_all(hdr, 4);
+    write_all(payload.data(), payload.size());
+  }
+  std::string recv_frame() {
+    char hdr[4];
+    read_all(hdr, 4);
+    uint32_t n = 0;
+    for (int k = 0; k < 4; k++)
+      n |= static_cast<uint32_t>(static_cast<unsigned char>(hdr[k])) << (8 * k);
+    std::string out(n, '\0');
+    read_all(out.data(), n);
+    return out;
+  }
+  void write_all(const char* p, size_t n) {
+    while (n) {
+      ssize_t w = ::write(fd_, p, n);
+      if (w <= 0) throw std::runtime_error("socket write failed");
+      p += w;
+      n -= static_cast<size_t>(w);
+    }
+  }
+  void read_all(char* p, size_t n) {
+    while (n) {
+      ssize_t r = ::read(fd_, p, n);
+      if (r <= 0) throw std::runtime_error("socket read failed");
+      p += r;
+      n -= static_cast<size_t>(r);
+    }
+  }
+};
+
+}  // namespace ray_tpu_serve
