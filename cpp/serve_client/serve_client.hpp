@@ -4,7 +4,7 @@
 // at the boundary a TPU serving user actually needs: a dependency-free
 // client (POSIX sockets, no Python, no gRPC) that speaks the
 // framework's length-prefixed wire protocol (`_private/rpc.py`:
-// 4-byte big-endian length + pickle of (kind, msg_id, method, body)).
+// 4-byte little-endian length + pickle of (kind, msg_id, method, body)).
 //
 // Requests are emitted as protocol-2 pickles (the server's
 // pickle.loads accepts any protocol); replies are decoded with a
@@ -317,6 +317,7 @@ class ServeRpcClient {
     fd_ = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
     if (fd_ < 0 || ::connect(fd_, res->ai_addr, res->ai_addrlen) != 0) {
       freeaddrinfo(res);
+      if (fd_ >= 0) ::close(fd_);  // dtor won't run for a throwing ctor
       throw std::runtime_error("connect failed: " + host + ":" +
                                std::to_string(port));
     }

@@ -518,18 +518,21 @@ class Controller:
             return [{"name": n, **states} for n, states in summary.items()]
 
         async def api_workers():
-            out = []
-            for rec in self.nodes.values():
-                if not rec.alive:
-                    continue
+            alive = [r for r in self.nodes.values() if r.alive]
+
+            async def one(rec):
                 try:
                     r = await self.clients.get(rec.address).call(
                         "worker_profile", {}, timeout=5)
-                    for w in r["workers"]:
-                        out.append(dict(w, node_id_hex=rec.node_id_hex))
+                    return [dict(w, node_id_hex=rec.node_id_hex)
+                            for w in r["workers"]]
                 except Exception:
-                    continue
-            return out
+                    return []
+
+            # concurrent fan-out: one unreachable node costs one probe
+            # timeout for the whole response, not 5s x nodes serially
+            groups = await asyncio.gather(*(one(r) for r in alive))
+            return [w for grp in groups for w in grp]
 
         srv.route("/api/cluster", api_cluster)
         srv.route("/api/nodes", api_nodes)

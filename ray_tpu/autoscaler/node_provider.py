@@ -32,7 +32,13 @@ class NodeType:
 
 
 class NodeProvider:
-    """Minimal provider surface the autoscaler drives."""
+    """Minimal provider surface the autoscaler drives.
+
+    Contract for v2 reconciliation: a provider must arrange for each
+    launched node's supervisor to advertise the node label
+    ``provider_id=<its provider node id>`` — that label is the join key
+    between the cloud view and the control-plane view
+    (`autoscaler/v2.py` ``Reconciler._sync_cluster``)."""
 
     def create_node(self, node_type: NodeType, count: int) -> List[str]:
         """Launch `count` nodes of the type; returns provider node ids."""
@@ -81,6 +87,12 @@ class LocalNodeProvider(NodeProvider):
                     self._controller_addr,
                     resources=dict(resources),
                     node_name=pid,
+                    # the provider<->control-plane join key the v2
+                    # reconciler matches on (v2.py _sync_cluster); every
+                    # NodeProvider must arrange for the node's supervisor
+                    # to advertise it
+                    labels={"provider_id": pid,
+                            "node_type": node_type.name},
                 )
                 self._nodes[pid] = {
                     "id": pid,

@@ -123,6 +123,10 @@ class Reconciler:
         self.im = im or InstanceManager()
         self.idle_timeout_s = idle_timeout_s
         self._idle_since: Dict[str, float] = {}
+        # node types whose timed-out allocation requests may still fill
+        # late; one marker per abandoned request, consumed by
+        # terminating the stray node it eventually produces
+        self._abandoned_requests: List[str] = []
 
     # ---- observation sync ------------------------------------------
 
@@ -146,6 +150,26 @@ class Reconciler:
                   > self.ALLOCATION_TIMEOUT_S):
                 self.im.transition(inst, ALLOCATION_FAILED,
                                    "allocation timed out (stockout?)")
+                # the cloud request is still outstanding: if it fills
+                # AFTER the retry's request, the stray node must be
+                # terminated, not silently leaked as a billable orphan
+                self._abandoned_requests.append(inst.node_type)
+        # reap late fills of abandoned requests: a live provider node no
+        # instance claims, of an abandoned type, is terminated (consume
+        # one marker per node so legitimate future launches still adopt)
+        if self._abandoned_requests:
+            claimed = {i.provider_id for i in self.im.instances.values()
+                       if i.provider_id}
+            for pid, n in list(live.items()):
+                if pid in claimed:
+                    continue
+                if n["node_type"] in self._abandoned_requests and not any(
+                        i.status == REQUESTED
+                        and i.node_type == n["node_type"]
+                        for i in self.im.instances.values()):
+                    self._abandoned_requests.remove(n["node_type"])
+                    self.provider.terminate_node(pid)
+                    live.pop(pid, None)
         for inst in self.im.by_status(ALLOCATED, RAY_RUNNING):
             if inst.provider_id not in live:
                 # the cloud reclaimed it under us (preemption)

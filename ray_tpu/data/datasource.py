@@ -529,20 +529,25 @@ def mongo_tasks(uri: str, database: str, collection: str,
         def task():
             client = client_factory()
             coll = client[database][collection]
+            # page size from the (metadata-based, possibly stale)
+            # estimate; correctness never depends on it: the LAST
+            # partition reads unbounded, so an undercount or a
+            # cardinality-changing pipeline can skew balance but can
+            # never silently drop trailing documents
             n = coll.estimated_document_count()
             per = max(1, -(-n // parallelism))  # ceil
             start = index * per
-            if start >= n and index > 0:
-                return pa.table({})
             stages = (list(pipeline or [])
-                      + [{"$sort": {"_id": 1}}, {"$skip": start},
-                         {"$limit": per}])
+                      + [{"$sort": {"_id": 1}}, {"$skip": start}])
+            if index < parallelism - 1:
+                stages.append({"$limit": per})
             rows = list(coll.aggregate(stages))
             for r in rows:
                 r.pop("_id", None)  # ObjectIds aren't arrow-serializable
             if not rows:
                 return pa.table({})
-            cols = {k: [r.get(k) for r in rows] for k in rows[0]}
+            keys = sorted({k for r in rows for k in r})  # union schema
+            cols = {k: [r.get(k) for r in rows] for k in keys}
             return batch_to_block(cols)
 
         return task

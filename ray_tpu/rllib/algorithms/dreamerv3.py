@@ -492,8 +492,13 @@ class DreamerV3(Algorithm):
         cfg = self.config
         self._sample_steps(cfg.rollout_fragment_length)
         metrics: Dict[str, Any] = {}
-        if self._replay_steps < max(cfg.batch_length_T * 2,
-                                    cfg.warmup_steps // 4):
+        # gate on SAMPLABLE steps: only length>=2 episodes can feed
+        # _sample_batch, so a replay full of one-step episodes must keep
+        # waiting instead of crashing the sampler
+        eligible_steps = sum(len(e["reward"]) for e in self._episodes
+                             if len(e["reward"]) >= 2)
+        if eligible_steps < max(cfg.batch_length_T * 2,
+                                cfg.warmup_steps // 4):
             return {"learner": {}, "waiting_for_replay": True}
         for _ in range(cfg.updates_per_iteration):
             batch = {k: self._jnp.asarray(v)

@@ -159,6 +159,27 @@ class TestReconciler:
         assert prov.terminated == [pid]
         assert r.im.by_status(TERMINATED)
 
+    def test_late_filled_abandoned_request_is_reaped(self):
+        """A request that times out and is retried may still fill later;
+        the stray node (no instance left to claim it) must be terminated,
+        not leaked as a billable orphan."""
+        prov = FakeProvider(stockout_types={"cpu4"})
+        r = Reconciler(_config(), prov)
+        r.ALLOCATION_TIMEOUT_S = 0.0
+        head = _node("head", cpu=0.0)
+        r.reconcile(_state([head], demand_on_first=[{"CPU": 4.0}]))
+        # timeout -> ALLOCATION_FAILED -> retry (still stockout)
+        r.reconcile(_state([head], demand_on_first=[{"CPU": 4.0}]))
+        # exhaust retries so no REQUESTED instance remains
+        for _ in range(8):
+            r.reconcile(_state([head]))
+        prov.stockout_types = set()
+        prov._n += 1
+        pid = f"prov-{prov._n}"
+        prov.live[pid] = {"id": pid, "node_type": "cpu4"}
+        r.reconcile(_state([head]))
+        assert pid in prov.terminated, "late-filled orphan not reaped"
+
     def test_dead_ray_node_terminated_at_provider(self):
         prov = FakeProvider()
         r = Reconciler(_config(), prov)

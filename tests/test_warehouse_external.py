@@ -195,6 +195,40 @@ class TestMongo:
         assert sorted(r["sku"] for r in rows) == sorted(
             f"s{i}" for i in range(37))
 
+    def test_stale_count_estimate_loses_nothing(self, ray_init):
+        """estimated_document_count is metadata-based and can undercount;
+        the unbounded last partition must still read every document."""
+        from ray_tpu.data import read_mongo
+
+        class Undercount(FakeMongoClient):
+            def __init__(self):
+                super().__init__()
+                coll = self.dbs["shop"]["orders"]
+                real_count = coll.estimated_document_count
+
+                coll.estimated_document_count = lambda: max(
+                    1, real_count() // 2)  # stale metadata
+
+        ds = read_mongo("mongodb://fake", "shop", "orders",
+                        parallelism=4, client_factory=Undercount)
+        rows = ds.take_all()
+        assert len(rows) == 37
+
+    def test_heterogeneous_docs_union_schema(self, ray_init):
+        from ray_tpu.data import read_mongo
+
+        class Hetero(FakeMongoClient):
+            def __init__(self):
+                self.dbs = {"shop": {"orders": FakeMongoCollection(
+                    [{"_id": 0, "a": 1},
+                     {"_id": 1, "a": 2, "extra": "x"}])}}
+
+        rows = read_mongo("mongodb://fake", "shop", "orders",
+                          parallelism=1,
+                          client_factory=Hetero).take_all()
+        assert len(rows) == 2
+        assert any(r.get("extra") == "x" for r in rows)
+
     def test_pipeline_pushdown(self, ray_init):
         from ray_tpu.data import read_mongo
 
