@@ -82,7 +82,7 @@ class TestASGIIngress:
 
         port = self._run_app()
         base = f"http://127.0.0.1:{port}/api"
-        r = httpx.post(base + "/", content="hello", timeout=30)
+        r = httpx.post(base + "/", content="hello", timeout=120)
         assert r.status_code == 200
         assert r.headers["x-app"] == "asgi-echo"
         out = r.json()
@@ -97,7 +97,7 @@ class TestASGIIngress:
         chunks = []
         with httpx.stream(
                 "GET", f"http://127.0.0.1:{port}/api/stream",
-                timeout=30) as r:
+                timeout=120) as r:
             assert r.status_code == 200
             for chunk in r.iter_raw():
                 chunks.append(chunk)
@@ -107,7 +107,7 @@ class TestASGIIngress:
         import httpx
 
         port = self._run_app()
-        r = httpx.get(f"http://127.0.0.1:{port}/api/boom", timeout=30)
+        r = httpx.get(f"http://127.0.0.1:{port}/api/boom", timeout=120)
         assert r.status_code == 500
         assert "app exploded" in r.text
 
@@ -115,7 +115,7 @@ class TestASGIIngress:
         import httpx
 
         port = self._run_app()
-        r = httpx.get(f"http://127.0.0.1:{port}/api/missing", timeout=30)
+        r = httpx.get(f"http://127.0.0.1:{port}/api/missing", timeout=120)
         assert r.status_code == 404
 
     def test_websocket_echo(self, serve_shutdown):
@@ -127,7 +127,7 @@ class TestASGIIngress:
             async with aiohttp.ClientSession() as sess:
                 async with sess.ws_connect(
                         f"http://127.0.0.1:{port}/api/ws",
-                        timeout=aiohttp.ClientWSTimeout(ws_close=30)
+                        timeout=aiohttp.ClientWSTimeout(ws_close=120)
                         if hasattr(aiohttp, "ClientWSTimeout") else 30
                 ) as ws:
                     await ws.send_str("hi")
@@ -157,5 +157,5 @@ class TestASGIIngress:
         serve.run(Plain.bind(), name="plain", route_prefix="/plain")
         port = serve.start(http_port=0)
         r = httpx.post(f"http://127.0.0.1:{port}/plain", json=21,
-                       timeout=30)
+                       timeout=120)
         assert r.json() == {"doubled": 42}
