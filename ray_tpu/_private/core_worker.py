@@ -1187,9 +1187,39 @@ class CoreWorker:
             # metadata ever crosses the wire (device_objects.py)
             self._run(self._async_store_device(oid, value))
             return oid, self.address
-        packed = serialization.pack(value)
-        entry = self._run(self._async_store_owned(oid, packed))
+        meta, buffers, total = serialization.packed_size(value)
+        if (total <= self.config.max_direct_call_object_size
+                or self.supervisor_addr is None or self.arena is None):
+            entry = self._run(self._async_store_owned(
+                oid, serialization.pack_parts(meta, buffers)))
+        else:
+            # arena path: write the parts piecewise straight into the
+            # mmap — one memcpy per payload buffer instead of join+copy
+            # (halves host traffic for GiB-class numpy/jax payloads)
+            entry = self._run(
+                self._async_store_parts(oid, meta, buffers, total))
         return oid, self.address
+
+    async def _async_store_parts(self, oid: ObjectID, meta: bytes,
+                                 buffers, total: int) -> ObjectEntry:
+        entry = self._ensure_entry(oid)
+        sup = self.clients.get(self.supervisor_addr)
+        # 600s: creating a GiB-class object can sit behind another
+        # object's multi-GB spill on the store thread
+        r = await sup.call("store_create",
+                           {"object_id": oid.binary(), "size": total},
+                           timeout=600)
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(
+            None, serialization.write_packed,
+            self.arena.view(r["offset"], total), meta, buffers)
+        await sup.call("store_seal", {"object_id": oid.binary()},
+                       timeout=600)
+        entry.state = SHARED
+        entry.size = total
+        entry.location = self.supervisor_addr
+        self._wake(entry)
+        return entry
 
     async def _async_store_device(self, oid: ObjectID, arr: Any) -> None:
         entry = self._ensure_entry(oid)
