@@ -431,16 +431,21 @@ class Executor:
                     "worker_addr": self.core.address,
                     "meta": serialization.dumps(meta)}))
                 continue
-            packed = serialization.pack(value)
-            if len(packed) <= self.core.config.max_direct_call_object_size:
-                results.append((oid.binary(), "inline", packed))
+            smeta, buffers, total = serialization.packed_size(value)
+            if total <= self.core.config.max_direct_call_object_size:
+                results.append((oid.binary(), "inline",
+                                serialization.pack_parts(smeta, buffers)))
             else:
-                self.core._run(self._store_shared(oid, packed))
+                # piecewise into the arena (no join copy — same path as
+                # owner-side put; matters for GiB numpy returns)
+                self.core._run(
+                    self._store_shared_parts(oid, smeta, buffers, total))
                 results.append(
                     (
                         oid.binary(),
                         "shared",
-                        {"size": len(packed), "node_addr": self.core.supervisor_addr},
+                        {"size": total,
+                         "node_addr": self.core.supervisor_addr},
                     )
                 )
         self._send_done(spec, {"task_id": spec.task_id.binary(), "results": results})
@@ -453,6 +458,18 @@ class Executor:
                                             "size": len(packed)},
                            timeout=600)
         self.core.arena.write(r["offset"], packed)
+        await sup.call("store_seal", {"object_id": oid.binary()},
+                       timeout=600)
+
+    async def _store_shared_parts(self, oid: ObjectID, meta: bytes,
+                                  buffers, total: int) -> None:
+        """Piecewise arena write of a serialized return — one memcpy per
+        payload buffer, no join (serialization.write_packed)."""
+        sup = self.core.clients.get(self.core.supervisor_addr)
+        r = await sup.call("store_create", {"object_id": oid.binary(),
+                                            "size": total}, timeout=600)
+        serialization.write_packed(
+            self.core.arena.view(r["offset"], total), meta, buffers)
         await sup.call("store_seal", {"object_id": oid.binary()},
                        timeout=600)
 
