@@ -1,10 +1,11 @@
 // Demo: invoke a ray_tpu Serve app from native C++ over the RPC ingress.
 //
 //   g++ -O2 -std=c++17 -o serve_demo demo.cpp
-//   ./serve_demo <host> <port> <app> [prompt]
+//   ./serve_demo <host> <port> <app> [prompt]           # unary
+//   ./serve_demo --stream <host> <port> <app> [prompt]  # streaming
 //
 // Prints the reply's "text" field (LLM apps) or a rendering of the
-// whole result.
+// whole result; --stream prints one line per chunk.
 
 #include <iostream>
 
@@ -14,14 +15,34 @@ using ray_tpu_serve::ServeRpcClient;
 using ray_tpu_serve::Value;
 
 int main(int argc, char** argv) {
+  bool stream = argc > 1 && std::string(argv[1]) == "--stream";
+  if (stream) {
+    argv++;
+    argc--;
+  }
   if (argc < 4) {
-    std::cerr << "usage: " << argv[0] << " <host> <port> <app> [prompt]\n";
+    std::cerr << "usage: " << argv[0]
+              << " [--stream] <host> <port> <app> [prompt]\n";
     return 2;
   }
   try {
     ServeRpcClient client(argv[1], std::stoi(argv[2]));
     std::map<std::string, ray_tpu_serve::ValuePtr> payload;
     payload["prompt"] = Value::str(argc > 4 ? argv[4] : "hello from c++");
+    if (stream) {
+      payload["stream"] = [] {
+        auto p = std::make_shared<Value>();
+        p->kind = Value::Kind::Bool;
+        p->b = true;
+        return p;
+      }();
+      client.invoke_stream(argv[3], payload,
+                           [](const ray_tpu_serve::ValuePtr& item) {
+                             std::cout << ServeRpcClient::describe(*item)
+                                       << "\n";
+                           });
+      return 0;
+    }
     auto result = client.invoke(argv[3], payload);
     if (result->has("text")) {
       std::cout << result->at("text").s << "\n";

@@ -327,10 +327,60 @@ class ServeRpcClient {
     if (fd_ >= 0) ::close(fd_);
   }
 
+  // invoke_stream(app, payload, on_item): streaming endpoints — pulls
+  // chunks until done, invoking on_item per item; throws on stream
+  // error. Non-streaming replies surface as a single item.
+  template <typename Fn>
+  void invoke_stream(const std::string& app,
+                     const std::map<std::string, ValuePtr>& payload,
+                     Fn on_item) {
+    ValuePtr first = invoke_raw(app, payload);
+    if (!first->has("stream")) {
+      on_item(first->has("result") ? first->dict["result"] : first);
+      return;
+    }
+    const std::string sid = first->at("stream").s;
+    while (true) {
+      PickleWriter w;
+      w.proto2();
+      w.mark();
+      w.int32(0);
+      w.int32(++msg_id_);
+      w.str("stream_next");
+      Value body;
+      body.kind = Value::Kind::Dict;
+      body.dict["stream"] = Value::str(sid);
+      w.value(body);
+      w.tuple();
+      w.stop();
+      send_frame(w.out);
+      auto tup = PickleReader(recv_frame()).parse();
+      if (tup->list.size() != 4 || tup->list[0]->i == 2)
+        throw std::runtime_error("stream_next failed");
+      auto& chunk = *tup->list[3];
+      if (chunk.has("items"))
+        for (auto& item : chunk.at("items").list) on_item(item);
+      if (chunk.has("error") &&
+          chunk.at("error").kind != Value::Kind::None)
+        throw std::runtime_error("stream error: " +
+                                 describe(chunk.at("error")));
+      if (chunk.has("done") && chunk.at("done").b) return;
+    }
+  }
+
   // invoke(app, payload): payload is a string->Value dict shipped as the
   // deployment's request; returns the "result" value of the reply.
   ValuePtr invoke(const std::string& app,
                   const std::map<std::string, ValuePtr>& payload) {
+    auto out = invoke_raw(app, payload);
+    if (out->has("stream"))
+      throw std::runtime_error("endpoint streams; use invoke_stream()");
+    return out->has("result") ? out->dict["result"] : out;
+  }
+
+ private:
+  ValuePtr invoke_raw(const std::string& app,
+                      const std::map<std::string, ValuePtr>& payload) {
     Value body;
     body.kind = Value::Kind::Dict;
     auto pay = std::make_shared<Value>();
@@ -361,10 +411,10 @@ class ServeRpcClient {
     const auto& payload_out = tup->list[3];
     if (kind == 2)  // ERROR
       throw std::runtime_error("server error: " + describe(*payload_out));
-    return payload_out->dict.count("result") ? payload_out->dict["result"]
-                                             : payload_out;
+    return payload_out;  // callers pick "result"/"stream"
   }
 
+ public:
   static std::string describe(const Value& v) {
     switch (v.kind) {
       case Value::Kind::Str: return v.s;

@@ -22,6 +22,7 @@ from ray_tpu._private.task_spec import (
     NodeAffinityStrategy,
     NodeLabelStrategy,
     PlacementGroupStrategy,
+    RandomStrategy,
     SchedulingStrategy,
     SpreadStrategy,
 )
@@ -86,6 +87,11 @@ def pick_node(
             if chosen is not None:
                 return chosen
         return _hybrid(eligible, rs, local_node_hex, spread_threshold)
+    if isinstance(strategy, RandomStrategy):
+        schedulable = [n for n in nodes if n.schedulable_now(rs)]
+        if not schedulable:
+            schedulable = [n for n in nodes if n.feasible(rs)]
+        return (rng or random).choice(schedulable) if schedulable else None
     if isinstance(strategy, SpreadStrategy):
         return _spread(nodes, rs, rng)
     # PlacementGroupStrategy demand is rewritten to bundle resources upstream.

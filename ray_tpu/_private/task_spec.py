@@ -63,6 +63,15 @@ class NodeAffinityStrategy(SchedulingStrategy):
 
 
 @dataclasses.dataclass
+class RandomStrategy(SchedulingStrategy):
+    """Uniform choice over schedulable nodes (ref
+    `policy/random_scheduling_policy.h`) — load-oblivious by design,
+    for workloads that want decorrelated placement."""
+
+    name: str = "RANDOM"
+
+
+@dataclasses.dataclass
 class PlacementGroupStrategy(SchedulingStrategy):
     name: str = "PLACEMENT_GROUP"
     pg_id_hex: str = ""

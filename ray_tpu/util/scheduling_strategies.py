@@ -10,6 +10,7 @@ from ray_tpu._private.task_spec import (  # noqa: F401
     NodeLabelStrategy,
     NotIn,
     PlacementGroupStrategy,
+    RandomStrategy,
     SchedulingStrategy,
     SpreadStrategy,
 )

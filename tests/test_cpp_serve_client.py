@@ -48,6 +48,23 @@ class TestCppServeClient:
         assert run.returncode == 0, run.stderr[-2000:]
         assert run.stdout.strip() == "echo:native c++ says hi"
 
+    def test_streaming_invoke(self, serve_shutdown, demo_binary):
+        @serve.deployment
+        def tokens(req):
+            def gen():
+                for i in range(4):
+                    yield f"tok{i}"
+            return gen()
+
+        serve.run(tokens.bind(), name="cppstream")
+        port = serve.start_rpc_ingress()
+        run = subprocess.run(
+            [demo_binary, "--stream", "127.0.0.1", str(port), "cppstream",
+             "go"],
+            capture_output=True, text=True, timeout=120)
+        assert run.returncode == 0, run.stderr[-2000:]
+        assert run.stdout.split() == [f"tok{i}" for i in range(4)]
+
     def test_server_error_surfaces(self, serve_shutdown, demo_binary):
         @serve.deployment
         def fine(req):
