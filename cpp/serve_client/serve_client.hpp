@@ -355,8 +355,11 @@ class ServeRpcClient {
       w.stop();
       send_frame(w.out);
       auto tup = PickleReader(recv_frame()).parse();
-      if (tup->list.size() != 4 || tup->list[0]->i == 2)
-        throw std::runtime_error("stream_next failed");
+      if (tup->list.size() != 4)
+        throw std::runtime_error("stream_next: bad reply tuple");
+      if (tup->list[0]->i == 2)  // ERROR frame: render the server's text
+        throw std::runtime_error("stream_next failed: " +
+                                 describe(*tup->list[3]));
       auto& chunk = *tup->list[3];
       if (chunk.has("items"))
         for (auto& item : chunk.at("items").list) on_item(item);

@@ -666,6 +666,15 @@ def _strategy_from_options(opts: Dict[str, Any]) -> SchedulingStrategy:
         return strat
     if strat == "SPREAD":
         return SpreadStrategy()
+    if strat == "RANDOM":
+        from ray_tpu._private.task_spec import RandomStrategy
+
+        return RandomStrategy()
+    if isinstance(strat, str) and strat not in ("DEFAULT", ""):
+        raise ValueError(
+            f"unknown scheduling_strategy string {strat!r}; use 'SPREAD', "
+            "'RANDOM', 'DEFAULT', or a strategy object from "
+            "ray_tpu.util.scheduling_strategies")
     pg = opts.get("placement_group")
     if pg is not None:
         return PlacementGroupStrategy(

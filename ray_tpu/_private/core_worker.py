@@ -1200,21 +1200,27 @@ class CoreWorker:
                 self._async_store_parts(oid, meta, buffers, total))
         return oid, self.address
 
-    async def _async_store_parts(self, oid: ObjectID, meta: bytes,
-                                 buffers, total: int) -> ObjectEntry:
-        entry = self._ensure_entry(oid)
+    async def arena_write_parts(self, oid: ObjectID, meta: bytes,
+                                buffers, total: int) -> None:
+        """THE create->write->seal sequence for serialized parts (shared
+        by owner-side put and executor-side returns): 600s RPC budgets
+        because a GiB-class create can queue behind another object's
+        spill on the store thread, and the (possibly multi-GB) memcpy
+        runs on an executor so it never stalls the event loop."""
         sup = self.clients.get(self.supervisor_addr)
-        # 600s: creating a GiB-class object can sit behind another
-        # object's multi-GB spill on the store thread
         r = await sup.call("store_create",
                            {"object_id": oid.binary(), "size": total},
                            timeout=600)
-        loop = asyncio.get_running_loop()
-        await loop.run_in_executor(
+        await asyncio.get_running_loop().run_in_executor(
             None, serialization.write_packed,
             self.arena.view(r["offset"], total), meta, buffers)
         await sup.call("store_seal", {"object_id": oid.binary()},
                        timeout=600)
+
+    async def _async_store_parts(self, oid: ObjectID, meta: bytes,
+                                 buffers, total: int) -> ObjectEntry:
+        entry = self._ensure_entry(oid)
+        await self.arena_write_parts(oid, meta, buffers, total)
         entry.state = SHARED
         entry.size = total
         entry.location = self.supervisor_addr

@@ -463,15 +463,10 @@ class Executor:
 
     async def _store_shared_parts(self, oid: ObjectID, meta: bytes,
                                   buffers, total: int) -> None:
-        """Piecewise arena write of a serialized return — one memcpy per
-        payload buffer, no join (serialization.write_packed)."""
-        sup = self.core.clients.get(self.core.supervisor_addr)
-        r = await sup.call("store_create", {"object_id": oid.binary(),
-                                            "size": total}, timeout=600)
-        serialization.write_packed(
-            self.core.arena.view(r["offset"], total), meta, buffers)
-        await sup.call("store_seal", {"object_id": oid.binary()},
-                       timeout=600)
+        """Piecewise arena write of a serialized return — the shared
+        create->write->seal helper (no owner bookkeeping: the SUBMITTER
+        owns returns; this process only lands the bytes)."""
+        await self.core.arena_write_parts(oid, meta, buffers, total)
 
     def _report_error(self, spec: TaskSpec, err: Exception, retryable: bool) -> None:
         self._send_done(

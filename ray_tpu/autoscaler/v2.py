@@ -123,10 +123,12 @@ class Reconciler:
         self.im = im or InstanceManager()
         self.idle_timeout_s = idle_timeout_s
         self._idle_since: Dict[str, float] = {}
-        # node types whose timed-out allocation requests may still fill
-        # late; one marker per abandoned request, consumed by
-        # terminating the stray node it eventually produces
-        self._abandoned_requests: List[str] = []
+        # (node_type, expires_at) markers for timed-out allocation
+        # requests that may still fill late; consumed by terminating the
+        # stray node, and EXPIRED after 2x the allocation timeout so a
+        # never-filled stockout can't leave a permanent kill-marker that
+        # would reap a legitimate out-of-band node months later
+        self._abandoned_requests: List[Tuple[str, float]] = []
 
     # ---- observation sync ------------------------------------------
 
@@ -153,21 +155,28 @@ class Reconciler:
                 # the cloud request is still outstanding: if it fills
                 # AFTER the retry's request, the stray node must be
                 # terminated, not silently leaked as a billable orphan
-                self._abandoned_requests.append(inst.node_type)
+                self._abandoned_requests.append(
+                    (inst.node_type, time.monotonic()
+                     + max(2 * self.ALLOCATION_TIMEOUT_S, 300.0)))
         # reap late fills of abandoned requests: a live provider node no
         # instance claims, of an abandoned type, is terminated (consume
         # one marker per node so legitimate future launches still adopt)
+        now = time.monotonic()
+        self._abandoned_requests = [
+            m for m in self._abandoned_requests if m[1] > now]
         if self._abandoned_requests:
             claimed = {i.provider_id for i in self.im.instances.values()
                        if i.provider_id}
             for pid, n in list(live.items()):
                 if pid in claimed:
                     continue
-                if n["node_type"] in self._abandoned_requests and not any(
+                marker = next((m for m in self._abandoned_requests
+                               if m[0] == n["node_type"]), None)
+                if marker is not None and not any(
                         i.status == REQUESTED
                         and i.node_type == n["node_type"]
                         for i in self.im.instances.values()):
-                    self._abandoned_requests.remove(n["node_type"])
+                    self._abandoned_requests.remove(marker)
                     self.provider.terminate_node(pid)
                     live.pop(pid, None)
         for inst in self.im.by_status(ALLOCATED, RAY_RUNNING):

@@ -128,12 +128,12 @@ class TestASGIIngress:
                 async with sess.ws_connect(
                         f"http://127.0.0.1:{port}/api/ws",
                         timeout=aiohttp.ClientWSTimeout(ws_close=120)
-                        if hasattr(aiohttp, "ClientWSTimeout") else 30
+                        if hasattr(aiohttp, "ClientWSTimeout") else 120
                 ) as ws:
                     await ws.send_str("hi")
-                    first = await asyncio.wait_for(ws.receive_str(), 30)
+                    first = await asyncio.wait_for(ws.receive_str(), 120)
                     await ws.send_str("there")
-                    second = await asyncio.wait_for(ws.receive_str(), 30)
+                    second = await asyncio.wait_for(ws.receive_str(), 120)
                     await ws.send_str("close")
                     closed = await asyncio.wait_for(ws.receive(), 30)
                     return first, second, closed.type
