@@ -69,6 +69,25 @@ def run_killable(argv: List[str], *, env: Optional[Dict[str, str]] = None,
     return proc.returncode, out or "", err or "", timed_out
 
 
+def tpu_probe(timeout: float = 180.0, log=None) -> bool:
+    """Can a fresh process reach the TPU backend? A killable child runs
+    a tiny device program; a wedged tunnel (jax init blocking forever —
+    the round-4/5 outage mode) times out and is SIGKILLed instead of
+    consuming a full benchmark attempt's budget."""
+    import sys
+
+    rc, out, _err, timed_out = run_killable(
+        [sys.executable, "-c",
+         "import jax, jax.numpy as jnp; "
+         "x = jnp.ones((64, 64)); print('PROBE-OK', float((x @ x)[0, 0]))"],
+        timeout=timeout)
+    ok = rc == 0 and "PROBE-OK" in out
+    if log is not None:
+        log(f"tpu probe {'ok' if ok else 'FAILED'} "
+            f"(rc={rc}, timed_out={timed_out})")
+    return ok
+
+
 def preflight_sweep(log) -> None:
     """Reap stale daemons/arenas; never let the sweep itself fail a run."""
     try:
