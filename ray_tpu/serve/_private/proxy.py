@@ -74,12 +74,19 @@ class ProxyActor:
 
     async def _refresh_routes(self):
         try:
-            self._routes = await self._controller.get_routes.remote()
+            # fetch BOTH, then assign together with no await in between:
+            # assigning routes first opened a window where a request saw
+            # the new route with a stale ASGI flag and took the plain
+            # handle_request path into an ASGI-only deployment
+            # (AttributeError: no __call__). asgi is fetched second so
+            # it is at least as new as the routes it annotates.
+            routes = await self._controller.get_routes.remote()
             # published by the controller from the deployment class's
             # static marker — the proxy never probes user code, and a
             # redeploy (plain <-> ASGI) takes effect on the next refresh
-            self._route_asgi = (
-                await self._controller.get_route_asgi.remote())
+            route_asgi = await self._controller.get_route_asgi.remote()
+            self._routes = routes
+            self._route_asgi = route_asgi
         except Exception:
             pass
 
