@@ -154,11 +154,9 @@ class UriControlStore(ControlStore):
         self._backend = backend
         self._seq: Optional[int] = None  # lazily seeded per epoch
         self._seq_epoch: Optional[int] = None
-        # key -> uri memo so reads skip a list round-trip when possible
-        self._uris: dict = {}
 
     def _put(self, key: str, blob: bytes) -> None:
-        self._uris[key] = self._backend.put(key, blob)
+        self._backend.put(key, blob)
 
     def _list(self, prefix: str) -> List[Tuple[str, str]]:
         return sorted(self._backend.list_keys(prefix))
@@ -192,8 +190,17 @@ class UriControlStore(ControlStore):
         for key, uri in self._list(f"wal.{epoch:012d}."):
             try:
                 out.append(self._backend.get(uri))
-            except Exception:
-                break  # a torn/missing frame ends the replay, like a file
+            except Exception as e:
+                # unlike a file WAL — where a torn frame can only be the
+                # tail of a crashed append — every listed URI frame was
+                # fully written before the next ack, so a mid-log read
+                # failure is a transient backend error. Swallowing it
+                # would silently discard every LATER acked frame; fail
+                # recovery loudly and let the operator retry.
+                raise RuntimeError(
+                    f"control-plane WAL frame {key} unreadable during "
+                    f"recovery; retry (transient backend error?): {e}"
+                ) from e
         return out
 
     def sweep_wals(self, max_epoch: int) -> None:
@@ -214,10 +221,17 @@ class UriControlStore(ControlStore):
 
 
 def control_store_for(target: str, default_dir: str) -> ControlStore:
-    """Build the controller's store: empty target -> session-dir files;
-    any external-storage URI -> that backend (config flag
+    """Build the controller's store: empty target / file:// / bare path
+    -> fsynced session-or-target-dir files (FileControlStore — the
+    external FileSystemStorage backend never fsyncs, which would break
+    append_wal's durable-before-ack contract on local disks); genuinely
+    remote URIs (mock://, s3://) -> that backend (config flag
     ``controller_store_uri``, ref `redis_store_client.h`)."""
     if not target:
         return FileControlStore(default_dir)
+    if target.startswith("file://"):
+        return FileControlStore(target[len("file://"):])
+    if "://" not in target:
+        return FileControlStore(target)
     return UriControlStore(
         external_storage.storage_from_spill_target(target, default_dir))

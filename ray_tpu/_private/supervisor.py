@@ -512,15 +512,6 @@ class Supervisor:
         self._pump_lease_queue()
         return await fut
 
-    def _self_view(self) -> NodeView:
-        return NodeView(
-            node_id_hex=self.node_id.hex(),
-            address=self.server.address,
-            total=self.total,
-            available=self.available,
-            alive=True,
-        )
-
     def _live_self_view(self) -> NodeView:
         """Self view net of demand already queued for leasing here."""
         avail = self.available.copy()
@@ -1141,8 +1132,12 @@ class Supervisor:
         """
         oid = ObjectID(body["object_id"])
         if await self._store_op(self.store.contains, oid):
+            # the object can be freed between the two store-thread hops
+            # (contains/locate no longer run back-to-back on the loop);
+            # a None locate falls through to the pull path cleanly
             loc = await self._store_op(self.store.locate, oid)
-            return {"offset": loc[0], "size": loc[1]}
+            if loc is not None:
+                return {"offset": loc[0], "size": loc[1]}
         pending = self._pulls_in_flight.get(oid)
         if pending is not None:
             return await pending

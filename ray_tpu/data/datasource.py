@@ -441,10 +441,13 @@ def webdataset_tasks(paths) -> List[Callable[[], Block]]:
 # ------------------------------------------------------------------- sql
 
 
-def _cursor_block(conn, sql: str, params=()) -> Block:
+def _cursor_block(conn, sql: str) -> Block:
     try:
         cur = conn.cursor()
-        cur.execute(sql, params)
+        # no params argument: passing one (even empty) makes
+        # format/pyformat drivers (psycopg2, MySQLdb) interpret every
+        # '%' in the SQL as a placeholder
+        cur.execute(sql)
         names = [d[0] for d in cur.description]
         rows = cur.fetchall()
     finally:
@@ -460,10 +463,13 @@ def sql_tasks(sql: str, connection_factory: Callable[[], Any],
               lower_bound=None, upper_bound=None,
               parallelism: int = 1) -> List[Callable[[], Block]]:
     """DBAPI-2 source (reference `read_sql`). One task runs the whole
-    query; with `partition_column` + bounds the read fans out into
-    `parallelism` range-partitioned queries — the standard warehouse
-    parallel-read recipe (ref `bigquery_datasource.py` read streams /
-    JDBC partitioned reads). `connection_factory` must be picklable
+    query; with `partition_column` + NUMERIC bounds the read fans out
+    into `parallelism` range-partitioned queries (Spark-JDBC recipe:
+    bounds set the STRIDES only — the first partition is unbounded
+    below and also takes NULLs, the last unbounded above, so no row is
+    ever filtered out by the bounds). Literal numeric bounds are
+    inlined because DBAPI paramstyles differ per driver.
+    `connection_factory` must be picklable
     (e.g. `lambda: sqlite3.connect(path)`)."""
     if partition_column is None or parallelism <= 1:
         return [lambda: _cursor_block(connection_factory(), sql)]
@@ -471,19 +477,24 @@ def sql_tasks(sql: str, connection_factory: Callable[[], Any],
         raise ValueError(
             "partitioned read_sql needs lower_bound and upper_bound for "
             "the partition column")
-    span = (upper_bound - lower_bound) / parallelism
+    lo_b, hi_b = float(lower_bound), float(upper_bound)  # numeric only
+    span = (hi_b - lo_b) / parallelism
+    col = partition_column
     tasks: List[Callable[[], Block]] = []
     for i in range(parallelism):
-        lo = lower_bound + i * span
-        hi = upper_bound + 1 if i == parallelism - 1 else lower_bound + (
-            i + 1) * span
+        if i == 0 and i == parallelism - 1:
+            where = "1=1"
+        elif i == 0:
+            where = f"({col} < {lo_b + span} OR {col} IS NULL)"
+        elif i == parallelism - 1:
+            where = f"{col} >= {lo_b + i * span}"
+        else:
+            where = (f"{col} >= {lo_b + i * span} AND "
+                     f"{col} < {lo_b + (i + 1) * span}")
 
-        def make(lo=lo, hi=hi):
-            part_sql = (f"SELECT * FROM ({sql}) __rt_sub WHERE "
-                        f"{partition_column} >= ? AND "
-                        f"{partition_column} < ?")
-            return lambda: _cursor_block(connection_factory(), part_sql,
-                                         (lo, hi))
+        def make(where=where):
+            part_sql = f"SELECT * FROM ({sql}) __rt_sub WHERE {where}"
+            return lambda: _cursor_block(connection_factory(), part_sql)
 
         tasks.append(make())
     return tasks
@@ -525,17 +536,19 @@ def mongo_tasks(uri: str, database: str, collection: str,
                 ) from e
             return pymongo.MongoClient(uri)
 
+    # compute the stride ONCE at dataset construction and bake it into
+    # every task: tasks executing at different times would otherwise
+    # derive different strides from a drifting estimate and silently
+    # drop/duplicate the rows between the two page grids. The estimate
+    # only sets page BOUNDARIES — the last partition is unbounded, so a
+    # stale count skews balance, never correctness.
+    n = client_factory()[database][collection].estimated_document_count()
+    per = max(1, -(-n // parallelism))  # ceil
+
     def part_task(index: int):
         def task():
             client = client_factory()
             coll = client[database][collection]
-            # page size from the (metadata-based, possibly stale)
-            # estimate; correctness never depends on it: the LAST
-            # partition reads unbounded, so an undercount or a
-            # cardinality-changing pipeline can skew balance but can
-            # never silently drop trailing documents
-            n = coll.estimated_document_count()
-            per = max(1, -(-n // parallelism))  # ceil
             start = index * per
             stages = (list(pipeline or [])
                       + [{"$sort": {"_id": 1}}, {"$skip": start}])
@@ -584,19 +597,25 @@ def bigquery_tasks(project_id: str, dataset: Optional[str] = None,
                     "use a custom client") from e
             return bigquery.Client(project=project_id)
 
-    def resolve_table(client):
-        if query is not None:
-            job = client.query(query)
-            job.result()  # wait; the anonymous destination holds rows
-            return job.destination
-        return dataset
+    # the query job runs ONCE at dataset construction (one job, one
+    # quota hit) and every stream task reads the SAME destination
+    # table — per-task execution would run N jobs and, for
+    # non-deterministic queries, page over N different result sets
+    # (duplicated + missing rows). num_rows is resolved here too so
+    # every task pages over one fixed grid.
+    setup = client_factory()
+    if query is not None:
+        job = setup.query(query)
+        job.result()  # wait; the anonymous destination holds the rows
+        table = job.destination
+    else:
+        table = dataset
+    n_rows = setup.get_table(table).num_rows
+    per = max(1, -(-n_rows // parallelism))  # ceil
 
     def stream_task(index: int):
         def task():
             client = client_factory()
-            table = resolve_table(client)
-            n_rows = client.get_table(table).num_rows
-            per = max(1, -(-n_rows // parallelism))  # ceil
             start = index * per
             if start >= n_rows and index > 0:
                 return pa.table({})

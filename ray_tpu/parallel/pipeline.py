@@ -224,7 +224,10 @@ def pipeline_1f1b(stage_fn: Callable[[Any, Any], Any],
 
         carry0 = (
             jnp.zeros(mb_shape, mb_dtype),
-            jnp.zeros(mb_shape, jnp.float32),
+            # cotangents carry the ACTIVATION dtype (vjp output,
+            # ppermuted as-is): a float32 init here fails scan's carry
+            # dtype check for bf16 microbatches — the TPU training dtype
+            jnp.zeros(mb_shape, mb_dtype),
             jnp.zeros((buf_slots,) + mb_shape, mb_dtype),
             jax.tree.map(
                 lambda v: jnp.zeros(v.shape[1:], jnp.float32), params_local),

@@ -166,6 +166,24 @@ class TestPipeline:
                 np.asarray(grads[k]), np.asarray(ref_g[k]),
                 rtol=1e-4, atol=1e-6)
 
+    def test_1f1b_bf16_microbatches(self):
+        """bf16 — the TPU training dtype — must trace and train: the
+        cotangent carry dtype follows the activations (regression: a
+        float32-initialized bwd buffer failed scan's carry check)."""
+        from ray_tpu.parallel.pipeline import pipeline_1f1b
+
+        mesh = Mesh(np.array(jax.devices()[:4]), ("pp",))
+        stages = stack_stage_params(
+            [{"w": jnp.eye(8, dtype=jnp.bfloat16) * (0.8 + 0.1 * i)}
+             for i in range(4)])
+        xs = jnp.ones((6, 4, 8), jnp.bfloat16)
+        loss, grads = pipeline_1f1b(
+            lambda p, h: jnp.tanh(h @ p["w"]),
+            lambda a: jnp.mean(a.astype(jnp.float32) ** 2),
+            stages, xs, mesh=mesh)
+        assert np.isfinite(float(loss)) and float(loss) > 0
+        assert float(jnp.abs(grads["w"].astype(jnp.float32)).sum()) > 0
+
     def test_1f1b_bounded_activation_store(self):
         """The act store is 2*S slots — independent of microbatch count:
         a 32-microbatch run must still be correct (slots are reused)."""

@@ -54,14 +54,19 @@ class FakeQueryJob:
 
 class FakeBQClient:
     """Honors the call surface bigquery_tasks drives: query().result(),
-    get_table().num_rows, list_rows(start_index, max_results).to_arrow."""
+    get_table().num_rows, list_rows(start_index, max_results).to_arrow.
+    Tables are CLASS-level (a query's destination table lives in the
+    service, visible to every client instance) and query jobs are
+    counted class-wide to assert once-only execution."""
+
+    tables = {"ds.events": FakeTable([(i, i * 2) for i in range(23)])}
+    query_jobs = 0
 
     def __init__(self):
-        self.tables = {"ds.events": FakeTable(
-            [(i, i * 2) for i in range(23)])}
         self.list_calls = []
 
     def query(self, sql):
+        type(self).query_jobs += 1
         return FakeQueryJob(self, sql)
 
     def get_table(self, name):
@@ -81,13 +86,22 @@ class TestBigQuery:
         assert sorted(r["id"] for r in rows) == list(range(23))
         assert all(r["value"] == r["id"] * 2 for r in rows)
 
-    def test_query_reads_destination_table(self, ray_init):
-        ds = read_bigquery("proj", query="SELECT * FROM x",
-                           parallelism=3, client_factory=FakeBQClient)
-        rows = ds.take_all()
-        assert len(rows) == 37
-        assert sorted(r["value"] for r in rows) == [i * 10
-                                                    for i in range(37)]
+    def test_query_reads_destination_table(self):
+        """The query job runs ONCE at construction; every stream task
+        pages the shared destination table. (Exercised at the
+        task-callable level: the class-level fake state that stands in
+        for the service does not cross worker processes.)"""
+        from ray_tpu.data.datasource import bigquery_tasks
+
+        before = FakeBQClient.query_jobs
+        tasks = bigquery_tasks("proj", query="SELECT * FROM x",
+                               parallelism=3,
+                               client_factory=FakeBQClient)
+        assert FakeBQClient.query_jobs == before + 1  # job ran already
+        blocks = [t() for t in tasks]
+        assert FakeBQClient.query_jobs == before + 1  # tasks reran NOTHING
+        values = [v for b in blocks for v in b.column("value").to_pylist()]
+        assert sorted(values) == [i * 10 for i in range(37)]
 
     def test_exactly_one_of_dataset_query(self):
         with pytest.raises(ValueError, match="exactly one"):
@@ -97,13 +111,13 @@ class TestBigQuery:
 
     def test_default_client_path_is_gated(self, ray_init):
         """Without an injected client the default path builds a real
-        bigquery.Client: in this image the library resolves but ADC
-        credentials don't — either failure mode must surface clearly,
-        never hang or return empty data."""
-        ds = read_bigquery("proj", dataset="ds.events")
+        bigquery.Client AT CONSTRUCTION (fail-fast: the query job and
+        row grid are resolved once, driver-side): in this image the
+        library resolves but ADC credentials don't — either failure
+        mode must surface clearly, never hang or return empty data."""
         with pytest.raises(Exception,
                            match="google-cloud-bigquery|credentials"):
-            ds.take_all()
+            read_bigquery("proj", dataset="ds.events")
 
 
 # --------------------------------------------------------- partitioned sql
@@ -241,9 +255,9 @@ class TestMongo:
     def test_missing_pymongo_gated(self, ray_init):
         from ray_tpu.data import read_mongo
 
-        ds = read_mongo("mongodb://real", "db", "coll")
+        # fail-fast at construction: the partition grid needs one count
         with pytest.raises(Exception, match="pymongo"):
-            ds.take_all()
+            read_mongo("mongodb://real", "db", "coll")
 
 
 class TestHuggingFace:
