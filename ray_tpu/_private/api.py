@@ -290,12 +290,17 @@ def _new_job_id(controller_addr) -> JobID:
     """Controller-issued job number (cluster-unique across drivers)."""
     import asyncio
 
-    from ray_tpu._private.rpc import RpcClient
+    from ray_tpu._private.config import global_config
+    from ray_tpu._private.rpc import RpcClient, retry_call
 
     async def ask():
         client = RpcClient(controller_addr)
         try:
-            return await client.call("job_new")
+            # job_new is replay-cached server-side, so retrying across a
+            # controller hiccup can never mint two numbers for this driver
+            return await retry_call(
+                client, "job_new", timeout=30, per_call_timeout=10,
+                base_interval_s=global_config().rpc_retry_interval_ms / 1000.0)
         finally:
             await client.close()
 

@@ -90,6 +90,23 @@ class Config:
     # ---- TPU ----
     tpu_chips_per_host: int = 0  # 0 = autodetect via jax
     tpu_topology: str = ""  # e.g. "v5p-64"; "" = autodetect
+    # ---- fault injection (chaos.py; every knob defaults OFF) ----
+    # seed for the deterministic fault schedule; < 0 disables chaos
+    # entirely (the rpc hot path then pays one None-check)
+    chaos_seed: int = -1
+    # per-RPC-event probabilities, each drawn deterministically from
+    # (seed, side:method, nth-call): drop = lose the frame + sever the
+    # connection; dup = deliver the request twice; delay = hold the frame
+    # up to chaos_delay_max_ms
+    chaos_drop_prob: float = 0.0
+    chaos_dup_prob: float = 0.0
+    chaos_delay_prob: float = 0.0
+    chaos_delay_max_ms: int = 50
+    # comma-separated RPC method names to target ("" = all methods)
+    chaos_methods: str = ""
+    # "point[:nth],..." — hard-exit the daemon the nth time it passes the
+    # named chaos.maybe_crash() point (deterministic process death)
+    chaos_crash_points: str = ""
     # ---- testing ----
     fake_cluster: bool = False
 

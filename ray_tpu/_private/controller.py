@@ -27,13 +27,14 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-from ray_tpu._private import serialization
+from ray_tpu._private import chaos, serialization
 from ray_tpu._private.config import Config
 from ray_tpu._private.http_util import MetricsHttpServer
 from ray_tpu._private.ids import ActorID, JobID, NodeID, PlacementGroupID
 from ray_tpu._private.metrics import Counter, Gauge, default_registry
 from ray_tpu._private.resources import ResourceSet
-from ray_tpu._private.rpc import ClientPool, RpcServer
+from ray_tpu._private.rpc import (ClientPool, RpcServer, idempotent,
+                                  replay_cached, retry_call)
 from ray_tpu._private.scheduling import NodeView, PlacementError, place_bundles
 
 logger = logging.getLogger(__name__)
@@ -151,7 +152,8 @@ class Controller:
         self.server = RpcServer(host, port if port else config.controller_port)
         self.server.register_object(self)
         self.clients = ClientPool(
-            config.rpc_connect_timeout_s, config.rpc_request_timeout_s
+            config.rpc_connect_timeout_s, config.rpc_request_timeout_s,
+            retry_base_s=config.rpc_retry_interval_ms / 1000.0,
         )
         self.nodes: Dict[str, NodeRecord] = {}
         self.actors: Dict[str, ActorRecord] = {}
@@ -552,22 +554,29 @@ class Controller:
 
     # job submission RPCs (the CLI may come through RPC instead of HTTP)
 
+    @replay_cached
     async def rpc_job_submit(self, body) -> dict:
+        # spawns a process: a retried submission must get the first job_id
+        # back, not a second entrypoint run
         return {"job_id": self.job_manager.submit(
             body["entrypoint"], env_vars=body.get("env_vars"),
             submission_id=body.get("submission_id"))}
 
+    @idempotent
     async def rpc_job_status(self, body):
         return self.job_manager.status(body["job_id"])
 
+    @idempotent
     async def rpc_job_logs(self, body) -> str:
         return self.job_manager.logs(body["job_id"])
 
+    @idempotent
     async def rpc_job_stop(self, body) -> bool:
         # blocking process wait — never on the control-plane loop
         return await asyncio.get_running_loop().run_in_executor(
             None, self.job_manager.stop, body["job_id"])
 
+    @idempotent
     async def rpc_job_submissions(self, body=None) -> list:
         return self.job_manager.list()
 
@@ -605,6 +614,7 @@ class Controller:
 
     # ------------------------------------------------------------- nodes
 
+    @idempotent  # overwrite-by-node-id; the 0.2s sync refreshes any staleness
     async def rpc_node_register(self, body) -> dict:
         rec = NodeRecord(
             node_id_hex=body["node_id_hex"],
@@ -624,6 +634,7 @@ class Controller:
         await self._retry_pending_pgs()
         return {"num_nodes": len(self.nodes)}
 
+    @idempotent  # latest-write-wins gossip
     async def rpc_node_sync(self, body):
         """Resource gossip from supervisors (≈ ray_syncer)."""
         rec = self.nodes.get(body["node_id_hex"])
@@ -641,6 +652,7 @@ class Controller:
         if rec.pending_demand or dict(rec.available) != dict(rec.total):
             rec.last_busy = time.monotonic()
 
+    @idempotent
     async def rpc_node_views(self, body=None) -> list:
         return [
             {
@@ -654,6 +666,7 @@ class Controller:
             for r in self.nodes.values()
         ]
 
+    @idempotent  # _mark_node_dead is a no-op on an already-dead node
     async def rpc_node_drain(self, body) -> None:
         await self._mark_node_dead(body["node_id_hex"], "drained")
 
@@ -697,7 +710,13 @@ class Controller:
         self.events.emit("NODE_DEAD", f"node {node_hex[:8]}: {reason}",
                          severity="WARNING", node_id=node_hex,
                          reason=reason)
-        await self._publish("nodes", {"event": "DEAD", "node_id_hex": node_hex})
+        # address included so owners can match their leases' supervisor
+        # addresses and requeue in-flight tasks that died with the node
+        # (core_worker._on_node_dead — a dead supervisor can't send the
+        # worker_failed notifications itself)
+        await self._publish("nodes", {"event": "DEAD",
+                                      "node_id_hex": node_hex,
+                                      "address": list(rec.address)})
         # fail over actors that lived there
         for actor in list(self.actors.values()):
             if actor.node_id_hex == node_hex and actor.state in (
@@ -718,6 +737,7 @@ class Controller:
 
     # ------------------------------------------------------------- KV / functions
 
+    @replay_cached  # overwrite=False must answer a retry like the original
     async def rpc_kv_put(self, body) -> bool:
         ns = self.kv.setdefault(body.get("ns", ""), {})
         overwrite = body.get("overwrite", True)
@@ -732,9 +752,11 @@ class Controller:
                                       body["value"]))
         return True
 
+    @idempotent
     async def rpc_kv_get(self, body):
         return self.kv.get(body.get("ns", ""), {}).get(body["key"])
 
+    @replay_cached  # retry after a lost reply must still report existed=True
     async def rpc_kv_del(self, body) -> bool:
         self._mark_dirty()
         existed = self.kv.get(body.get("ns", ""), {}).pop(
@@ -747,15 +769,18 @@ class Controller:
                                               body["key"]))
         return existed
 
+    @idempotent
     async def rpc_kv_exists(self, body) -> bool:
         return body["key"] in self.kv.get(body.get("ns", ""), {})
 
+    @idempotent
     async def rpc_kv_keys(self, body) -> list:
         prefix = body.get("prefix", "")
         return [k for k in self.kv.get(body.get("ns", ""), {}) if k.startswith(prefix)]
 
     # ------------------------------------------------------------- actors
 
+    @replay_cached  # a retry would trip the name-conflict check on ITSELF
     async def rpc_actor_register(self, body) -> dict:
         """Register + schedule an actor creation.
 
@@ -792,13 +817,15 @@ class Controller:
             self.named_actors[(namespace, name)] = hexid
         self._mark_dirty()
         await self._wal_append("actor", rec)  # ack implies durability
+        chaos.maybe_crash("ctrl.actor_register")  # after WAL, before ack
         self.events.emit("ACTOR_REGISTERED",
                          f"actor {hexid[:8]} ({rec.class_name})",
                          actor_id=hexid, class_name=rec.class_name,
                          name=name, namespace=namespace)
         return {"ok": True}
 
-    async def rpc_actor_ready(self, body) -> None:
+    @replay_cached  # re-execution would double-increment the incarnation,
+    async def rpc_actor_ready(self, body) -> None:  # resetting handle seqnos
         """Worker reports successful actor construction."""
         rec = self.actors.get(body["actor_id_hex"])
         if rec is None:
@@ -818,16 +845,19 @@ class Controller:
             },
         )
 
+    @replay_cached  # terminal transition + death fan-out must run once
     async def rpc_actor_creation_failed(self, body) -> None:
         rec = self.actors.get(body["actor_id_hex"])
         if rec is None:
             return
         await self._kill_actor(rec, reason=body.get("reason", "creation failed"), restart=False)
 
+    @idempotent
     async def rpc_actor_get(self, body):
         rec = self.actors.get(body["actor_id_hex"])
         return dataclasses.asdict(rec) if rec else None
 
+    @idempotent
     async def rpc_actor_by_name(self, body):
         hexid = self.named_actors.get((body.get("namespace", "default"), body["name"]))
         if hexid is None:
@@ -835,9 +865,11 @@ class Controller:
         rec = self.actors.get(hexid)
         return dataclasses.asdict(rec) if rec else None
 
+    @idempotent
     async def rpc_actor_list(self, body=None) -> list:
         return [dataclasses.asdict(r) for r in self.actors.values()]
 
+    @replay_cached  # restart=True re-execution would burn a second restart
     async def rpc_actor_kill(self, body) -> None:
         rec = self.actors.get(body["actor_id_hex"])
         if rec is None:
@@ -856,7 +888,8 @@ class Controller:
             rec, reason="killed via ray_tpu.kill", restart=not no_restart
         )
 
-    async def rpc_worker_died(self, body) -> None:
+    @replay_cached  # duplicate would double _on_actor_failure: two restart
+    async def rpc_worker_died(self, body) -> None:  # loops, num_restarts += 2
         """Supervisor reports a worker process exit."""
         actor_hex = body.get("actor_id_hex", "")
         if actor_hex and actor_hex in self.actors:
@@ -944,17 +977,25 @@ class Controller:
                         timeout=self.config.worker_lease_timeout_s,
                     )
                     if grant.get("granted"):
+                        base = self.config.rpc_retry_interval_ms / 1000.0
                         # mark the worker as actor-hosting BEFORE it can run
                         # (its death must reach us for restart accounting)
-                        await self.clients.get(node.address).call(
+                        await retry_call(
+                            self.clients.get(node.address),
                             "worker_set_actor",
                             {
                                 "worker_id_hex": grant["worker_id_hex"],
                                 "actor_id_hex": rec.actor_id_hex,
                             },
+                            timeout=15, per_call_timeout=5,
+                            base_interval_s=base,
                         )
-                        await self.clients.get(tuple(grant["worker_address"])).call(
-                            "push_task", {"spec": serialization.dumps(spec)}, timeout=30
+                        await retry_call(
+                            self.clients.get(tuple(grant["worker_address"])),
+                            "push_task",
+                            {"spec": serialization.dumps(spec)},
+                            timeout=30, per_call_timeout=10,
+                            base_interval_s=base,
                         )
                         return  # worker reports actor_ready on success
                 except Exception as e:
@@ -966,6 +1007,7 @@ class Controller:
 
     # ------------------------------------------------------------- placement groups
 
+    @replay_cached  # re-execution re-places a created group from scratch
     async def rpc_pg_create(self, body) -> dict:
         pg = PGRecord(
             pg_id_hex=body["pg_id_hex"],
@@ -1034,13 +1076,16 @@ class Controller:
             if pg.state == PG_PENDING:
                 await self._try_place_pg(pg)
 
+    @idempotent
     async def rpc_pg_get(self, body):
         pg = self.pgs.get(body["pg_id_hex"])
         return dataclasses.asdict(pg) if pg else None
 
+    @idempotent
     async def rpc_pg_list(self, body=None) -> list:
         return [dataclasses.asdict(p) for p in self.pgs.values()]
 
+    @idempotent  # guarded by the REMOVED state check below
     async def rpc_pg_remove(self, body) -> None:
         pg = self.pgs.get(body["pg_id_hex"])
         if pg is None or pg.state == PG_REMOVED:
@@ -1064,6 +1109,7 @@ class Controller:
 
     # ------------------------------------------------------------- jobs
 
+    @replay_cached  # a retried mint must get the ORIGINAL number back
     async def rpc_job_new(self, body=None) -> int:
         """Issue a cluster-unique job number (drivers must not mint their own:
         two drivers on one cluster would both claim job 1)."""
@@ -1076,6 +1122,7 @@ class Controller:
         await self._wal_append("job_int", issued)  # never reissue on crash
         return issued
 
+    @replay_cached  # keeps start_time stable and the WAL free of dup frames
     async def rpc_job_register(self, body) -> None:
         self.jobs[body["job_id_hex"]] = JobRecord(
             job_id_hex=body["job_id_hex"],
@@ -1087,6 +1134,7 @@ class Controller:
         self.events.emit("JOB_STARTED", f"job {body['job_id_hex'][:8]}",
                          job_id=body["job_id_hex"])
 
+    @idempotent  # alive=False converges; the extra WAL tombstone is harmless
     async def rpc_job_finish(self, body) -> None:
         job = self.jobs.get(body["job_id_hex"])
         if job:
@@ -1101,6 +1149,7 @@ class Controller:
                              f"job {body['job_id_hex'][:8]}",
                              job_id=body["job_id_hex"])
 
+    @idempotent
     async def rpc_job_list(self, body=None) -> list:
         return [dataclasses.asdict(j) for j in self.jobs.values()]
 
@@ -1121,27 +1170,41 @@ class Controller:
             source_type=body.get("source_type"),
             severity=body.get("severity"))
 
+    @idempotent  # set add
     async def rpc_subscribe(self, body) -> None:
         self.subscribers.setdefault(body["channel"], set()).add(tuple(body["address"]))
 
+    @idempotent  # set discard
     async def rpc_unsubscribe(self, body) -> None:
         self.subscribers.get(body["channel"], set()).discard(tuple(body["address"]))
 
+    @idempotent  # subscribers tolerate duplicate fan-out messages
     async def rpc_publish(self, body) -> None:
         await self._publish(body["channel"], body["message"])
 
     async def _publish(self, channel: str, message: Any) -> None:
-        dead: List[Address] = []
         # snapshot: subscribe RPCs may mutate the set while we await notifies
-        for addr in list(self.subscribers.get(channel, set())):
+        subs = list(self.subscribers.get(channel, set()))
+        if not subs:
+            return
+
+        async def one(addr: Address) -> Optional[Address]:
             try:
-                await self.clients.get(addr).notify(
-                    "on_publish", {"channel": channel, "message": message}
-                )
+                # bounded + concurrent: a dead subscriber costs the publish
+                # 2s ONCE (then it's pruned), never a serial 10s connect
+                # window per address — node-death fan-out must stay prompt
+                await asyncio.wait_for(
+                    self.clients.get(addr).notify(
+                        "on_publish",
+                        {"channel": channel, "message": message}),
+                    timeout=2.0)
+                return None
             except Exception:
-                dead.append(addr)
-        for addr in dead:
-            self.subscribers[channel].discard(addr)
+                return addr
+
+        for addr in await asyncio.gather(*(one(a) for a in subs)):
+            if addr is not None:
+                self.subscribers[channel].discard(addr)
 
     # ------------------------------------------------------------- observability
 
@@ -1150,10 +1213,12 @@ class Controller:
             self.task_events.append(ev)
         self._m_task_events.inc(len(body["events"]))
 
+    @idempotent
     async def rpc_state_tasks(self, body=None) -> list:
         limit = (body or {}).get("limit", 1000)
         return list(self.task_events)[-limit:]
 
+    @idempotent
     async def rpc_cluster_status(self, body=None) -> dict:
         total = ResourceSet()
         avail = ResourceSet()
@@ -1171,9 +1236,11 @@ class Controller:
             "uptime_s": time.time() - self._started,
         }
 
+    @idempotent
     async def rpc_ping(self, body=None) -> str:
         return "pong"
 
+    @idempotent
     async def rpc_autoscaler_state(self, body=None) -> dict:
         """Cluster state consumed by StandardAutoscaler.update():
         per-node views + pending demand + idle ages

@@ -29,6 +29,7 @@ import dataclasses
 import hashlib
 import logging
 import os
+import random
 import threading
 import time
 from collections import OrderedDict, deque
@@ -52,6 +53,9 @@ from ray_tpu._private.rpc import (
     RpcServer,
     RpcTimeoutError,
     RemoteError,
+    idempotent,
+    replay_cached,
+    retry_call,
 )
 from ray_tpu._private.task_spec import (
     ArgKind,
@@ -115,6 +119,9 @@ class _PendingTask:
     spec: TaskSpec
     retries_left: int = 0
     lease: Optional[_Lease] = None
+    # connection-refused pushes requeued without burning retries_left
+    # (bounded — see _on_push_failure)
+    free_requeues: int = 0
 
 
 class _StreamEnd(Exception):
@@ -232,13 +239,23 @@ class CoreWorker:
 
     async def _async_start(self) -> Address:
         self.clients = ClientPool(
-            self.config.rpc_connect_timeout_s, self.config.rpc_request_timeout_s
+            self.config.rpc_connect_timeout_s,
+            self.config.rpc_request_timeout_s,
+            retry_base_s=self.config.rpc_retry_interval_ms / 1000.0,
         )
         addr = await self.server.start()
         if self.supervisor_addr is not None:
             info = await self.clients.get(self.supervisor_addr).call("node_info")
             self.node_id_hex = info["node_id_hex"]
             self.arena = ArenaFile(info["arena_path"], info["arena_size"])
+        # node-death fan-out: a killed supervisor cannot send worker_failed
+        # for its workers, so owners learn about lost leases from the
+        # controller's "nodes" channel instead (see _on_node_dead)
+        try:
+            await self.clients.get(self.controller_addr).call(
+                "subscribe", {"channel": "nodes", "address": addr}, timeout=10)
+        except Exception:
+            logger.debug("nodes-channel subscribe failed", exc_info=True)
         return addr
 
     def shutdown(self) -> None:
@@ -253,6 +270,16 @@ class CoreWorker:
         self._loop_thread.join(timeout=2)
 
     async def _async_shutdown(self):
+        try:
+            # leave the nodes channel so dead processes don't pile up as
+            # publish targets (pruning is best-effort and costs a timeout)
+            await asyncio.wait_for(
+                self.clients.get(self.controller_addr).notify(
+                    "unsubscribe",
+                    {"channel": "nodes", "address": self.address}),
+                timeout=1.0)
+        except Exception:
+            pass
         for shape, leases in self._leases.items():
             for lease in leases:
                 try:
@@ -504,6 +531,7 @@ class CoreWorker:
         target = await self._lease_target(spec)
         hops = 0
         conn_failures = 0
+        base = self.config.rpc_retry_interval_ms / 1000.0
         while True:
             try:
                 grant = await self.clients.get(target).call(
@@ -512,10 +540,14 @@ class CoreWorker:
                     timeout=self.config.worker_lease_timeout_s + 3600,
                 )
             except RpcConnectionError:
+                # each target change restarts the transport-level retry, so
+                # back off across failures (exponential + jitter) instead of
+                # hammering a churning cluster at a fixed interval
                 conn_failures += 1
                 if conn_failures > 30:
                     raise
-                await asyncio.sleep(0.3)
+                delay = min(base * (2 ** min(conn_failures - 1, 6)), 5.0)
+                await asyncio.sleep(delay * (0.5 + random.random()))
                 target = await self._alive_lease_target(spec, exclude=target)
                 hops = 0
                 continue
@@ -650,8 +682,30 @@ class CoreWorker:
     async def _on_push_failure(self, task: _PendingTask, lease: _Lease, err) -> None:
         lease.broken = True
         await self._drop_lease(lease)
-        if task.retries_left != 0 and task.spec.task_id in self._inflight_tasks:
-            task.retries_left -= 1
+        if task.spec.task_id not in self._inflight_tasks:
+            return
+        # A connection-refused push means the worker is GONE (the transport
+        # already exhausted its transparent reconnect): the task never
+        # reached an executor, so requeueing is free — it must not burn a
+        # task retry (node-death cleanup can lag push failures by a health
+        # period, and fast-failing pushes would otherwise drain max_retries
+        # against a node everyone but the health checker knows is dead).
+        # Redelivery stays safe either way: executors dedupe by task id.
+        # Timeouts/handler errors keep burning retries — the push may have
+        # landed on a wedged-but-alive worker. Free requeues are BOUNDED so
+        # a pathological always-refusing endpoint still terminates (after
+        # the cap, connection failures burn retries like everything else),
+        # and each one backs off briefly instead of hot-looping the
+        # requeue -> re-lease cycle.
+        free_requeue = (isinstance(err, RpcConnectionError)
+                        and task.free_requeues < 20)
+        if free_requeue or task.retries_left != 0:
+            if free_requeue:
+                task.free_requeues += 1
+                await asyncio.sleep(
+                    min(0.02 * task.free_requeues, 0.5))
+            else:
+                task.retries_left -= 1
             task.lease = None
             shape = self._shape_key(task.spec)
             self._task_queues.setdefault(shape, deque()).append(task)
@@ -673,6 +727,7 @@ class CoreWorker:
 
     # ------------------------------------------------------------- owner RPCs
 
+    @idempotent  # each report dedupes app-level by report_id
     async def rpc_task_done_batch(self, body) -> None:
         """Coalesced completion reports (executor-side reply batching —
         the mirror of push_task_batch on the submit side). Each report is
@@ -686,6 +741,7 @@ class CoreWorker:
                 logger.exception("task_done in batch failed (task %s)",
                                  done.get("task_id", b"").hex()[:12])
 
+    @idempotent  # dedupes app-level by report_id (bounded LRU below)
     async def rpc_task_done(self, body) -> None:
         _trace(f"task_done received {body.get('task_id', b'').hex()[:12]} err={body.get('error') is not None}")
         rid = body.get("report_id")
@@ -767,6 +823,7 @@ class CoreWorker:
 
     # ----------------------------------------------------------- streaming
 
+    @idempotent  # replayed indices refresh the same entry in place
     async def rpc_stream_item(self, body) -> dict:
         """Executor reports one yielded item of a streaming generator task
         (≈ ReportGeneratorItemReturns, core_worker.cc:3260). The item
@@ -812,6 +869,7 @@ class CoreWorker:
         stream.event.set()
         return {"consumed": stream.consumed, "stop": False}
 
+    @idempotent
     async def rpc_stream_state(self, body) -> dict:
         """Backpressure wait: block (bounded) until the consumer has
         advanced to `wait_for` items, so a paused producer holds ONE
@@ -980,6 +1038,7 @@ class CoreWorker:
         asyncio.get_running_loop().create_task(self._pump_shape(shape, spec))
         return True
 
+    @idempotent  # _try_reconstruct no-ops while a reconstruction runs
     async def rpc_object_lost(self, body) -> bool:
         """A borrower failed to read one of our SHARED objects (its node is
         gone). Kick off reconstruction; the borrower keeps polling
@@ -1001,30 +1060,48 @@ class CoreWorker:
         self._task_queues.setdefault(shape, deque()).append(task)
         await self._pump_shape(shape, task.spec)
 
+    async def _fail_lease_tasks(self, lease: "_Lease", reason: str) -> None:
+        """A lease's worker is gone: drop the lease and retry (or fail) every
+        task in flight on it — shared by supervisor worker_failed
+        notifications and controller node-death fan-out."""
+        lease.broken = True
+        leases = self._leases.get(lease.shape_key, [])
+        if lease in leases:
+            leases.remove(lease)
+        for task in list(self._inflight_tasks.values()):
+            if task.lease is lease:
+                if task.retries_left != 0:
+                    task.retries_left -= 1
+                    await self._requeue(task)
+                else:
+                    self._fail_task(task.spec, WorkerCrashedError(reason))
+                    self._inflight_tasks.pop(task.spec.task_id, None)
+
+    @idempotent  # the first execution removes the lease it matches on
     async def rpc_worker_failed(self, body) -> None:
         """Supervisor notifies: a worker leased to us died."""
         dead_hex = body["worker_id_hex"]
         for shape, leases in self._leases.items():
             for lease in list(leases):
                 if lease.worker_id_hex == dead_hex:
-                    lease.broken = True
-                    leases.remove(lease)
-                    # retry or fail the tasks in flight on that worker
-                    for task in list(self._inflight_tasks.values()):
-                        if task.lease is lease:
-                            if task.retries_left != 0:
-                                task.retries_left -= 1
-                                await self._requeue(task)
-                            else:
-                                self._fail_task(
-                                    task.spec,
-                                    WorkerCrashedError(
-                                        body.get("reason")
-                                        or f"worker {dead_hex[:8]} died "
-                                           f"(exit {body.get('exitcode')})"
-                                    ),
-                                )
-                                self._inflight_tasks.pop(task.spec.task_id, None)
+                    await self._fail_lease_tasks(
+                        lease,
+                        body.get("reason")
+                        or f"worker {dead_hex[:8]} died "
+                           f"(exit {body.get('exitcode')})")
+
+    async def _on_node_dead(self, supervisor_addr: Address) -> None:
+        """Controller declared a node dead: every lease granted by that
+        node's supervisor is gone, and its supervisor can no longer send
+        worker_failed for them — requeue their in-flight tasks here (the
+        gap the double-fault chaos test exposed: tasks running on a killed
+        node used to hang their owners forever)."""
+        addr = tuple(supervisor_addr)
+        for shape, leases in self._leases.items():
+            for lease in list(leases):
+                if tuple(lease.supervisor_addr) == addr:
+                    await self._fail_lease_tasks(
+                        lease, f"node {addr} died with tasks in flight")
 
     @staticmethod
     def _entry_status(entry: Optional[ObjectEntry]) -> str:
@@ -1035,6 +1112,7 @@ class CoreWorker:
         return {PENDING: "pending", FAILED: "error", DEVICE: "device",
                 INLINE: "value"}.get(entry.state, "location")
 
+    @idempotent
     async def rpc_get_object(self, body):
         """Remote reader resolves one of our owned objects. With
         ``wait_ms`` the owner parks the request until the object is ready
@@ -1073,6 +1151,7 @@ class CoreWorker:
                     "holder": entry.location}
         return {"status": status}
 
+    @idempotent
     async def rpc_device_read(self, body) -> bytes:
         """One bounded chunk of a device object's shard, staged host-side
         by the owner (device->host conversion cached across chunks)."""
@@ -1085,37 +1164,46 @@ class CoreWorker:
             None, self.device_objects.read, oid, index_key,
             body["offset"], body["length"])
 
+    @idempotent  # drop of an absent id is a no-op
     async def rpc_device_free(self, body) -> None:
         """Owner GC reached zero refs for a device return we hold."""
         self.device_objects.drop(ObjectID(body["object_id"]))
 
+    @idempotent
     async def rpc_object_states(self, body) -> List[str]:
         """Batched status probe for wait(): one RPC covers many refs."""
         return [self._entry_status(self.objects.get(ObjectID(raw)))
                 for raw in body["object_ids"]]
 
+    @replay_cached  # a duplicated increment would leak the object
     async def rpc_add_borrow(self, body) -> None:
         entry = self.objects.get(ObjectID(body["object_id"]))
         if entry is not None:
             entry.borrows += 1
 
+    @replay_cached  # a duplicated decrement could free a live borrow
     async def rpc_release_borrow(self, body) -> None:
         entry = self.objects.get(ObjectID(body["object_id"]))
         if entry is not None:
             entry.borrows = max(0, entry.borrows - 1)
             self._maybe_free(entry)
 
+    @idempotent  # pubsub is at-least-once; handlers tolerate repeats
     async def rpc_on_publish(self, body) -> None:
         channel = body["channel"]
         message = body["message"]
         if channel.startswith("actor:"):
             self._on_actor_update(channel[len("actor:") :], message)
+        elif channel == "nodes" and isinstance(message, dict) \
+                and message.get("event") == "DEAD" and message.get("address"):
+            await self._on_node_dead(tuple(message["address"]))
         for handler in self._pub_handlers.get(channel, []):
             try:
                 handler(message)
             except Exception:
                 logger.exception("pubsub handler failed for %s", channel)
 
+    @idempotent
     async def rpc_ping(self, body=None) -> str:
         return "pong"
 
@@ -1692,12 +1780,15 @@ class CoreWorker:
         try:
             grant = await self._lease_with_retry(spec)
             target = grant["_supervisor_addr"]
-            await self.clients.get(target).call(
+            base = self.config.rpc_retry_interval_ms / 1000.0
+            await retry_call(
+                self.clients.get(target),
                 "worker_set_actor",
                 {
                     "worker_id_hex": grant["worker_id_hex"],
                     "actor_id_hex": spec.actor_id.hex(),
                 },
+                timeout=15, per_call_timeout=5, base_interval_s=base,
             )
             await self.clients.get(tuple(grant["worker_address"])).call(
                 "push_task", {"spec": serialization.dumps(spec)}, timeout=3600

@@ -14,8 +14,26 @@ rides it (objects move via the shared-memory store and the chunked transfer
 protocol in object_store.py / supervisor.py).
 
 Frame: [u32 little-endian length][payload]
-Payload: pickle of (kind, msg_id, method, body)
+Payload: pickle of (kind, msg_id, method, body[, client_id])
   kind: 0=request 1=reply 2=error 3=oneway
+  client_id: 8 random bytes stable for the client's lifetime; with msg_id it
+  forms the exactly-once key for the server's replay cache (requests only;
+  replies echo the bare msg_id).
+
+Fault tolerance (what gRPC + the GCS managers give the reference, rebuilt):
+
+  * ``RpcClient.call`` retries transparently on connection loss —
+    reconnect, exponential backoff + jitter, the SAME ``msg_id`` resent —
+    all under one deadline budget covering connect + request + retries.
+  * The server replays cached replies for retried/duplicated deliveries of
+    methods registered ``replay_cached=True`` (non-idempotent control RPCs:
+    lease grants, task pushes, registrations). A retried ``request_lease``
+    whose first reply was lost gets the original grant back instead of
+    leasing a second worker. Handlers are annotated at their definition with
+    :func:`replay_cached` / :func:`idempotent`.
+  * Both sides consult :mod:`ray_tpu._private.chaos` so a seeded
+    ``FaultController`` can drop (sever), duplicate, or delay any frame —
+    the substrate the chaos suite drives.
 """
 
 from __future__ import annotations
@@ -23,13 +41,17 @@ from __future__ import annotations
 import asyncio
 import inspect
 import logging
+import os
 import pickle
+import random
 import socket
 import struct
 import time
+from collections import OrderedDict
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
 from ray_tpu._private import serialization
+from ray_tpu._private.chaos import fault_controller
 
 logger = logging.getLogger(__name__)
 
@@ -37,6 +59,9 @@ _LEN = struct.Struct("<I")
 REQUEST, REPLY, ERROR, ONEWAY = 0, 1, 2, 3
 
 MAX_FRAME = 512 * 1024 * 1024
+
+# completed replies kept for duplicate/retried delivery replay, per server
+REPLAY_CACHE_SIZE = 4096
 
 
 class RpcError(Exception):
@@ -51,12 +76,37 @@ class RpcTimeoutError(RpcError):
     pass
 
 
+class _ConnectionLostMidCall(RpcConnectionError):
+    """Internal: an ESTABLISHED connection dropped before the reply. The only
+    failure the transparent retry loop absorbs — a reconnect that fails means
+    the peer is gone, and that must surface immediately (callers like the
+    actor push path re-resolve a NEW address on RpcConnectionError; eating
+    the signal here would starve their failover)."""
+
+
 class RemoteError(RpcError):
     """An exception raised inside the remote handler, re-raised locally."""
 
     def __init__(self, method: str, cause_repr: str, cause: Exception | None = None):
         super().__init__(f"remote handler {method!r} failed: {cause_repr}")
         self.cause = cause
+
+
+def replay_cached(fn):
+    """Mark an ``rpc_*`` handler non-idempotent: the server caches its reply
+    keyed by (client_id, msg_id) and replays it for duplicated or retried
+    deliveries instead of re-executing. Use for anything that mints ids,
+    grants resources, or appends durable records."""
+    fn._rpc_replay_cached = True
+    return fn
+
+
+def idempotent(fn):
+    """Audit marker: re-executing this handler with the same body converges
+    to the same state (reads, overwrite-by-key writes, guarded transitions).
+    Duplicated/retried deliveries may re-execute it freely."""
+    fn._rpc_idempotent = True
+    return fn
 
 
 async def _read_frame(reader: asyncio.StreamReader) -> bytes:
@@ -85,8 +135,16 @@ class RpcServer:
         self._handlers: Dict[str, Callable] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set = set()
+        # exactly-once layer for non-idempotent methods: (client_id, msg_id)
+        # -> completed reply payload bytes, or an asyncio.Future while the
+        # first delivery is still executing (concurrent duplicates await it)
+        self._replay_methods: set = set()
+        self._replay_cache: "OrderedDict[Tuple[bytes, int], Any]" = OrderedDict()
 
-    def register(self, method: str, handler: Callable) -> None:
+    def register(self, method: str, handler: Callable,
+                 replay_cached: bool = False) -> None:
+        if replay_cached or getattr(handler, "_rpc_replay_cached", False):
+            self._replay_methods.add(method)
         self._handlers[method] = handler
 
     def register_object(self, obj: Any, prefix: str = "") -> None:
@@ -149,30 +207,96 @@ class RpcServer:
                 pass
 
     async def _dispatch(self, frame: bytes, writer: asyncio.StreamWriter, peer):
-        kind, msg_id, method, body = pickle.loads(frame)
+        msg = pickle.loads(frame)
+        kind, msg_id, method, body = msg[:4]
+        client_id = msg[4] if len(msg) > 4 else None
+        drop_reply = False
+        if kind == REQUEST:
+            fc = fault_controller()
+            if fc is not None:
+                decision = fc.rpc("server", method)
+                if decision is not None:
+                    if decision.delay_s:
+                        await asyncio.sleep(decision.delay_s)
+                    # server-side drop = the reply is lost in transit: the
+                    # handler runs (and its reply is cached), then the
+                    # connection severs so the client retries and must be
+                    # served from the replay cache
+                    drop_reply = decision.drop
+
         handler = self._handlers.get(method)
         if handler is None:
             if kind == REQUEST:
-                self._reply(writer, ERROR, msg_id, method, f"no such method: {method}")
+                self._send_reply(
+                    writer,
+                    self._encode_reply(ERROR, msg_id, method,
+                                       f"no such method: {method}"),
+                    drop_reply)
             return
+
+        key = None
+        if kind == REQUEST and client_id is not None \
+                and method in self._replay_methods:
+            key = (client_id, msg_id)
+            hit = self._replay_cache.get(key)
+            if hit is not None:
+                if isinstance(hit, asyncio.Future):
+                    payload = await hit  # first delivery still executing
+                else:
+                    payload = hit
+                self._send_reply(writer, payload, drop_reply)
+                return
+            self._replay_cache[key] = asyncio.get_running_loop().create_future()
+
+        payload = None
         try:
             sig_args = (body, peer) if _wants_peer(handler) else (body,)
             result = handler(*sig_args)
             if inspect.isawaitable(result):
                 result = await result
             if kind == REQUEST:
-                self._reply(writer, REPLY, msg_id, method, result)
+                payload = self._encode_reply(REPLY, msg_id, method, result)
         except Exception as e:  # noqa: BLE001 — handler errors cross the wire
             logger.debug("handler %s raised", method, exc_info=True)
             if kind == REQUEST:
-                try:
-                    self._reply(writer, ERROR, msg_id, method, e)
-                except Exception:
-                    self._reply(writer, ERROR, msg_id, method, repr(e))
+                payload = self._encode_reply(ERROR, msg_id, method, e)
+        if key is not None:
+            self._finish_replay(key, payload)
+        if payload is not None:
+            self._send_reply(writer, payload, drop_reply)
 
-    def _reply(self, writer, kind, msg_id, method, body):
+    def _encode_reply(self, kind: int, msg_id, method: str, body) -> bytes:
         try:
-            payload = serialization.dumps((kind, msg_id, method, body))
+            return serialization.dumps((kind, msg_id, method, body))
+        except Exception:
+            # unpicklable result/exception: degrade to its repr
+            return serialization.dumps((ERROR, msg_id, method, repr(body)))
+
+    def _finish_replay(self, key, payload: bytes) -> None:
+        fut = self._replay_cache.get(key)
+        self._replay_cache[key] = payload
+        self._replay_cache.move_to_end(key)
+        if isinstance(fut, asyncio.Future) and not fut.done():
+            fut.set_result(payload)
+        # trim oldest COMPLETED entries only: evicting an in-flight Future
+        # would strand duplicate dispatches awaiting it and let a late
+        # retry re-execute the non-idempotent handler
+        excess = len(self._replay_cache) - REPLAY_CACHE_SIZE
+        if excess > 0:
+            for k in [k for k, v in self._replay_cache.items()
+                      if not isinstance(v, asyncio.Future)][:excess]:
+                del self._replay_cache[k]
+
+    def _send_reply(self, writer, payload: bytes, drop_reply: bool) -> None:
+        if drop_reply:
+            # injected reply loss: sever so the client's retry machinery
+            # (not a silent timeout) observes it
+            try:
+                writer.close()
+            except Exception:
+                pass
+            return
+        try:
             _write_frame(writer, payload)
         except (ConnectionResetError, RuntimeError):
             pass
@@ -187,9 +311,13 @@ def _wants_peer(handler) -> bool:
 
 
 class RpcClient:
-    """Multiplexed client with lazy connect and bounded reconnection.
+    """Multiplexed client with lazy connect and transparent retry.
 
-    All calls must run on the owning event loop.
+    A call whose connection drops before the reply arrives reconnects and
+    resends the SAME (client_id, msg_id) with exponential backoff + jitter,
+    all under a single deadline budget — the server's replay cache makes the
+    resend exactly-once for non-idempotent methods. All calls must run on the
+    owning event loop.
     """
 
     def __init__(
@@ -197,6 +325,7 @@ class RpcClient:
         address: Tuple[str, int] | str,
         connect_timeout_s: float = 10.0,
         request_timeout_s: float = 60.0,
+        retry_base_s: float = 0.1,
     ):
         if isinstance(address, str):
             host, port = address.rsplit(":", 1)
@@ -204,6 +333,8 @@ class RpcClient:
         self._addr = address
         self._connect_timeout = connect_timeout_s
         self._request_timeout = request_timeout_s
+        self._retry_base = max(0.001, retry_base_s)
+        self._client_id = os.urandom(8)
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._pending: Dict[int, asyncio.Future] = {}
@@ -216,7 +347,12 @@ class RpcClient:
     def address(self) -> Tuple[str, int]:
         return self._addr
 
-    async def _ensure_connected(self) -> None:
+    async def _ensure_connected(self, one_shot: bool = False) -> None:
+        """Establish the connection. ``one_shot`` (reconnect attempts inside
+        a call's transparent retry) tries exactly once: if the peer cannot be
+        re-reached NOW it is presumed dead and the caller must fail over —
+        only the initial connect gets the patient retry window (the peer may
+        legitimately still be starting up)."""
         if self._writer is not None and not self._writer.is_closing():
             return
         async with self._lock:
@@ -235,7 +371,8 @@ class RpcClient:
                         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                     break
                 except (OSError, asyncio.TimeoutError) as e:
-                    if time.monotonic() + delay >= deadline or self._closed:
+                    if one_shot or time.monotonic() + delay >= deadline \
+                            or self._closed:
                         raise RpcConnectionError(
                             f"cannot connect to {self._addr}: {e}"
                         ) from e
@@ -248,7 +385,7 @@ class RpcClient:
         try:
             while True:
                 frame = await _read_frame(reader)
-                kind, msg_id, method, body = pickle.loads(frame)
+                kind, msg_id, method, body = pickle.loads(frame)[:4]
                 fut = self._pending.pop(msg_id, None)
                 if fut is None or fut.done():
                     continue
@@ -275,43 +412,134 @@ class RpcClient:
             self._writer = None
             self._reader = None
 
-    async def call(self, method: str, body: Any = None, timeout: float | None = None) -> Any:
-        # one deadline covers connect + request (a 2s call must not ride a
-        # 10s connect-retry window to a dead peer, nor get a fresh 2s after
-        # a 1.9s connect)
+    def reserve_msg_id(self) -> int:
+        """Pre-allocate a request id so several call() attempts can share one
+        (client_id, msg_id) replay-cache key (see retry_call)."""
+        self._next_id += 1
+        return self._next_id
+
+    async def call(self, method: str, body: Any = None,
+                   timeout: float | None = None,
+                   _reuse_msg_id: int | None = None) -> Any:
+        # One deadline covers connect + request + every transparent retry
+        # (a 2s call must not ride a 10s connect-retry window to a dead
+        # peer, nor get a fresh 2s after a 1.9s connect).
         budget = timeout if timeout is not None else self._request_timeout
         deadline = time.monotonic() + budget
-        if timeout is not None:
-            try:
-                await asyncio.wait_for(self._ensure_connected(), timeout=budget)
-            except asyncio.TimeoutError as e:
-                raise RpcConnectionError(
-                    f"cannot connect to {self._addr} within {timeout}s"
-                ) from e
+        if _reuse_msg_id is not None:
+            msg_id = _reuse_msg_id
         else:
-            await self._ensure_connected()
-        self._next_id += 1
-        msg_id = self._next_id
+            msg_id = self.reserve_msg_id()
+        # the payload (same msg_id) is reused verbatim across retries so the
+        # server-side replay cache can recognize the redelivery
+        payload = serialization.dumps(
+            (REQUEST, msg_id, method, body, self._client_id))
+        attempt = 0
+        while True:
+            try:
+                return await self._attempt(method, msg_id, payload, deadline,
+                                           reconnect=attempt > 0)
+            except _ConnectionLostMidCall:
+                # the peer WAS reachable and the frame (or its reply) was
+                # lost — retry under the deadline; a reconnect that fails
+                # raises plain RpcConnectionError out of _attempt instead,
+                # surfacing peer death to the caller's failover logic
+                attempt += 1
+                delay = min(self._retry_base * (2 ** (attempt - 1)), 2.0)
+                delay *= 0.5 + random.random()  # jitter: 0.5x..1.5x
+                if self._closed or time.monotonic() + delay >= deadline:
+                    raise
+                await asyncio.sleep(delay)
+
+    async def _attempt(self, method: str, msg_id: int, payload: bytes,
+                       deadline: float, reconnect: bool) -> Any:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise RpcConnectionError(
+                f"{method} to {self._addr}: deadline exhausted reconnecting")
+        try:
+            # connect failures are TERMINAL for the call (peer unreachable);
+            # one_shot on reconnects keeps dead-peer failover instant
+            await asyncio.wait_for(self._ensure_connected(one_shot=reconnect),
+                                   timeout=remaining)
+        except asyncio.TimeoutError as e:
+            raise RpcConnectionError(
+                f"cannot connect to {self._addr} within budget") from e
+        # snapshot: the read loop nulls self._writer when the connection
+        # dies, and that can interleave even between _ensure_connected
+        # resolving and this coroutine resuming — never deref the attribute
+        # after an await
+        writer = self._writer
+        if writer is None:
+            raise _ConnectionLostMidCall(
+                f"connection to {self._addr} lost before send")
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[msg_id] = fut
-        _write_frame(self._writer, serialization.dumps((REQUEST, msg_id, method, body)))
         try:
-            await self._writer.drain()
+            send = True
+            fc = fault_controller()
+            if fc is not None:
+                decision = fc.rpc("client", method)
+                if decision is not None:
+                    if decision.delay_s:
+                        await asyncio.sleep(decision.delay_s)
+                    if decision.drop:
+                        # request lost in transit: sever instead of sending;
+                        # the read loop fails `fut` and the retry loop in
+                        # call() re-sends — exactly a real network drop
+                        send = False
+                        writer.close()
+                    elif decision.duplicate:
+                        _write_frame(writer, payload)
+            if send:
+                if writer.is_closing():
+                    raise _ConnectionLostMidCall(
+                        f"connection to {self._addr} closed before send")
+                _write_frame(writer, payload)
+                await writer.drain()
             return await asyncio.wait_for(
                 fut, max(0.05, deadline - time.monotonic())
             )
         except asyncio.TimeoutError as e:
-            self._pending.pop(msg_id, None)
             raise RpcTimeoutError(f"{method} to {self._addr} timed out") from e
+        except RpcConnectionError as e:
+            # the established connection died before the reply (read loop
+            # failed our future) — the one retriable failure
+            raise _ConnectionLostMidCall(str(e)) from e
+        except (ConnectionResetError, BrokenPipeError, OSError) as e:
+            raise _ConnectionLostMidCall(
+                f"send to {self._addr} failed: {e}") from e
+        finally:
+            # the read loop pops on reply; this covers every other exit —
+            # drain/serialization failures, timeouts, cancellation — so a
+            # failed attempt can never leak its pending-future entry
+            self._pending.pop(msg_id, None)
 
     async def notify(self, method: str, body: Any = None) -> None:
-        """Fire-and-forget."""
+        """Fire-and-forget (at-most-once; never retried)."""
         await self._ensure_connected()
+        writer = self._writer  # see _attempt: never deref after an await
+        if writer is None:
+            raise RpcConnectionError(
+                f"connection to {self._addr} lost before send")
         self._next_id += 1
-        _write_frame(
-            self._writer, serialization.dumps((ONEWAY, self._next_id, method, body))
-        )
-        await self._writer.drain()
+        payload = serialization.dumps(
+            (ONEWAY, self._next_id, method, body, self._client_id))
+        fc = fault_controller()
+        if fc is not None:
+            decision = fc.rpc("client", method)
+            if decision is not None:
+                if decision.delay_s:
+                    await asyncio.sleep(decision.delay_s)
+                if decision.drop:
+                    return  # lost in transit (oneway: nothing notices)
+                if decision.duplicate:
+                    _write_frame(writer, payload)
+        if writer.is_closing():
+            raise RpcConnectionError(
+                f"connection to {self._addr} closed before send")
+        _write_frame(writer, payload)
+        await writer.drain()
 
     async def close(self) -> None:
         self._closed = True
@@ -325,13 +553,65 @@ class RpcClient:
         self._writer = None
 
 
+async def retry_call(
+    client: RpcClient,
+    method: str,
+    body: Any = None,
+    *,
+    timeout: float | None = None,
+    per_call_timeout: float | None = None,
+    base_interval_s: float = 0.1,
+    max_interval_s: float = 5.0,
+    retry_on: tuple = (RpcConnectionError, RpcTimeoutError),
+) -> Any:
+    """Deadline-budgeted retry wrapper shared by control-plane call sites.
+
+    ``RpcClient.call`` already retries transparently on connection loss; this
+    helper additionally absorbs peer restarts and per-call timeouts across a
+    longer window — the replacement for the hand-rolled fixed-interval retry
+    loops daemons used to carry. ``timeout`` bounds the WHOLE effort
+    (defaults to the client's request timeout); each attempt gets
+    ``per_call_timeout`` (clamped to the remaining budget); sleeps between
+    attempts follow exponential backoff from ``base_interval_s``
+    (``Config.rpc_retry_interval_ms`` at call sites) with 0.5x..1.5x jitter.
+    Safe for non-idempotent methods only because the server's replay cache
+    dedupes redeliveries — every attempt here shares ONE reserved
+    (client_id, msg_id) key, so even a retry after a timeout whose first
+    delivery actually executed is answered from the cache, never
+    re-executed."""
+    budget = timeout if timeout is not None else client._request_timeout
+    deadline = time.monotonic() + budget
+    msg_id = client.reserve_msg_id()
+    attempt = 0
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise RpcTimeoutError(
+                f"{method} to {client.address}: retry budget exhausted")
+        call_timeout = remaining if per_call_timeout is None \
+            else min(per_call_timeout, remaining)
+        try:
+            return await client.call(method, body, timeout=call_timeout,
+                                     _reuse_msg_id=msg_id)
+        except retry_on:
+            attempt += 1
+            delay = min(base_interval_s * (2 ** (attempt - 1)), max_interval_s)
+            delay *= 0.5 + random.random()
+            if time.monotonic() + delay >= deadline:
+                raise
+            await asyncio.sleep(delay)
+
+
 class ClientPool:
     """Cache of RpcClients keyed by address."""
 
-    def __init__(self, connect_timeout_s: float = 10.0, request_timeout_s: float = 60.0):
+    def __init__(self, connect_timeout_s: float = 10.0,
+                 request_timeout_s: float = 60.0,
+                 retry_base_s: float = 0.1):
         self._clients: Dict[Tuple[str, int], RpcClient] = {}
         self._connect_timeout = connect_timeout_s
         self._request_timeout = request_timeout_s
+        self._retry_base = retry_base_s
 
     def get(self, address: Tuple[str, int] | str) -> RpcClient:
         if isinstance(address, str):
@@ -343,6 +623,7 @@ class ClientPool:
                 address,
                 connect_timeout_s=self._connect_timeout,
                 request_timeout_s=self._request_timeout,
+                retry_base_s=self._retry_base,
             )
             self._clients[address] = client
         return client

@@ -31,14 +31,15 @@ import time
 from collections import deque
 from typing import Any, Deque, Dict, List, Optional, Set, Tuple
 
-from ray_tpu._private import serialization
+from ray_tpu._private import chaos, serialization
 from ray_tpu._private.config import Config
 from ray_tpu._private.http_util import MetricsHttpServer
 from ray_tpu._private.ids import NodeID, ObjectID, WorkerID
 from ray_tpu._private.metrics import Counter, Gauge, default_registry
 from ray_tpu._private.object_store import NodeObjectStore
 from ray_tpu._private.resources import ResourceSet, detect_node_resources
-from ray_tpu._private.rpc import ClientPool, RpcServer
+from ray_tpu._private.rpc import (ClientPool, RpcServer, idempotent,
+                                  replay_cached, retry_call)
 from ray_tpu._private.runtime_env import (RuntimeEnvManager,
                                           runtime_env_cache_key)
 from ray_tpu._private.scheduling import NodeView, pick_node
@@ -119,7 +120,8 @@ class Supervisor:
         self.server = RpcServer(host, port)
         self.server.register_object(self)
         self.clients = ClientPool(
-            config.rpc_connect_timeout_s, config.rpc_request_timeout_s
+            config.rpc_connect_timeout_s, config.rpc_request_timeout_s,
+            retry_base_s=config.rpc_retry_interval_ms / 1000.0,
         )
         self.total = (
             ResourceSet.of(resources)
@@ -294,9 +296,11 @@ class Supervisor:
         await self.clients.close_all()
         await self.server.stop()
 
+    @idempotent
     async def rpc_ping(self, body=None) -> str:
         return "pong"
 
+    @idempotent
     async def rpc_node_info(self, body=None) -> dict:
         return {
             "node_id_hex": self.node_id.hex(),
@@ -444,11 +448,16 @@ class Supervisor:
 
     # ------------------------------------------------------------- leases
 
+    @replay_cached
     async def rpc_request_lease(self, body) -> dict:
         """Grant a worker lease for a task, spill back, or queue.
 
         ≈ NodeManager::HandleRequestWorkerLease (node_manager.cc:1753).
+        Replay-cached: a duplicated/retried request whose first grant's
+        reply was lost must get the SAME grant back — re-executing would
+        lease a second worker nobody releases.
         """
+        chaos.maybe_crash("sup.request_lease")
         spec: TaskSpec = serialization.loads(body["spec"])
         no_spillback = body.get("no_spillback", False)
         hops = body.get("hops", 0)
@@ -606,6 +615,7 @@ class Supervisor:
         else:
             await self._release(lease.lease_id)
 
+    @idempotent  # _release of a popped lease id is a no-op
     async def rpc_release_lease(self, body) -> None:
         await self._release(body["lease_id"])
 
@@ -741,7 +751,8 @@ class Supervisor:
         _trace(f"spawned {handle.worker_id_hex[:8]} pid={handle.pid}")
         return handle
 
-    async def rpc_worker_register(self, body) -> dict:
+    @replay_cached  # re-execution re-pops _spawned_procs empty: the handle
+    async def rpc_worker_register(self, body) -> dict:  # loses its Popen
         handle = WorkerHandle(
             worker_id_hex=body["worker_id_hex"],
             address=tuple(body["address"]),
@@ -763,6 +774,7 @@ class Supervisor:
                     break
         return {"node_id_hex": self.node_id.hex()}
 
+    @idempotent  # sets the same two fields
     async def rpc_worker_set_actor(self, body) -> None:
         """Mark a worker as hosting an actor (exempt from pool reuse/reaping)."""
         w = self.workers.get(body["worker_id_hex"])
@@ -771,6 +783,7 @@ class Supervisor:
             w.is_actor = True
             w.actor_id_hex = body["actor_id_hex"]
 
+    @idempotent  # killing a dead pid is a no-op
     async def rpc_kill_worker(self, body) -> None:
         w = self.workers.get(body["worker_id_hex"])
         if w is not None and w.proc is not None:
@@ -779,10 +792,12 @@ class Supervisor:
             except Exception:
                 pass
 
+    @idempotent
     async def rpc_tpu_visible_chips(self, body) -> list:
         w = self.workers.get(body["worker_id_hex"])
         return w.tpu_chips if w else []
 
+    @idempotent
     async def rpc_worker_profile(self, body) -> dict:
         """Relay an on-demand live profile request to one of our workers
         (ref dashboard reporter_agent.py:391; collectors in
@@ -847,14 +862,18 @@ class Supervisor:
             await self._release(lease.lease_id)
         if w.is_actor:
             try:
-                await self.clients.get(self.controller_addr).call(
+                # the controller's restart accounting depends on this
+                # landing: ride out a controller restart window
+                await retry_call(
+                    self.clients.get(self.controller_addr),
                     "worker_died",
                     {
                         "worker_id_hex": w.worker_id_hex,
                         "actor_id_hex": w.actor_id_hex,
                         "reason": reason,
                     },
-                    timeout=5,
+                    timeout=15, per_call_timeout=5,
+                    base_interval_s=self.config.rpc_retry_interval_ms / 1000.0,
                 )
             except Exception:
                 pass
@@ -1057,6 +1076,7 @@ class Supervisor:
 
     # ------------------------------------------------------------- placement bundles
 
+    @idempotent  # key-guarded: re-reserving an existing bundle is a no-op
     async def rpc_reserve_bundle(self, body) -> None:
         key = (body["pg_id_hex"], body["bundle_index"])
         demand = ResourceSet.of(body["resources"])
@@ -1067,6 +1087,7 @@ class Supervisor:
         self.available.subtract(demand)
         self.bundles[key] = [demand.copy(), demand.copy()]
 
+    @idempotent  # pop-guarded
     async def rpc_release_bundle(self, body) -> None:
         key = (body["pg_id_hex"], body["bundle_index"])
         entry = self.bundles.pop(key, None)
@@ -1086,30 +1107,37 @@ class Supervisor:
         return await asyncio.get_running_loop().run_in_executor(
             self._store_exec, fn, *args)
 
-    async def rpc_store_create(self, body) -> dict:
+    @replay_cached  # a second create of the same id must return the SAME
+    async def rpc_store_create(self, body) -> dict:  # offset, not re-allocate
         oid = ObjectID(body["object_id"])
         offset = await self._store_op(self.store.create, oid, body["size"])
         return {"offset": offset}
 
+    @replay_cached  # double-seal rejects
     async def rpc_store_seal(self, body) -> None:
         await self._store_op(self.store.seal, ObjectID(body["object_id"]))
 
+    @idempotent
     async def rpc_store_abort(self, body) -> None:
         await self._store_op(self.store.abort, ObjectID(body["object_id"]))
 
+    @replay_cached  # pin=True re-execution leaks a pin count
     async def rpc_store_locate(self, body):
         loc = await self._store_op(
             lambda: self.store.locate(ObjectID(body["object_id"]),
                                       pin=body.get("pin", False)))
         return None if loc is None else {"offset": loc[0], "size": loc[1]}
 
+    @replay_cached  # double-unpin would release someone else's pin
     async def rpc_store_unpin(self, body) -> None:
         await self._store_op(self.store.unpin, ObjectID(body["object_id"]))
 
+    @idempotent
     async def rpc_store_contains(self, body) -> bool:
         return await self._store_op(
             self.store.contains, ObjectID(body["object_id"]))
 
+    @idempotent
     async def rpc_store_free(self, body) -> None:
         def free_all():
             for raw in body["object_ids"]:
@@ -1117,14 +1145,17 @@ class Supervisor:
 
         await self._store_op(free_all)
 
+    @idempotent
     async def rpc_store_read_chunk(self, body) -> bytes:
         return await self._store_op(
             self.store.read_chunk, ObjectID(body["object_id"]),
             body["offset"], body["length"])
 
+    @idempotent
     async def rpc_store_stats(self, body=None) -> dict:
         return await self._store_op(self.store.stats)
 
+    @idempotent  # contains-check + in-flight dedupe make re-pulls converge
     async def rpc_pull_object(self, body) -> dict:
         """Fetch an object from a remote node into the local store.
 

@@ -188,6 +188,9 @@ class Executor:
         return self.core.get_function(spec.function_key)
 
     def _execute(self, spec: TaskSpec) -> None:
+        from ray_tpu._private import chaos
+
+        chaos.maybe_crash("worker.execute")
         if spec.task_id in self._cancelled:
             from ray_tpu._private.exceptions import TaskCancelledError
 
@@ -665,8 +668,12 @@ def main() -> None:
     core.start()
 
     executor = Executor(core)
-    core.server.register("push_task", executor.push_task)
-    core.server.register("push_task_batch", executor.push_task_batch)
+    # replay-cached at the RPC layer (retried delivery replays the ack) on
+    # top of the executor's own _seen_pushes task-id dedupe, which covers
+    # re-pushes that arrive as NEW requests (owner-level retry paths)
+    core.server.register("push_task", executor.push_task, replay_cached=True)
+    core.server.register("push_task_batch", executor.push_task_batch,
+                         replay_cached=True)
     core.server.register("cancel", executor.cancel)
 
     async def profile(body):

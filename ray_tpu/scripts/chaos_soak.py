@@ -1,0 +1,251 @@
+"""Chaos soak: sweep fault-schedule seeds against the multinode harness.
+
+Each seed drives one deterministic fault schedule (message drop/duplicate/
+delay on the control RPCs, plus supervisor + worker kills) under a real
+task + actor + training workload, and asserts end-state correctness — the
+same workload ``tests/test_chaos.py`` runs on its fixed seeds. The sweep
+prints the first failing seed so it can be handed straight back to the test
+suite (or this script) for bisection and replay:
+
+    python -m ray_tpu.scripts.chaos_soak --seeds 20          # sweep 0..19
+    python -m ray_tpu.scripts.chaos_soak --one 13            # replay seed 13
+
+Seeds run in subprocesses so one seed's daemons/env can never bleed into the
+next schedule.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+# the control RPCs worth attacking; health probes (ping) are excluded so a
+# node is only declared dead when a kill really happened
+CHAOS_METHODS = ",".join([
+    "request_lease", "push_task", "push_task_batch",
+    "task_done", "task_done_batch", "get_object",
+    "actor_register", "actor_ready", "worker_register", "worker_died",
+    "kv_put", "job_new", "node_sync",
+    "store_create", "store_seal", "store_locate",
+])
+
+
+def run_chaos_workload(
+    seed: int,
+    *,
+    drop_prob: float = 0.02,
+    dup_prob: float = 0.05,
+    delay_prob: float = 0.05,
+    delay_max_ms: int = 20,
+    kills: bool = True,
+    train: bool = True,
+) -> None:
+    """One seeded chaos run. Raises AssertionError / propagates any failure.
+
+    Builds a 2-node cluster whose daemons (and this driver process) all run
+    the seed's fault schedule, then drives:
+      * a fan of tasks spread across both nodes,
+      * an actor with calls in flight,
+      * a worker kill (task that hard-exits its process once) and a
+        supervisor kill (the 'doomed' node dies mid-run, a replacement
+        joins),
+      * a 2-worker data-parallel training run with checkpoint restore,
+    and asserts every result is correct and no pending RPC futures leaked.
+    """
+    import tempfile
+
+    import ray_tpu
+    from ray_tpu._private import chaos
+    from ray_tpu._private.chaos import FaultController
+    from ray_tpu._private.config import Config
+    from ray_tpu.cluster_utils import Cluster
+
+    cfg = Config.from_env()
+    cfg.chaos_seed = seed
+    cfg.chaos_drop_prob = drop_prob
+    cfg.chaos_dup_prob = dup_prob
+    cfg.chaos_delay_prob = delay_prob
+    cfg.chaos_delay_max_ms = delay_max_ms
+    cfg.chaos_methods = CHAOS_METHODS
+
+    cluster = Cluster(config=cfg)
+    workdir = tempfile.mkdtemp(prefix=f"chaos_seed{seed}_")
+    try:
+        cluster.add_node(num_cpus=4, resources={"stable": 100})
+        doomed = cluster.add_node(num_cpus=2, resources={"doomed": 100})
+        cluster.wait_for_nodes(2)
+        ray_tpu.init(address=cluster.address)
+        # the driver speaks the same fault schedule as the daemons
+        chaos.set_fault_controller(FaultController(
+            seed=seed, drop_prob=drop_prob, dup_prob=dup_prob,
+            delay_prob=delay_prob, delay_max_ms=delay_max_ms,
+            methods=CHAOS_METHODS))
+
+        @ray_tpu.remote
+        def square(x):
+            time.sleep(0.05)
+            return x * x
+
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def incr(self):
+                self.n += 1
+                return self.n
+
+            def total(self):
+                return self.n
+
+        @ray_tpu.remote
+        def crash_once(marker):
+            # first execution kills the worker process mid-task; the retry
+            # (a fresh worker) succeeds — a deterministic worker kill
+            if not os.path.exists(marker):
+                open(marker, "w").write("x")
+                os._exit(1)
+            return "survived"
+
+        @ray_tpu.remote
+        def on_doomed():
+            time.sleep(2.0)
+            return "done"
+
+        refs = [square.remote(i) for i in range(16)]
+        counter = Counter.options(resources={"stable": 1},
+                                  max_restarts=3).remote()
+        incs = [counter.incr.remote() for _ in range(10)]
+        crash_ref = crash_once.options(max_retries=2).remote(
+            os.path.join(workdir, "crash_marker"))
+        doomed_refs = [on_doomed.options(resources={"doomed": 1}).remote()
+                       for _ in range(2)]
+
+        if kills:
+            time.sleep(0.5)  # let doomed-node tasks start
+            cluster.remove_node(doomed)  # supervisor kill mid-run
+            cluster.add_node(num_cpus=2, resources={"doomed": 100})
+            cluster.wait_for_nodes(2)
+
+        # training runs FIRST so the tasks/actor calls above settle (with
+        # their retries) concurrently under it — the asserts below are then
+        # cheap resolutions instead of serial waits
+        if train:
+            from ray_tpu.air.config import (FailureConfig, RunConfig,
+                                            ScalingConfig)
+            from ray_tpu.train import DataParallelTrainer
+            from ray_tpu.train._checkpoint import Checkpoint
+            from ray_tpu.train._internal.session import get_session
+
+            def loop():
+                sess = get_session()
+                start = 0
+                ckpt = sess.get_checkpoint()
+                if ckpt is not None:
+                    start = int(ckpt.get_metadata().get("step", 0))
+                for step in range(start, 3):
+                    time.sleep(0.1)
+                    d = tempfile.mkdtemp(dir=workdir)
+                    c = Checkpoint(d)
+                    c.set_metadata({"step": step + 1})
+                    sess.report({"step": step}, checkpoint=c)
+
+            trainer = DataParallelTrainer(
+                loop,
+                scaling_config=ScalingConfig(num_workers=2),
+                run_config=RunConfig(
+                    name=f"chaos-seed{seed}",
+                    storage_path=os.path.join(workdir, "train"),
+                    failure_config=FailureConfig(max_failures=3),
+                ),
+            )
+            result = trainer.fit()
+            assert result.error is None, f"training failed: {result.error}"
+            assert result.metrics["step"] == 2, result.metrics
+
+        assert ray_tpu.get(refs, timeout=120) == [i * i for i in range(16)]
+        assert sorted(ray_tpu.get(incs, timeout=120)) == list(range(1, 11))
+        assert ray_tpu.get(counter.total.remote(), timeout=60) == 10
+        assert ray_tpu.get(crash_ref, timeout=120) == "survived"
+        if kills:
+            # tasks lost with the doomed supervisor retried onto its
+            # replacement — no lost tasks
+            assert ray_tpu.get(doomed_refs, timeout=120) == ["done", "done"]
+
+        # no leaked pending futures: every retried/severed call either
+        # completed or popped its entry on the way out
+        from ray_tpu._private import api as _api
+
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            leaked = {addr: len(c._pending)
+                      for addr, c in _api._core.clients._clients.items()
+                      if c._pending}
+            if not leaked:
+                break
+            time.sleep(0.1)
+        assert not leaked, f"pending RPC futures leaked: {leaked}"
+    finally:
+        chaos.set_fault_controller(None)  # calm teardown
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        cluster.shutdown()
+        chaos.reset()
+
+
+def _run_one(seed: int, args) -> None:
+    run_chaos_workload(
+        seed,
+        drop_prob=args.drop, dup_prob=args.dup, delay_prob=args.delay,
+        delay_max_ms=args.delay_max_ms,
+        kills=not args.no_kills, train=not args.no_train)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--seeds", type=int, default=10,
+                        help="number of seeds to sweep (from --start)")
+    parser.add_argument("--start", type=int, default=0)
+    parser.add_argument("--one", type=int, default=None,
+                        help="run exactly this seed in-process (replay mode)")
+    parser.add_argument("--drop", type=float, default=0.02)
+    parser.add_argument("--dup", type=float, default=0.05)
+    parser.add_argument("--delay", type=float, default=0.05)
+    parser.add_argument("--delay-max-ms", type=int, default=20)
+    parser.add_argument("--no-kills", action="store_true")
+    parser.add_argument("--no-train", action="store_true")
+    args = parser.parse_args()
+
+    if args.one is not None:
+        _run_one(args.one, args)
+        print(f"seed {args.one}: OK")
+        return 0
+
+    for seed in range(args.start, args.start + args.seeds):
+        t0 = time.monotonic()
+        child = [sys.executable, "-m", "ray_tpu.scripts.chaos_soak",
+                 "--one", str(seed),
+                 "--drop", str(args.drop), "--dup", str(args.dup),
+                 "--delay", str(args.delay),
+                 "--delay-max-ms", str(args.delay_max_ms)]
+        if args.no_kills:
+            child.append("--no-kills")
+        if args.no_train:
+            child.append("--no-train")
+        proc = subprocess.run(child)
+        took = time.monotonic() - t0
+        if proc.returncode != 0:
+            print(f"FIRST FAILING SEED: {seed} (rc={proc.returncode}, "
+                  f"{took:.0f}s) — replay with:\n"
+                  f"  python -m ray_tpu.scripts.chaos_soak --one {seed}")
+            return 1
+        print(f"seed {seed}: OK ({took:.0f}s)")
+    print(f"all {args.seeds} seeds passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

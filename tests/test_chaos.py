@@ -1,0 +1,398 @@
+"""Seeded fault-injection chaos suite.
+
+Drives the deterministic fault substrate (``_private/chaos.py``) through the
+hardened RPC layer (``_private/rpc.py``): message drops (connection sever),
+duplicated deliveries, delays, plus worker/supervisor kills — and asserts the
+control plane stays exactly-once where it must be (leases, pushes, id
+minting) and at-least-once everywhere else.
+
+Layout:
+  * schedule determinism: same seed => byte-identical fault schedule;
+  * RPC-layer units: replay cache, transparent retry, pending-future leak;
+  * cluster integration: a task+actor+training workload completing correctly
+    under 3 fixed seeds with kills (quick mode, tier-1);
+  * double-fault lineage: the node serving a reconstruction dies mid-replay;
+  * a `slow`-gated random-schedule soak (see also
+    ``python -m ray_tpu.scripts.chaos_soak``).
+"""
+
+import asyncio
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import chaos
+from ray_tpu._private.chaos import FaultController
+from ray_tpu.scripts.chaos_soak import CHAOS_METHODS, run_chaos_workload
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _chaos_isolation():
+    """No fault schedule may leak into (or out of) a test."""
+    chaos.reset()
+    yield
+    chaos.set_fault_controller(None)
+    chaos.reset()
+
+
+# --------------------------------------------------------------- determinism
+
+
+class TestScheduleDeterminism:
+    POINTS = [("client", "request_lease"), ("server", "request_lease"),
+              ("client", "push_task"), ("server", "task_done"),
+              ("client", "kv_put")]
+
+    def _drive(self, seed: int) -> FaultController:
+        fc = FaultController(seed=seed, drop_prob=0.1, dup_prob=0.2,
+                             delay_prob=0.3, delay_max_ms=40, record=True)
+        for i in range(400):
+            side, method = self.POINTS[i % len(self.POINTS)]
+            fc.rpc(side, method)
+        return fc
+
+    def test_same_seed_byte_identical_schedule(self):
+        a, b = self._drive(42), self._drive(42)
+        blob = a.schedule_bytes()
+        assert blob == b.schedule_bytes()
+        assert blob  # non-trivial: the schedule contains decisions
+        assert any(d.any() for _, _, d in a.trace)
+
+    def test_different_seed_different_schedule(self):
+        assert self._drive(42).schedule_bytes() != \
+            self._drive(43).schedule_bytes()
+
+    def test_schedule_independent_of_interleaving(self):
+        """Concurrency reorders which CALL sees a decision, never the
+        per-point decision sequence."""
+        a = FaultController(seed=7, drop_prob=0.3, record=True)
+        b = FaultController(seed=7, drop_prob=0.3, record=True)
+        for _ in range(50):  # a: strictly alternating
+            a.rpc("client", "x")
+            a.rpc("client", "y")
+        for _ in range(50):  # b: all x then all y
+            b.rpc("client", "x")
+        for _ in range(50):
+            b.rpc("client", "y")
+        per_point_a = {}
+        for point, n, d in a.trace:
+            per_point_a.setdefault(point, []).append((n, d))
+        per_point_b = {}
+        for point, n, d in b.trace:
+            per_point_b.setdefault(point, []).append((n, d))
+        assert per_point_a == per_point_b
+
+    def test_crash_point_fires_on_nth_hit(self):
+        exits = []
+        fc = FaultController(seed=0, crash_points="sup.request_lease:3",
+                             exit_fn=exits.append)
+        for _ in range(5):
+            fc.maybe_crash("sup.request_lease")
+            fc.maybe_crash("other.point")
+        assert exits == [137]  # fired exactly once, on the 3rd hit
+
+
+# ------------------------------------------------------------ rpc-layer units
+
+
+def _loop_run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+class TestRpcHardening:
+    def test_duplicated_request_lease_replay_cached(self):
+        """Every request frame delivered twice; the replay cache must hand
+        the duplicate the FIRST grant — the worker pool drains once per
+        logical request, never twice."""
+        from ray_tpu._private.rpc import RpcClient, RpcServer
+
+        async def main():
+            server = RpcServer()
+            pool = list(range(100))  # 100 "workers" available
+            grants = []
+
+            async def request_lease(body):
+                worker = pool.pop()  # re-execution would burn a 2nd worker
+                grants.append(worker)
+                return {"granted": True, "worker": worker}
+
+            server.register("request_lease", request_lease,
+                            replay_cached=True)
+            await server.start()
+            chaos.set_fault_controller(FaultController(
+                seed=11, dup_prob=1.0, methods="request_lease"))
+            client = RpcClient(server.address)
+            replies = [await client.call("request_lease", {"i": i},
+                                         timeout=10) for i in range(10)]
+            await asyncio.sleep(0.3)  # let duplicate dispatches land
+            chaos.set_fault_controller(None)
+            assert len(grants) == 10, "duplicated lease re-executed"
+            assert len(pool) == 90, "a worker was leased twice"
+            assert [r["worker"] for r in replies] == grants
+            await client.close()
+            await server.stop()
+
+        _loop_run(main())
+
+    def test_lost_reply_retried_and_replayed(self):
+        """Server-side drop: the handler runs, the reply is severed in
+        transit, the client's transparent retry is answered from the
+        replay cache — exactly-once execution, reply delivered."""
+        from ray_tpu._private.rpc import RpcClient, RpcServer
+
+        async def main():
+            server = RpcServer()
+            executions = []
+
+            async def push_task(body):
+                executions.append(body["i"])
+                return "ok"
+
+            server.register("push_task", push_task, replay_cached=True)
+            await server.start()
+            chaos.set_fault_controller(FaultController(
+                seed=5, drop_prob=0.4, methods="push_task"))
+            client = RpcClient(server.address, retry_base_s=0.02)
+            for i in range(20):
+                assert await client.call("push_task", {"i": i},
+                                         timeout=30) == "ok"
+            chaos.set_fault_controller(None)
+            assert executions == list(range(20)), \
+                "lost-reply retry re-executed a push"
+            assert not client._pending
+            await client.close()
+            await server.stop()
+
+        _loop_run(main())
+
+    def test_dropped_request_transparent_retry(self):
+        """Client-side drop severs the connection before the send; call()
+        reconnects and resends the same msg_id under its deadline."""
+        from ray_tpu._private.rpc import RpcClient, RpcServer
+
+        async def main():
+            server = RpcServer()
+            server.register("echo", lambda body: body)
+            await server.start()
+            chaos.set_fault_controller(FaultController(
+                seed=3, drop_prob=0.25, methods="echo"))
+            client = RpcClient(server.address, retry_base_s=0.02)
+            for i in range(25):
+                assert await client.call("echo", i, timeout=30) == i
+            chaos.set_fault_controller(None)
+            assert not client._pending
+            await client.close()
+            await server.stop()
+
+        _loop_run(main())
+
+    def test_pending_future_not_leaked_on_send_failure(self):
+        """Regression: a body whose serialization fails (or any pre-reply
+        failure) must pop its msg_id from _pending — it used to stay
+        forever."""
+        from ray_tpu._private.rpc import RpcClient, RpcServer
+
+        class Unpicklable:
+            def __reduce__(self):
+                raise RuntimeError("cannot pickle this")
+
+        async def main():
+            server = RpcServer()
+            server.register("echo", lambda body: body)
+            await server.start()
+            client = RpcClient(server.address)
+            assert await client.call("echo", 1) == 1  # connected
+            with pytest.raises(Exception):
+                await client.call("echo", Unpicklable())
+            assert not client._pending, "failed call leaked a pending future"
+            # timeouts must not leak either
+            async def never(body):
+                await asyncio.sleep(60)
+
+            server.register("never", never)
+            with pytest.raises(Exception):
+                await client.call("never", timeout=0.3)
+            assert not client._pending
+            await client.close()
+            await server.stop()
+
+        _loop_run(main())
+
+    def test_retry_call_timeout_retry_replays_not_reexecutes(self):
+        """retry_call shares ONE (client_id, msg_id) key across attempts: a
+        retry after a per-call timeout whose first delivery is still
+        executing must be answered by the original execution, not mint a
+        second result."""
+        from ray_tpu._private.rpc import RpcClient, RpcServer, retry_call
+
+        async def main():
+            server = RpcServer()
+            minted = []
+
+            async def job_new(body):
+                await asyncio.sleep(0.6)  # slower than the per-call timeout
+                minted.append(len(minted) + 1)
+                return minted[-1]
+
+            server.register("job_new", job_new, replay_cached=True)
+            await server.start()
+            client = RpcClient(server.address, retry_base_s=0.02)
+            got = await retry_call(client, "job_new", timeout=10,
+                                   per_call_timeout=0.3,
+                                   base_interval_s=0.02)
+            assert got == 1 and minted == [1], (got, minted)
+            await client.close()
+            await server.stop()
+
+        _loop_run(main())
+
+    def test_deadline_budget_covers_retries(self):
+        """A call to a dead peer fails within its budget, not after
+        unbounded reconnect attempts."""
+        from ray_tpu._private.rpc import RpcClient, RpcConnectionError
+
+        async def main():
+            client = RpcClient(("127.0.0.1", 1))  # nothing listens
+            t0 = time.monotonic()
+            with pytest.raises(RpcConnectionError):
+                await client.call("echo", 1, timeout=1.0)
+            assert time.monotonic() - t0 < 5.0
+            await client.close()
+
+        _loop_run(main())
+
+
+# --------------------------------------------------------- cluster integration
+
+
+class TestSeededChaosWorkload:
+    """The acceptance workload: message drop/duplicate/delay plus worker and
+    supervisor kills, three fixed seeds, correct end state (run_chaos_workload
+    asserts results, actor counts, training metrics, and zero leaked pending
+    futures)."""
+
+    @pytest.mark.parametrize("seed", [101, 202, 303])
+    def test_workload_under_seeded_chaos(self, seed):
+        run_chaos_workload(seed)
+
+
+class TestDuplicatedControlRpcsCluster:
+    def test_duplicated_lease_and_push_execute_tasks_once(self, tmp_path):
+        """Every request_lease / push_task frame is delivered twice end to
+        end; each task must still execute exactly once."""
+        from ray_tpu._private.config import Config
+        from ray_tpu.cluster_utils import Cluster
+
+        methods = "request_lease,push_task,push_task_batch"
+        cfg = Config.from_env()
+        cfg.chaos_seed = 17
+        cfg.chaos_dup_prob = 1.0
+        cfg.chaos_methods = methods
+        cluster = Cluster(config=cfg)
+        marker = tmp_path / "executions.txt"
+        try:
+            cluster.add_node(num_cpus=4)
+            cluster.wait_for_nodes(1)
+            ray_tpu.init(address=cluster.address)
+            chaos.set_fault_controller(FaultController(
+                seed=17, dup_prob=1.0, methods=methods))
+
+            @ray_tpu.remote
+            def record(i, path):
+                with open(path, "a") as f:
+                    f.write(f"{i}\n")
+                return i
+
+            refs = [record.remote(i, str(marker)) for i in range(8)]
+            assert sorted(ray_tpu.get(refs, timeout=60)) == list(range(8))
+            time.sleep(0.5)  # let any duplicate deliveries land
+            lines = marker.read_text().splitlines()
+            assert sorted(int(x) for x in lines) == list(range(8)), (
+                f"duplicated control RPCs double-executed tasks: {lines}")
+        finally:
+            chaos.set_fault_controller(None)
+            if ray_tpu.is_initialized():
+                ray_tpu.shutdown()
+            cluster.shutdown()
+            chaos.reset()
+
+
+# ------------------------------------------------------- double-fault lineage
+
+
+class TestDoubleFaultLineage:
+    def test_borrower_survives_node_death_mid_replay(self, ray_cluster):
+        """Lineage reconstruction under a second fault: the node re-executing
+        the creating task dies mid-replay; the borrower's get must ride the
+        second retry onto a third node and still produce the value."""
+        ray_cluster.add_node(num_cpus=2, resources={"stable": 10})
+        v1 = ray_cluster.add_node(num_cpus=2, resources={"doomed": 10})
+        ray_cluster.wait_for_nodes(2)
+        ray_tpu.init(address=ray_cluster.address)
+
+        import tempfile
+
+        marker = os.path.join(tempfile.mkdtemp(), "exec_count")
+
+        @ray_tpu.remote
+        def slow_array(n, marker_path):
+            with open(marker_path, "a") as f:
+                f.write("x\n")
+            time.sleep(1.5)
+            return np.arange(n, dtype=np.float64)
+
+        @ray_tpu.remote
+        def consume(arr):
+            return float(arr[:10].sum())
+
+        ref = slow_array.options(resources={"doomed": 1}).remote(
+            300_000, marker)
+        ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=60)
+        assert ready == [ref]
+
+        ray_cluster.remove_node(v1)  # first fault: the only copy is lost
+        v2 = ray_cluster.add_node(num_cpus=2, resources={"doomed": 10})
+        ray_cluster.wait_for_nodes(2)
+
+        # borrower (a task on the stable node) forces the reconstruction
+        out_ref = consume.options(resources={"stable": 1}).remote(ref)
+
+        def execs():
+            try:
+                return len(open(marker).read().splitlines())
+            except OSError:
+                return 0
+
+        deadline = time.monotonic() + 60
+        while execs() < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert execs() >= 2, "reconstruction never started"
+        # second fault: kill the node mid-replay (the replay sleeps 1.5s
+        # after writing its marker line)
+        ray_cluster.remove_node(v2)
+        ray_cluster.add_node(num_cpus=2, resources={"doomed": 10})
+        ray_cluster.wait_for_nodes(2)
+
+        assert ray_tpu.get(out_ref, timeout=120) == float(sum(range(10)))
+        assert execs() >= 3, "second replay never ran"
+
+
+# ------------------------------------------------------------------- the soak
+
+
+@pytest.mark.slow
+class TestChaosSoak:
+    @pytest.mark.parametrize("seed", [1001, 1002, 1003])
+    def test_soak_heavier_schedules(self, seed):
+        run_chaos_workload(seed, drop_prob=0.05, dup_prob=0.1,
+                           delay_prob=0.1, delay_max_ms=40)
