@@ -50,6 +50,9 @@ class Config:
     # (reference inlines <100KB returns, core_worker.cc:2852 path).
     max_direct_call_object_size: int = 100 * 1024
     object_transfer_chunk_bytes: int = 8 * 1024**2
+    # cross-node pulls stream this many chunk RPCs concurrently (a bounded
+    # window keeps the wire full without buffering the whole object)
+    object_transfer_window: int = 4
     object_spilling_threshold: float = 0.8
     object_spilling_dir: str = ""
     # URI spill target (≈ the reference's object_spilling_config /

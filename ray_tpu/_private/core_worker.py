@@ -32,10 +32,12 @@ import os
 import random
 import threading
 import time
+import weakref
 from collections import OrderedDict, deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu._private import device_objects, serialization
+from ray_tpu._private.metrics import Counter, Gauge
 from ray_tpu._private.config import Config
 from ray_tpu._private.exceptions import (
     ActorDiedError,
@@ -69,6 +71,154 @@ from ray_tpu._private.task_spec import (
 logger = logging.getLogger(__name__)
 
 Address = Tuple[str, int]
+
+# ---- object data-plane metrics (per process; rendered by each daemon's
+# /metrics endpoint and read directly by counter-based tests) ----
+_m_reads = Counter(
+    "ray_tpu_object_reads_total",
+    "Object payload reads by mode (zero_copy = views over the arena mmap, "
+    "copy = bytes copied out of the store)")
+_m_read_bytes = Counter(
+    "ray_tpu_object_read_bytes_total",
+    "Payload bytes served on get, by mode")
+_m_put_bytes = Counter(
+    "ray_tpu_object_put_bytes_total",
+    "Payload bytes written on put/task-return, by path (arena/inline)")
+_m_pins = Gauge(
+    "ray_tpu_object_pins_outstanding",
+    "Arena pins this process holds (released when the last zero-copy "
+    "view is garbage-collected)")
+_m_locate_rpcs = Counter(
+    "ray_tpu_store_locate_rpcs_total",
+    "locate RPCs issued to node stores (a batch counts once)")
+
+
+class _PinGuard:
+    """Owns ONE supervisor-side pin across N zero-copy buffer views.
+
+    Each out-of-band buffer handed to pickle gets a finalizer that calls
+    dec(); once every view is gone AND arm() has confirmed construction
+    finished, the release callback fires exactly once. Finalizers run on
+    whatever thread drops the last reference, so the count is
+    lock-protected and the callback must be thread-safe."""
+
+    __slots__ = ("_release", "_count", "_armed", "_released", "_lock")
+
+    def __init__(self, release: Callable[[], None]):
+        self._release = release
+        self._count = 0
+        self._armed = False
+        self._released = False
+        self._lock = threading.Lock()
+
+    def inc(self) -> None:
+        with self._lock:
+            self._count += 1
+
+    def dec(self) -> None:
+        self._maybe_release(dec=True)
+
+    def arm(self) -> None:
+        """Construction done: release immediately if nothing kept a view
+        (pure in-band payloads), else wait for the finalizers."""
+        self._maybe_release(arm=True)
+
+    def _maybe_release(self, dec: bool = False, arm: bool = False) -> None:
+        with self._lock:
+            if dec:
+                self._count -= 1
+            if arm:
+                self._armed = True
+            fire = self._armed and self._count <= 0 and not self._released
+            if fire:
+                self._released = True
+        if fire:
+            self._release()
+
+
+class _LocateBatcher:
+    """Coalesces concurrent pinned-locate requests to this node's store
+    into ``store_locate_batch`` RPCs: a ``ray.get([refs...])`` burst costs
+    O(nodes) locate round-trips, not O(refs) (the shape that failed the
+    reference's 1k-refs microbench). Runs on the owning IO loop."""
+
+    MAX_BATCH = 512
+
+    def __init__(self, core: "CoreWorker"):
+        self._core = core
+        self._queue: List[Tuple[ObjectID, asyncio.Future]] = []
+        self._flushing = False
+
+    async def locate(self, oid: ObjectID) -> Optional[Tuple[int, int]]:
+        """Pinned locate of one object; returns (offset, size) or None.
+        The pin belongs to the caller from the moment a non-None result is
+        set — cancellation windows hand it back (see except branch)."""
+        fut = asyncio.get_running_loop().create_future()
+        self._queue.append((oid, fut))
+        if not self._flushing:
+            self._flushing = True
+            asyncio.get_running_loop().create_task(self._flush())
+        try:
+            return await fut
+        except asyncio.CancelledError:
+            # the RPC completed with a pin but our waiter was cancelled
+            # before consuming it: give the pin back
+            if (fut.done() and not fut.cancelled()
+                    and fut.exception() is None
+                    and fut.result() is not None):
+                self._core._schedule_unpin(oid)
+            raise
+
+    async def _flush(self) -> None:
+        try:
+            while self._queue:
+                # one tick so the whole submitting burst enqueues first
+                await asyncio.sleep(0)
+                batch = self._queue[: self.MAX_BATCH]
+                del self._queue[: len(batch)]
+                body = {
+                    "object_ids": [o.binary() for o, _ in batch],
+                    "pin": True,
+                    "client": self._core._store_client_id,
+                    # lets the supervisor's liveness sweep reclaim our
+                    # pins if this process is killed without cleanup
+                    "client_addr": self._core.address,
+                }
+                _m_locate_rpcs.inc()
+                try:
+                    # 600s: a batch may restore several spilled objects
+                    res = await self._core.clients.get(
+                        self._core.supervisor_addr).call(
+                            "store_locate_batch", body, timeout=600)
+                except Exception as e:  # noqa: BLE001 — fan the error out
+                    # Deliberately NO speculative unpin here even though
+                    # the handler may have executed with only the reply
+                    # lost: pins are per-client COUNTS, so a blind
+                    # decrement could steal the pin a retry just took and
+                    # recycle the range under a live view. A possibly
+                    # leaked pin is bounded (reclaimed on client death /
+                    # graceful departure); a stolen pin is corruption.
+                    for _, fut in batch:
+                        if not fut.done():
+                            fut.set_exception(e)
+                    continue
+                for (oid, fut), item in zip(batch, res):
+                    err = item.get("error") if isinstance(item, dict) else None
+                    pinned = item is not None and err is None
+                    if pinned:
+                        _m_pins.inc()
+                    if fut.done():  # waiter cancelled while we were out
+                        if pinned:
+                            self._core._schedule_unpin(oid)
+                        continue
+                    if err is not None:
+                        fut.set_exception(ObjectLostError(oid.hex(), err))
+                    elif item is None:
+                        fut.set_result(None)
+                    else:
+                        fut.set_result((item["offset"], item["size"]))
+        finally:
+            self._flushing = False
 
 _TRACE_PATH = os.environ.get("RAY_TPU_TRACE_FILE", "")
 
@@ -189,6 +339,14 @@ class CoreWorker:
 
         self.in_process = InProcessStore()
         self.objects: Dict[ObjectID, ObjectEntry] = {}
+        # identity under which this process pins arena objects; the
+        # supervisor releases a dead worker's pins by this id
+        self._store_client_id = self.worker_id.hex()
+        self._locate_batcher: Optional[_LocateBatcher] = None
+        # pending pin releases (filled by view finalizers from any thread,
+        # drained as store_unpin_batch frames by one flusher on the loop)
+        self._unpin_queue: deque = deque()
+        self._unpin_flushing = False
         # jax.Arrays put through the object layer stay in HBM, owned here
         # (device_objects.py — the compiled-DAG/channels answer)
         self.device_objects = device_objects.DeviceObjectRegistry()
@@ -280,6 +438,23 @@ class CoreWorker:
                 timeout=1.0)
         except Exception:
             pass
+        if self.supervisor_addr is not None:
+            # hand back every pin this client still holds (live zero-copy
+            # views die with the process; queued unpins were dropped when
+            # _shutdown flipped) — without this, a driver leaving a
+            # long-lived cluster would strand its pins until the
+            # supervisor restarts. Let an in-flight unpin batch land
+            # first so the wholesale release never races it into
+            # double-unpin errors.
+            deadline = time.monotonic() + 1.0
+            while self._unpin_flushing and time.monotonic() < deadline:
+                await asyncio.sleep(0.01)
+            try:
+                await self.clients.get(self.supervisor_addr).call(
+                    "store_release_client",
+                    {"client": self._store_client_id}, timeout=2)
+            except Exception:
+                pass
         for shape, leases in self._leases.items():
             for lease in leases:
                 try:
@@ -1304,6 +1479,7 @@ class CoreWorker:
             self.arena.view(r["offset"], total), meta, buffers)
         await sup.call("store_seal", {"object_id": oid.binary()},
                        timeout=600)
+        _m_put_bytes.inc(total, labels={"path": "arena"})
 
     async def _async_store_parts(self, oid: ObjectID, meta: bytes,
                                  buffers, total: int) -> ObjectEntry:
@@ -1330,6 +1506,7 @@ class CoreWorker:
             self.in_process.put(oid, packed)
             entry.state = INLINE
             entry.size = len(packed)
+            _m_put_bytes.inc(len(packed), labels={"path": "inline"})
         else:
             sup = self.clients.get(self.supervisor_addr)
             # 600s: creating a GiB-class object can sit behind another
@@ -1343,6 +1520,7 @@ class CoreWorker:
                 None, self.arena.write, r["offset"], packed)
             await sup.call("store_seal", {"object_id": oid.binary()},
                            timeout=600)
+            _m_put_bytes.inc(len(packed), labels={"path": "arena"})
             entry.state = SHARED
             entry.size = len(packed)
             entry.location = self.supervisor_addr
@@ -1541,46 +1719,184 @@ class CoreWorker:
         return await loop.run_in_executor(
             None, device_objects.assemble, meta, shard_data)
 
+    def _schedule_unpin(self, oid: ObjectID) -> None:
+        """Release one of our pins on the local store, from any thread
+        (zero-copy view finalizers fire wherever GC drops the last
+        reference). Releases coalesce into ``store_unpin_batch`` calls —
+        a burst of view GCs costs one RPC, and an unpin never sits on the
+        critical path ahead of the next get's locate. A ``call`` (not
+        notify) so a transport blip cannot silently leak the pin; the
+        replay cache dedupes its retries."""
+        if self._shutdown or self.supervisor_addr is None:
+            return
+        self._unpin_queue.append(oid.binary())
+        _m_pins.dec()
+        try:
+            self.loop.call_soon_threadsafe(self._kick_unpin_flusher)
+        except RuntimeError:
+            pass  # loop already closed (interpreter shutdown)
+
+    def _kick_unpin_flusher(self) -> None:
+        if self._unpin_flushing or not self._unpin_queue:
+            return
+        self._unpin_flushing = True
+        asyncio.get_running_loop().create_task(self._flush_unpins())
+
+    async def _flush_unpins(self) -> None:
+        try:
+            while self._unpin_queue:
+                batch = []
+                while self._unpin_queue and len(batch) < 512:
+                    batch.append(self._unpin_queue.popleft())
+                try:
+                    # retry_call: every attempt shares ONE (client_id,
+                    # msg_id) replay-cache key, so a retry after a lost
+                    # reply can NEVER re-execute the unpins (a double
+                    # release would recycle an arena range under a live
+                    # view elsewhere)
+                    await retry_call(
+                        self.clients.get(self.supervisor_addr),
+                        "store_unpin_batch",
+                        {"entries": batch,
+                         "client": self._store_client_id},
+                        timeout=120, per_call_timeout=30,
+                        base_interval_s=(
+                            self.config.rpc_retry_interval_ms / 1000.0))
+                except Exception:
+                    logger.warning(
+                        "dropping %d unpin(s): supervisor unreachable; "
+                        "the pins fall to the supervisor's dead-client "
+                        "reclamation (or die with it)", len(batch))
+        finally:
+            self._unpin_flushing = False
+
+    def _unpack_pinned_sync(self, oid: ObjectID, offset: int, size: int) -> Any:
+        """Deserialize an arena object ZERO-COPY: out-of-band payload
+        buffers become read-only numpy views over this process's own
+        arena mmap — no copy-out — and the pin taken by the locate is
+        released by a finalizer when the LAST view is garbage-collected
+        (mutation of a returned array raises: the arena is shared,
+        immutable storage). Pure in-band payloads (no buffers) release
+        the pin immediately after unpickling — pickle copies in-band
+        data, so nothing references the arena ("copy-on-read" for
+        non-buffer payloads)."""
+        guard = _PinGuard(lambda: self._schedule_unpin(oid))
+        try:
+            view = self.arena.view(offset, size).toreadonly()
+            try:
+                import numpy as np
+            except ImportError:
+                np = None
+            if np is None:
+                # no numpy in this process: copy out, release immediately
+                data = bytes(view)
+                _m_reads.inc(labels={"mode": "copy"})
+                _m_read_bytes.inc(size, labels={"mode": "copy"})
+                return serialization.unpack(data)
+
+            def factory(sub: memoryview):
+                base = np.frombuffer(sub, dtype=np.uint8)
+                guard.inc()
+                weakref.finalize(base, guard.dec)
+                return base
+
+            obj, n_buf = serialization.unpack_zero_copy(view, factory)
+        finally:
+            # exactly-once: the guard owns the pin on every exit — it
+            # fires now if no view survived (error, or none was created),
+            # else when the last finalizer runs
+            guard.arm()
+        # an in-band-only payload (no out-of-band buffers) was COPIED by
+        # pickle while parsing — label it honestly
+        mode = "zero_copy" if n_buf > 0 else "copy"
+        _m_reads.inc(labels={"mode": mode})
+        _m_read_bytes.inc(size, labels={"mode": mode})
+        return obj
+
     async def _read_shared(self, oid: ObjectID, size: int, node_addr: Address) -> Any:
         sup = self.clients.get(self.supervisor_addr or node_addr)
         if self.supervisor_addr is not None and tuple(node_addr) != tuple(self.supervisor_addr):
+            # remote object: the local supervisor pulls it into our node's
+            # arena first (chunked, pipelined — supervisor._do_pull), then
+            # the local zero-copy path below serves it
             await sup.call(
                 "pull_object",
                 {"object_id": oid.binary(), "from": node_addr, "size": size},
                 timeout=600,
             )
-        # pin so the range cannot be spilled/recycled between the locate reply
-        # and our copy out of the mmap
-        # 600s: locate may RESTORE a spilled GiB-class object first
-        loc = await sup.call("store_locate",
-                             {"object_id": oid.binary(), "pin": True},
-                             timeout=600)
-        if loc is None:
-            raise ObjectLostError(oid.hex(), "not in local store")
         if self.arena is not None and self.supervisor_addr is not None:
+            # pin-backed zero-copy read: one (batched) locate pins the
+            # range; deserialization views the mmap directly and the pin
+            # lives until the last view is GC'd (finalizer in
+            # _unpack_pinned_sync)
+            if self._locate_batcher is None:
+                self._locate_batcher = _LocateBatcher(self)
+            loc = await self._locate_batcher.locate(oid)
+            if loc is None:
+                raise ObjectLostError(oid.hex(), "not in local store")
+            offset, lsize = loc
+            # only a big IN-BAND portion makes unpacking heavy (pickle
+            # copies it); out-of-band buffers are O(1) views — a 1 GiB
+            # numpy payload unpacks in microseconds and must not pay a
+            # thread hop
             try:
-                data = bytes(self.arena.view(loc["offset"], loc["size"]))
-            finally:
-                await sup.notify("store_unpin", {"object_id": oid.binary()})
-        else:
-            # no local arena (e.g. detached utility process): stream chunks
-            try:
-                pos = 0
-                chunks = []
-                while pos < size:
-                    c = await sup.call(
-                        "store_read_chunk",
-                        {
-                            "object_id": oid.binary(),
-                            "offset": pos,
-                            "length": self.config.object_transfer_chunk_bytes,
-                        },
-                    )
-                    chunks.append(c)
-                    pos += len(c)
-                data = b"".join(chunks)
-            finally:
-                await sup.notify("store_unpin", {"object_id": oid.binary()})
+                heavy = serialization.inband_size(
+                    self.arena.view(offset, lsize)) > 4 * 1024 * 1024
+            except Exception:
+                self._schedule_unpin(oid)  # corrupt header: hand it back
+                raise
+            if heavy:
+                # shield: if this get is cancelled mid-await, the unpack
+                # still runs, the guard still takes the pin, and the
+                # unreferenced result releases it via the finalizers —
+                # an unshielded cancel-before-start would strand the pin
+                return await asyncio.shield(
+                    asyncio.get_running_loop().run_in_executor(
+                        None, self._unpack_pinned_sync, oid, offset,
+                        lsize))
+            return self._unpack_pinned_sync(oid, offset, lsize)
+        # no local arena (e.g. detached utility process): pin at the remote
+        # store and stream chunks — the copy path
+        pinned = False
+        try:
+            loc = await sup.call(
+                "store_locate",
+                {"object_id": oid.binary(), "pin": True,
+                 "client": self._store_client_id,
+                 "client_addr": self.address},
+                timeout=600)
+            if loc is None:
+                raise ObjectLostError(oid.hex(), "not in local store")
+            pinned = True
+            _m_pins.inc()
+            pos = 0
+            chunks = []
+            while pos < size:
+                c = await sup.call(
+                    "store_read_chunk",
+                    {
+                        "object_id": oid.binary(),
+                        "offset": pos,
+                        "length": self.config.object_transfer_chunk_bytes,
+                    },
+                )
+                chunks.append(c)
+                pos += len(c)
+            data = b"".join(chunks)
+        finally:
+            if pinned:
+                _m_pins.dec()
+                try:
+                    await sup.call(
+                        "store_unpin",
+                        {"object_id": oid.binary(),
+                         "client": self._store_client_id},
+                        timeout=60)
+                except Exception:
+                    logger.debug("remote unpin of %s failed",
+                                 oid.hex()[:12], exc_info=True)
+        _m_reads.inc(labels={"mode": "copy"})
+        _m_read_bytes.inc(size, labels={"mode": "copy"})
         return serialization.unpack(data)
 
     def wait(

@@ -44,6 +44,13 @@ class Metric:
         self._lock = threading.Lock()
         registry._register(self)
 
+    def value(self, labels: Optional[Dict[str, str]] = None) -> float:
+        """Current value for one label set (counter-based assertions in
+        tests; 0.0 when the series was never set/incremented). Only
+        meaningful for single-valued metrics (Counter/Gauge)."""
+        with self._lock:
+            return getattr(self, "_values", {}).get(_label_key(labels), 0.0)
+
 
 class Counter(Metric):
     TYPE = "counter"

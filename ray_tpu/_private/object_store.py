@@ -14,9 +14,12 @@ Design:
     free-list allocator with coalescing (stand-in for the dlmalloc arena; the
     C++ allocator in src/ replaces it without changing the protocol).
   * Clients create (RPC → offset), write payload bytes directly into the
-    mapping, then seal. Reads locate (RPC → offset,size) and copy out of the
-    mapping during deserialization. Copy-on-read keeps eviction/spilling free
-    of dangling-view hazards; pin-based zero-copy is a later optimization.
+    mapping, then seal. Reads locate (RPC → offset,size, pin=True) and
+    deserialize ZERO-COPY: out-of-band payload buffers become read-only
+    views over the reader's own mapping, and the pin — tracked per client
+    so a crashed reader's pins can be reclaimed — protects the range from
+    spill/free until the last view is garbage-collected
+    (≈ plasma's get/release pinning; see core_worker._read_shared).
   * Spilling under memory pressure moves sealed, unreferenced objects to disk
     (analog of `external_storage.py:185`), restored on demand.
 
@@ -202,7 +205,10 @@ class ObjectMeta:
     spill_path: str = ""
     last_access: float = 0.0
     freed: bool = False  # owner released it; eligible for deletion
-    pins: int = 0  # readers copying out of the arena; blocks spill/free
+    pins: int = 0  # readers holding views over the arena; blocks spill/free
+    # pin counts per client id (worker/driver/puller) so the pins of a
+    # crashed client can be released instead of blocking spill forever
+    pin_clients: Dict[str, int] = dataclasses.field(default_factory=dict)
 
 
 class NodeObjectStore:
@@ -224,6 +230,9 @@ class NodeObjectStore:
         self.spill_storage = spill_storage
         self.num_spilled = 0
         self.num_restored = 0
+        # reverse index: client id -> object ids it currently pins (release
+        # path for dead clients; see release_client_pins)
+        self._client_pins: Dict[str, set] = {}
 
     # ---- creation ----
 
@@ -262,13 +271,16 @@ class NodeObjectStore:
         m = self._objects.get(object_id)
         return m is not None and m.state in (IN_MEMORY, SPILLED)
 
-    def locate(self, object_id: ObjectID, pin: bool = False) -> Optional[Tuple[int, int]]:
+    def locate(self, object_id: ObjectID, pin: bool = False,
+               client: str = "") -> Optional[Tuple[int, int]]:
         """Return (offset, size), restoring from spill if needed.
 
         With pin=True the range is protected from spill/free until unpin() —
-        readers copy out of their own mmap after the RPC returns, so the
-        window between locate and copy must not recycle the range
-        (≈ plasma's get/release pinning).
+        readers deserialize zero-copy views over their own mmap after the
+        RPC returns, so the range must not be recycled while any view is
+        alive (≈ plasma's get/release pinning). Pins are attributed to
+        ``client`` so release_client_pins() can reclaim the pins of a
+        crashed reader.
         """
         meta = self._objects.get(object_id)
         if meta is None or meta.state == CREATING:
@@ -278,15 +290,56 @@ class NodeObjectStore:
         meta.last_access = time.monotonic()
         if pin:
             meta.pins += 1
+            meta.pin_clients[client] = meta.pin_clients.get(client, 0) + 1
+            self._client_pins.setdefault(client, set()).add(object_id)
         return (meta.offset, meta.size)
 
-    def unpin(self, object_id: ObjectID) -> None:
+    def pinned_clients(self) -> List[str]:
+        """Client ids currently holding pins (liveness-sweep input)."""
+        return list(self._client_pins.keys())
+
+    def unpin(self, object_id: ObjectID, client: str = "") -> bool:
+        """Release one pin held by ``client``. An unpin with no matching
+        pin is a protocol bug (double-unpin, or unpin of a never-pinned
+        object) and raises — bulk reclamation for dead/departing clients
+        goes through release_client_pins() instead."""
         meta = self._objects.get(object_id)
-        if meta is None:
-            return
-        meta.pins = max(0, meta.pins - 1)
+        if meta is None or meta.pins <= 0 \
+                or meta.pin_clients.get(client, 0) <= 0:
+            raise ValueError(
+                f"unpin without matching pin: object="
+                f"{object_id.hex()[:16]} client={client!r} "
+                f"(double-unpin or unpin of a never-pinned object)")
+        meta.pins -= 1
+        remaining = meta.pin_clients[client] - 1
+        if remaining > 0:
+            meta.pin_clients[client] = remaining
+        else:
+            del meta.pin_clients[client]
+            held = self._client_pins.get(client)
+            if held is not None:
+                held.discard(object_id)
+                if not held:
+                    self._client_pins.pop(client, None)
         if meta.freed and meta.pins == 0:
             self.free(object_id)
+        return True
+
+    def release_client_pins(self, client: str) -> int:
+        """Drop every pin held by ``client`` (it died without unpinning).
+        Returns the number of pins released; deferred frees fire for
+        objects whose last pin this was."""
+        released = 0
+        for object_id in self._client_pins.pop(client, set()):
+            meta = self._objects.get(object_id)
+            if meta is None:
+                continue
+            count = meta.pin_clients.pop(client, 0)
+            meta.pins = max(0, meta.pins - count)
+            released += count
+            if meta.freed and meta.pins == 0:
+                self.free(object_id)
+        return released
 
     def read_chunk(self, object_id: ObjectID, offset: int, length: int) -> bytes:
         loc = self.locate(object_id)
@@ -377,6 +430,8 @@ class NodeObjectStore:
             "num_spilled_now": spilled,
             "total_spills": self.num_spilled,
             "total_restores": self.num_restored,
+            "pinned_objects": sum(1 for m in metas if m.pins > 0),
+            "pins_total": sum(m.pins for m in metas),
         }
 
     def shutdown(self) -> None:

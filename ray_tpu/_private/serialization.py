@@ -97,6 +97,36 @@ def unpack(data) -> Any:
     return pickle.loads(meta, buffers=buffers)
 
 
+def inband_size(view) -> int:
+    """Bytes pickle will parse IN-BAND for this packed payload (the meta
+    pickle). Out-of-band buffers deserialize as O(1) views, so this — not
+    the total size — is what decides whether unpacking is heavy."""
+    _, len_meta = _HEADER.unpack_from(view, 0)
+    return len_meta
+
+
+def unpack_zero_copy(view: memoryview, buffer_factory) -> Tuple[Any, int]:
+    """unpack() variant for pin-backed zero-copy reads: each out-of-band
+    payload buffer is routed through ``buffer_factory(sub_view)`` and the
+    factory's RESULT is what pickle hands to the reconstructor (numpy et
+    al. keep a reference to it for the life of the deserialized array) —
+    the caller uses that hook to attach pin-release finalizers. In-band
+    meta is parsed by pickle without retaining the input buffer, so only
+    out-of-band buffers keep the arena range alive. Returns
+    (obj, n_out_of_band_buffers)."""
+    n_buf, len_meta = _HEADER.unpack_from(view, 0)
+    off = _HEADER.size
+    meta = view[off : off + len_meta]
+    off += len_meta
+    buffers = []
+    for _ in range(n_buf):
+        (blen,) = _BUFLEN.unpack_from(view, off)
+        off += _BUFLEN.size
+        buffers.append(buffer_factory(view[off : off + blen]))
+        off += blen
+    return pickle.loads(meta, buffers=buffers), n_buf
+
+
 def packed_size(obj: Any) -> Tuple[bytes, List[pickle.PickleBuffer], int]:
     """Serialize and report total packed size without concatenating."""
     meta, buffers = serialize(obj)

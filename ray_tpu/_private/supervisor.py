@@ -214,6 +214,30 @@ class Supervisor:
             "ray_tpu_lease_queue_depth", "Queued + infeasible leases")
         self._m_store_bytes = Gauge(
             "ray_tpu_object_store_bytes", "Object store usage by kind")
+        self._m_transfer_bytes = Counter(
+            "ray_tpu_object_transfer_bytes_total",
+            "Object bytes pulled from remote nodes (chunked transfer)")
+        self._m_transfer_chunks = Counter(
+            "ray_tpu_object_transfer_chunks_total",
+            "Chunk RPCs completed by the pipelined cross-node pull")
+        self._m_pins_released = Counter(
+            "ray_tpu_store_pins_released_total",
+            "Pins force-released on behalf of dead clients")
+        # node ids seen alive in the synced view; a node leaving this set
+        # has its cross-node pull pins force-released (its pulls died
+        # with it)
+        self._alive_node_hexes: Set[str] = set()
+        # pin-holding clients that are neither our workers nor nodes
+        # (drivers attached to this cluster): last known RPC address and
+        # consecutive probe failures, for the liveness sweep that
+        # reclaims a SIGKILLed driver's pins
+        self._pin_client_addrs: Dict[str, Address] = {}
+        self._pin_client_fails: Dict[str, int] = {}
+        self._pin_sweep_task: Optional[asyncio.Task] = None
+        # clients whose pins were just force/bulk-released: a straggler
+        # unpin retry from them is a benign shutdown race, not the
+        # protocol bug the strict unpin guards against
+        self._released_clients: Dict[str, float] = {}
         # original (driver) environment for spawning TPU workers
         self._orig_env = dict(os.environ)
         orig_axon = os.environ.get("RAY_TPU_AXON_ORIG")
@@ -240,6 +264,7 @@ class Supervisor:
         self._reap_task = loop.create_task(self._reap_loop())
         self._monitor_task = loop.create_task(self._monitor_loop())
         self._log_task = loop.create_task(self._log_tail_loop())
+        self._pin_sweep_task = loop.create_task(self._pin_sweep_loop())
         if self.config.memory_usage_threshold > 0:
             self._memory_task = loop.create_task(self._memory_monitor_loop())
         if self.config.metrics_export_port >= 0:
@@ -281,7 +306,7 @@ class Supervisor:
 
     async def stop(self) -> None:
         for t in (self._sync_task, self._reap_task, self._monitor_task,
-                  self._log_task, self._memory_task):
+                  self._log_task, self._memory_task, self._pin_sweep_task):
             if t is not None:
                 t.cancel()
         if self.metrics_server is not None:
@@ -362,6 +387,19 @@ class Supervisor:
                 ]
                 self._reevaluate_infeasible()
                 self._reevaluate_queued()
+                # a dead node's in-flight pulls pinned objects here under
+                # "node:<hex>" — reclaim them so spill/free unblock
+                alive_now = {v.node_id_hex for v in self.cluster_view
+                             if v.alive}
+                for gone in self._alive_node_hexes - alive_now:
+                    if gone != self.node_id.hex():
+                        await self._release_dead_client_pins(
+                            f"node:{gone}", "node")
+                for back in alive_now - self._alive_node_hexes:
+                    # a flapped node re-registered: let its pulls pin
+                    # again (fresh pins; the released ones stay released)
+                    self._released_clients.pop(f"node:{back}", None)
+                self._alive_node_hexes = alive_now
             except Exception as e:
                 logger.debug("sync failed: %s", e)
             await asyncio.sleep(0.2)
@@ -828,10 +866,81 @@ class Supervisor:
                 except Exception:
                     logger.exception("worker-exit handling failed for %s", w.worker_id_hex[:8])
 
+    async def _release_dead_client_pins(self, client: str, what: str) -> None:
+        """A pinning client died: reclaim its pins so spill/free unblock
+        (a leaked pin would otherwise block spilling that object forever)."""
+        self._mark_client_released(client)
+        try:
+            released = await self._store_op(
+                self.store.release_client_pins, client)
+        except Exception:
+            logger.exception("pin release for dead %s %s failed", what, client)
+            return
+        if released:
+            self._m_pins_released.inc(released)
+            logger.warning("released %d pin(s) held by dead %s %s",
+                           released, what, client[:16])
+
+    def _mark_client_released(self, client: str) -> None:
+        """Remember a bulk-released client for a while: its in-flight
+        unpin retries are a benign race, not a double-unpin bug."""
+        now = time.monotonic()
+        self._released_clients[client] = now
+        self._pin_client_addrs.pop(client, None)
+        self._pin_client_fails.pop(client, None)
+        # keep entries past the longest locate RPC budget (600s) so even
+        # the most delayed straggler cannot re-pin for a released client
+        for c, t in list(self._released_clients.items()):
+            if now - t > 1200:
+                del self._released_clients[c]
+
+    def _log_unpin_rejects(self, client: str, errors) -> None:
+        """Strict-unpin rejections are protocol bugs — unless the client
+        was just bulk-released (shutdown/reclaim racing a retry)."""
+        level = (logger.debug if client in self._released_clients
+                 else logger.error)
+        for e in errors:
+            level("store_unpin rejected: %s", e)
+
+    async def _pin_sweep_loop(self) -> None:
+        """Reclaim pins of crashed DRIVERS. Workers are covered by the
+        exit monitor, remote nodes by the view sync — a driver that was
+        SIGKILLed while holding zero-copy views is covered by nobody, so
+        probe pin-holding non-worker clients at their recorded RPC
+        address and release after 3 consecutive connect failures (the
+        health-check pattern the controller uses for nodes; a live but
+        busy driver still accepts TCP on its IO loop)."""
+        while True:
+            await asyncio.sleep(5.0)
+            try:
+                clients = await self._store_op(self.store.pinned_clients)
+                for client in clients:
+                    if client in self.workers or client.startswith("node:"):
+                        continue
+                    addr = self._pin_client_addrs.get(client)
+                    if addr is None:
+                        continue  # pre-address pin (legacy/unknown): skip
+                    try:
+                        await self.clients.get(tuple(addr)).call(
+                            "ping", timeout=3)
+                        self._pin_client_fails.pop(client, None)
+                    except Exception:
+                        fails = self._pin_client_fails.get(client, 0) + 1
+                        self._pin_client_fails[client] = fails
+                        # a connection churn must not steal pins under a
+                        # live view: require sustained unreachability
+                        if fails >= 3:
+                            self.clients.drop(tuple(addr))
+                            await self._release_dead_client_pins(
+                                client, "driver")
+            except Exception:
+                logger.exception("pin liveness sweep failed")
+
     async def _on_worker_exit(self, w: WorkerHandle) -> None:
         _trace(f"worker_exit {w.worker_id_hex[:8]} is_actor={w.is_actor} actor={w.actor_id_hex[:8]} code={w.proc.poll() if w.proc else None}")
         self.workers.pop(w.worker_id_hex, None)
         self._m_worker_exits.inc()
+        await self._release_dead_client_pins(w.worker_id_hex, "worker")
         await self._drain_worker_logs(w)
         try:
             self.idle.get(w.env_key, deque()).remove(w)
@@ -1064,8 +1173,12 @@ class Supervisor:
                 _trace(f"reap {w.worker_id_hex[:8]} is_actor={w.is_actor}")
                 self.workers.pop(w.worker_id_hex, None)
                 try:
-                    asyncio.get_running_loop().create_task(
-                        self._drain_worker_logs(w))
+                    loop = asyncio.get_running_loop()
+                    loop.create_task(self._drain_worker_logs(w))
+                    # a reaped worker skips _on_worker_exit (it already
+                    # left self.workers) — reclaim its pins here
+                    loop.create_task(self._release_dead_client_pins(
+                        w.worker_id_hex, "reaped worker"))
                 except RuntimeError:
                     pass
                 if w.proc is not None:
@@ -1121,16 +1234,102 @@ class Supervisor:
     async def rpc_store_abort(self, body) -> None:
         await self._store_op(self.store.abort, ObjectID(body["object_id"]))
 
+    def _note_pin_client(self, body) -> None:
+        """Record a pinning client's RPC address for the liveness sweep.
+        Raises for a client whose pins were already bulk-released: a
+        chaos-delayed straggler locate from a dead/departed client would
+        otherwise re-pin under an id nothing will ever reclaim."""
+        if not body.get("pin") or not body.get("client"):
+            return
+        if body["client"] in self._released_clients:
+            raise ValueError(
+                f"pinning client {body['client'][:16]} was already "
+                f"released as dead/departed")
+        if body.get("client_addr"):
+            self._pin_client_addrs[body["client"]] = tuple(
+                body["client_addr"])
+
     @replay_cached  # pin=True re-execution leaks a pin count
     async def rpc_store_locate(self, body):
+        self._note_pin_client(body)
         loc = await self._store_op(
             lambda: self.store.locate(ObjectID(body["object_id"]),
-                                      pin=body.get("pin", False)))
+                                      pin=body.get("pin", False),
+                                      client=body.get("client", "")))
         return None if loc is None else {"offset": loc[0], "size": loc[1]}
 
+    @replay_cached  # pin=True re-execution leaks pin counts
+    async def rpc_store_locate_batch(self, body):
+        """Batched locate: ONE RPC resolves (and optionally pins) many
+        objects — `ray.get([refs...])` costs O(nodes) locate round-trips
+        instead of O(refs). Per-object failures (e.g. a restore that hits
+        store-full) are isolated as {'error': ...} entries so one bad
+        object cannot leak the pins the rest of the batch took."""
+        pin = body.get("pin", False)
+        client = body.get("client", "")
+        self._note_pin_client(body)
+
+        def run():
+            out = []
+            for raw in body["object_ids"]:
+                try:
+                    loc = self.store.locate(ObjectID(raw), pin=pin,
+                                            client=client)
+                except Exception as e:  # noqa: BLE001 — isolate per object
+                    out.append({"error": f"{type(e).__name__}: {e}"})
+                    continue
+                out.append(None if loc is None
+                           else {"offset": loc[0], "size": loc[1]})
+            return out
+
+        return await self._store_op(run)
+
     @replay_cached  # double-unpin would release someone else's pin
-    async def rpc_store_unpin(self, body) -> None:
-        await self._store_op(self.store.unpin, ObjectID(body["object_id"]))
+    async def rpc_store_unpin(self, body) -> bool:
+        try:
+            return await self._store_op(
+                lambda: self.store.unpin(
+                    ObjectID(body["object_id"]),
+                    client=body.get("client", "")))
+        except ValueError as e:
+            # protocol bug (double-unpin) — except for a just-released
+            # client, where a straggler retry is a benign shutdown race
+            self._log_unpin_rejects(body.get("client", ""), [e])
+            raise
+
+    @idempotent  # releasing an already-empty client is a no-op
+    async def rpc_store_release_client(self, body) -> int:
+        """A departing client (driver/worker leaving the cluster
+        gracefully) hands back every pin it still holds — its zero-copy
+        views die with it, so the pins must not outlive it."""
+        self._mark_client_released(body.get("client", ""))
+        released = await self._store_op(
+            self.store.release_client_pins, body.get("client", ""))
+        if released:
+            logger.info("released %d pin(s) from departing client %s",
+                        released, body.get("client", "")[:16])
+        return released
+
+    @replay_cached  # re-execution would double-release pins
+    async def rpc_store_unpin_batch(self, body) -> int:
+        """Coalesced pin releases (the GC-driven twin of
+        store_locate_batch). Bad entries (double-unpin) are logged and
+        counted, never allowed to strand the rest of the batch. Returns
+        the number of rejected entries."""
+        client = body.get("client", "")
+
+        def run():
+            errors = []
+            for raw in body["entries"]:
+                try:
+                    self.store.unpin(ObjectID(raw), client=client)
+                except ValueError as e:
+                    errors.append(str(e))
+            return errors
+
+        errors = await self._store_op(run)
+        self._log_unpin_rejects(client, errors)
+        return len(errors)
 
     @idempotent
     async def rpc_store_contains(self, body) -> bool:
@@ -1187,35 +1386,71 @@ class Supervisor:
                 fut.cancel()
 
     async def _do_pull(self, oid: ObjectID, source: Address, size: int) -> dict:
+        """Chunked, PIPELINED transfer: a bounded window of concurrent
+        chunk RPCs streams the object straight into the pre-created arena
+        allocation (no whole-object pickle frame, no reassembly buffer —
+        each chunk lands with one write at its own offset). Chunk reads
+        are idempotent and same-offset rewrites converge, so transport
+        retries under drop/dup chaos are safe."""
         offset = await self._store_op(self.store.create, oid, size)
         src = self.clients.get(source)
         chunk = self.config.object_transfer_chunk_bytes
+        window = max(1, self.config.object_transfer_window)
+        client = f"node:{self.node_id.hex()}"
         pinned = False
+        tasks: List[asyncio.Task] = []
         try:
             # pin at the source for the duration of the chunked transfer
             pinned = (
                 await src.call(
-                    "store_locate", {"object_id": oid.binary(), "pin": True}, timeout=60
+                    "store_locate",
+                    {"object_id": oid.binary(), "pin": True,
+                     "client": client},
+                    timeout=60,
                 )
                 is not None
             )
-            pos = 0
-            while pos < size:
-                data = await src.call(
-                    "store_read_chunk",
-                    {"object_id": oid.binary(), "offset": pos, "length": chunk},
-                    timeout=60,
-                )
-                await self._store_op(self.store.arena.write,
-                                     offset + pos, data)
-                pos += len(data)
+            if not pinned:
+                raise KeyError(f"object {oid.hex()} not at source node")
+
+            sem = asyncio.Semaphore(window)
+
+            async def fetch(pos: int) -> int:
+                async with sem:
+                    data = await src.call(
+                        "store_read_chunk",
+                        {"object_id": oid.binary(), "offset": pos,
+                         "length": chunk},
+                        timeout=600,
+                    )
+                    await self._store_op(self.store.arena.write,
+                                         offset + pos, data)
+                    self._m_transfer_chunks.inc()
+                    return len(data)
+
+            loop = asyncio.get_running_loop()
+            tasks = [loop.create_task(fetch(pos))
+                     for pos in range(0, size, chunk)]
+            moved = sum(await asyncio.gather(*tasks))
+            if moved != size:
+                raise RuntimeError(f"short pull: {moved}/{size} bytes")
+            self._m_transfer_bytes.inc(moved)
         except Exception:
+            # in-flight chunk writes must stop BEFORE abort recycles the
+            # range, or a straggler would scribble over a reallocation
+            for t in tasks:
+                t.cancel()
+            if tasks:
+                await asyncio.gather(*tasks, return_exceptions=True)
             await self._store_op(self.store.abort, oid)
             raise
         finally:
             if pinned:
                 try:
-                    await src.notify("store_unpin", {"object_id": oid.binary()})
+                    await src.call(
+                        "store_unpin",
+                        {"object_id": oid.binary(), "client": client},
+                        timeout=30)
                 except Exception:
                     pass
         await self._store_op(self.store.seal, oid)

@@ -30,6 +30,11 @@ CHAOS_METHODS = ",".join([
     "actor_register", "actor_ready", "worker_register", "worker_died",
     "kv_put", "job_new", "node_sync",
     "store_create", "store_seal", "store_locate",
+    # zero-copy data plane: batched pinned locates, coalesced unpins, and
+    # the pipelined cross-node chunk stream (chunk reads are idempotent;
+    # pin-taking RPCs ride the replay cache, so drop/dup must converge)
+    "store_locate_batch", "store_unpin", "store_unpin_batch",
+    "store_read_chunk", "pull_object",
 ])
 
 
@@ -70,6 +75,9 @@ def run_chaos_workload(
     cfg.chaos_delay_prob = delay_prob
     cfg.chaos_delay_max_ms = delay_max_ms
     cfg.chaos_methods = CHAOS_METHODS
+    # small chunks so the ~3 MB cross-node object below streams as many
+    # chunk RPCs — the pipelined-transfer path the schedule attacks
+    cfg.object_transfer_chunk_bytes = 256 * 1024
 
     cluster = Cluster(config=cfg)
     workdir = tempfile.mkdtemp(prefix=f"chaos_seed{seed}_")
@@ -115,7 +123,16 @@ def run_chaos_workload(
             time.sleep(2.0)
             return "done"
 
+        @ray_tpu.remote
+        def make_big():
+            import numpy as np
+            return np.arange(400_000, dtype=np.float64)  # ~3 MB, chunked
+
         refs = [square.remote(i) for i in range(16)]
+        # lands on the doomed node's arena: the cross-node pull races the
+        # node kill, and the post-kill get exercises lineage
+        # reconstruction + a second chunked transfer
+        big_ref = make_big.options(resources={"doomed": 1}).remote()
         counter = Counter.options(resources={"stable": 1},
                                   max_restarts=3).remote()
         incs = [counter.incr.remote() for _ in range(10)]
@@ -167,6 +184,11 @@ def run_chaos_workload(
             assert result.metrics["step"] == 2, result.metrics
 
         assert ray_tpu.get(refs, timeout=120) == [i * i for i in range(16)]
+        import numpy as np
+        big = ray_tpu.get(big_ref, timeout=120)
+        assert np.array_equal(big, np.arange(400_000, dtype=np.float64)), \
+            "chunked cross-node object corrupted under chaos"
+        del big
         assert sorted(ray_tpu.get(incs, timeout=120)) == list(range(1, 11))
         assert ray_tpu.get(counter.total.remote(), timeout=60) == 10
         assert ray_tpu.get(crash_ref, timeout=120) == "survived"

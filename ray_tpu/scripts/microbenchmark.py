@@ -2,14 +2,17 @@
 
 Analog of `ray microbenchmark` (`python/ray/_private/ray_perf.py:93-180`):
 ops/s for the hot core paths — put/get of small objects, large-object
-store throughput, sync/async task submission, sync/async actor calls, and
-`wait` over a thousand refs. Run against a live cluster:
+store throughput (including the pin-backed zero-copy get of a 64 MiB
+numpy payload and a 1000-ref multi-get driving the batched locate path),
+sync/async task submission, sync/async actor calls, and `wait` over a
+thousand refs. Run against a live cluster:
 
     python -m ray_tpu.scripts.microbenchmark [--num-cpus N] [--json]
 
 Each benchmark runs for a fixed wall budget and reports ops/s; `--json`
-prints one machine-readable line per benchmark (the driver-side record
-for BENCH artifacts).
+prints one machine-readable line per benchmark in `bench.py`'s artifact
+record shape ({"metric", "value", "unit", "detail"}), so microbenchmark
+output drops straight into the BENCH_* artifact flow.
 """
 
 from __future__ import annotations
@@ -74,6 +77,50 @@ def run_all(budget_s: float = 2.0) -> List[Dict[str, float]]:
     gbs = _rate(put_big, budget_s) * 10 / 1024
     results.append({"benchmark": "single_client_put_gigabytes",
                     "value": round(gbs, 3), "unit": "GiB/s"})
+
+    # -- 64 MiB numpy put: protocol-5 buffers land in the arena with one
+    # memcpy each (no intermediate join)
+    big_arr = np.random.default_rng(0).standard_normal(8 * 1024 * 1024)
+
+    def put_large():
+        for _ in range(2):
+            ray_tpu.put(big_arr)
+        return 2
+
+    gbs = _rate(put_large, budget_s) * big_arr.nbytes / 1024**3
+    results.append({"benchmark": "single_client_put_large_numpy",
+                    "value": round(gbs, 3), "unit": "GiB/s"})
+
+    # -- 64 MiB numpy get: pin-backed ZERO-COPY (read-only views over the
+    # caller's arena mmap; no copy-out). The pre-PR copy path payed one
+    # full memcpy per get — the acceptance bar is >= 5x over that.
+    ref_big = ray_tpu.put(big_arr)
+
+    def get_large():
+        for _ in range(4):
+            a = ray_tpu.get(ref_big)
+            assert a.nbytes == big_arr.nbytes
+        return 4
+
+    gbs = _rate(get_large, budget_s) * big_arr.nbytes / 1024**3
+    results.append({"benchmark": "single_client_get_large_zero_copy",
+                    "value": round(gbs, 3), "unit": "GiB/s"})
+
+    # -- multi-ref get of 1000 small ARENA objects (128 KB each — above
+    # the inline threshold, so every ref resolves through the store and
+    # the batched locate: one store_locate_batch RPC per node per get,
+    # not one RPC per ref)
+    refs_1k_arena = [ray_tpu.put(np.full(16_384, i, dtype=np.float64))
+                     for i in range(1000)]
+
+    def get_1k():
+        vals = ray_tpu.get(refs_1k_arena)
+        assert len(vals) == 1000
+        return 1000
+
+    record("single_client_get_1k_refs", _rate(get_1k, budget_s),
+           unit="refs/s")
+    del refs_1k_arena
 
     # -- tasks, synchronous round-trips
     @ray_tpu.remote
@@ -148,8 +195,17 @@ def main(argv=None) -> None:
     finally:
         ray_tpu.shutdown()
     if args.json:
+        # bench.py artifact record shape: one {"metric", "value", "unit",
+        # "detail"} line per benchmark (BENCH_* drivers consume these
+        # exactly like bench.py's own output)
         for r in results:
-            print(json.dumps(r))
+            print(json.dumps({
+                "metric": r["benchmark"],
+                "value": r["value"],
+                "unit": r["unit"],
+                "detail": {"suite": "core_microbenchmark",
+                           "budget_s": args.budget_s},
+            }))
     else:
         width = max(len(r["benchmark"]) for r in results)
         for r in results:
