@@ -420,14 +420,46 @@ def put(value: Any) -> ObjectRef:
 def get(
     refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float] = None
 ) -> Any:
+    if getattr(refs, "_is_compiled_dag_ref", False):
+        # compiled-graph step: resolves by reading the output channel(s)
+        # directly — no object layer, no RPCs (ray.get parity for
+        # CompiledDAGRef)
+        return refs.get(timeout=timeout)
     if _client is not None:
         return _client.get(refs, timeout=timeout)
     core = _require_core()
     single = isinstance(refs, ObjectRef)
     batch = [refs] if single else list(refs)
     for r in batch:
-        if not isinstance(r, ObjectRef):
+        if not isinstance(r, ObjectRef) and \
+                not getattr(r, "_is_compiled_dag_ref", False):
             raise TypeError(f"get() expects ObjectRef(s), got {type(r).__name__}")
+    if not single and any(
+            getattr(r, "_is_compiled_dag_ref", False) for r in batch):
+        # a list mixing compiled-graph steps with ordinary refs: batch
+        # the ObjectRefs through the object layer, read the compiled
+        # steps from their channels, preserve order. One deadline covers
+        # every resolve — not timeout-per-item
+        import time as _time
+
+        deadline = None if timeout is None \
+            else _time.monotonic() + timeout
+
+        def remaining() -> Optional[float]:
+            return None if deadline is None \
+                else max(0.001, deadline - _time.monotonic())
+
+        obj_idx = [i for i, r in enumerate(batch)
+                   if isinstance(r, ObjectRef)]
+        obj_vals = core.get([batch[i] for i in obj_idx],
+                            timeout=remaining()) if obj_idx else []
+        out: list = [None] * len(batch)
+        for i, v in zip(obj_idx, obj_vals):
+            out[i] = v
+        for i, r in enumerate(batch):
+            if not isinstance(r, ObjectRef):
+                out[i] = r.get(timeout=remaining())
+        return out
     values = core.get(batch, timeout=timeout)
     return values[0] if single else values
 

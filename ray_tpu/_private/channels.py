@@ -1,0 +1,536 @@
+"""Mutable shared-memory channels for compiled-graph execution.
+
+Analog of the reference's compiled-DAG channel layer
+(`python/ray/experimental/channel/shared_memory_channel.py`): a channel is
+ONE arena range allocated at compile time and reused for every step, so a
+steady-state pipeline hop costs a buffer write + a version bump — not a
+lease/push/put RPC round. Single writer, bounded readers, seqlock-style
+version protocol over the node arena that every local process already
+mmaps (`object_store.ArenaFile`):
+
+  header (128 B):
+    [magic u64][closed u64][version u64][length u64][n_readers u64]
+    [reader_acks u64 x 8][pad]
+  payload: up to ``buffer_bytes`` of a pack()-serialized value.
+
+Protocol (versions advance by 2 per step; step N commits version 2N):
+  * writer: wait until every reader slot acked version-2 (flow control:
+    capacity is exactly one in-flight step), set version to the odd
+    version-1 (write in progress), copy payload, set version (even,
+    committed);
+  * reader: wait until version >= target (even), hand out a READ-ONLY
+    view of payload[:length] — deserialization is zero-copy (pickle-5
+    out-of-band buffers become read-only numpy views over the reader's
+    own arena mmap; mutation raises), valid until the reader acks;
+  * ack: reader slot <- version, releasing the writer for the next step.
+
+The backing arena range is allocated once through the pin machinery
+(`NodeObjectStore.create_channel`: create + seal + pin in one store op),
+so it can never be spilled or recycled while the graph lives, and a dead
+participant's pins are reclaimed by the supervisor's existing dead-client
+paths — which also mark the channel CLOSED, raising ChannelClosedError at
+every peer instead of hanging them.
+
+Cross-node edges: the producer commits locally, then PUSHES the payload
+to a mirror channel on each remote consumer node through the supervisor's
+``channel_push`` / ``channel_write_chunk``+``channel_commit`` RPCs
+(chunked with the PR2 bounded transfer window for large payloads). The
+push carries an absolute version, so chaos-retried frames converge; the
+remote commit waits for the mirror's reader acks, carrying the writer's
+flow control across the wire.
+
+Everything here is synchronous: channels are touched from executor/user
+threads (the per-actor run loop, the driver's execute/get), never from an
+event loop — remote pushes hop onto the core worker's IO loop via
+``core._run``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import struct
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu._private import chaos, serialization
+from ray_tpu._private.exceptions import ChannelClosedError
+from ray_tpu._private.metrics import Counter
+
+logger = logging.getLogger(__name__)
+
+Address = Tuple[str, int]
+
+MAGIC = 0x5254_5055_4348_414E  # "RTPUCHAN"
+MAX_READERS = 8
+HEADER_SIZE = 128
+_OFF_MAGIC, _OFF_CLOSED, _OFF_VERSION, _OFF_LENGTH, _OFF_NREADERS = (
+    0, 8, 16, 24, 32)
+_OFF_ACKS = 40  # u64 x MAX_READERS
+_U64 = struct.Struct("<Q")
+
+# the method name the driver submits to install a per-actor run loop;
+# dispatched specially by the worker executor (never a user method)
+CHANNEL_LOOP_METHOD = "__rtpu_channel_loop__"
+
+_m_writes = Counter(
+    "ray_tpu_channel_writes_total",
+    "Compiled-graph channel commits (local writes + remote mirror pushes)")
+_m_reads = Counter(
+    "ray_tpu_channel_reads_total",
+    "Compiled-graph channel reads (zero-copy views handed to consumers)")
+_m_bytes = Counter(
+    "ray_tpu_channel_bytes_total",
+    "Compiled-graph channel payload bytes by op (write/read/push)")
+_m_steps = Counter(
+    "ray_tpu_compiled_steps_total",
+    "Compiled-graph steps launched (CompiledDAG.execute calls)")
+
+
+def total_size(buffer_bytes: int) -> int:
+    return HEADER_SIZE + int(buffer_bytes)
+
+
+def init_header(arena, offset: int, n_readers: int) -> None:
+    """Zero + stamp a fresh channel header (runs supervisor-side on the
+    store thread right after the range is allocated)."""
+    if not 0 <= int(n_readers) <= MAX_READERS:
+        # a clamped count would silently drop flow control for the extra
+        # readers (and their acks would land in the payload bytes)
+        raise ValueError(
+            f"channel needs {n_readers} reader slots; the header carries "
+            f"at most {MAX_READERS}")
+    view = arena.view(offset, HEADER_SIZE)
+    view[:] = b"\x00" * HEADER_SIZE
+    _U64.pack_into(view, _OFF_MAGIC, MAGIC)
+    _U64.pack_into(view, _OFF_NREADERS, int(n_readers))
+
+
+def mark_closed(arena, offset: int) -> None:
+    """Set the closed flag (any peer/supervisor may; one-way)."""
+    arena.view(offset, HEADER_SIZE)[_OFF_CLOSED:_OFF_CLOSED + 8] = \
+        _U64.pack(1)
+
+
+def read_header(arena, offset: int) -> Tuple[bool, int, int]:
+    """(closed, version, length) — supervisor-side peek for push/commit."""
+    view = arena.view(offset, HEADER_SIZE)
+    return (
+        _U64.unpack_from(view, _OFF_CLOSED)[0] != 0,
+        _U64.unpack_from(view, _OFF_VERSION)[0],
+        _U64.unpack_from(view, _OFF_LENGTH)[0],
+    )
+
+
+def readers_ready(arena, offset: int, version: int) -> bool:
+    """True when every reader slot acked ``version - 2`` (the writer —
+    local or a remote push landing via the supervisor — may overwrite)."""
+    view = arena.view(offset, HEADER_SIZE)
+    n = _U64.unpack_from(view, _OFF_NREADERS)[0]
+    for slot in range(n):
+        if _U64.unpack_from(view, _OFF_ACKS + 8 * slot)[0] < version - 2:
+            return False
+    return True
+
+
+def host_write_commit(arena, offset: int, payload, version: int) -> None:
+    """Supervisor-side mirror write: payload + length + commit in one shot
+    (callers already waited for reader acks; chunked pushes write payload
+    via host_write_chunk and commit via host_commit instead)."""
+    arena.write(offset + HEADER_SIZE, payload)
+    view = arena.view(offset, HEADER_SIZE)
+    _U64.pack_into(view, _OFF_LENGTH, len(payload))
+    _U64.pack_into(view, _OFF_VERSION, version)
+
+
+def host_commit(arena, offset: int, length: int, version: int) -> None:
+    view = arena.view(offset, HEADER_SIZE)
+    _U64.pack_into(view, _OFF_LENGTH, length)
+    _U64.pack_into(view, _OFF_VERSION, version)
+
+
+def host_write_chunk(arena, offset: int, chunk_offset: int, data) -> None:
+    arena.write(offset + HEADER_SIZE + chunk_offset, data)
+
+
+# --------------------------------------------------------------- descriptors
+
+
+@dataclasses.dataclass
+class ChannelSpec:
+    """Wire-shippable address of one channel: which node's arena, where in
+    it, and how many reader slots its header carries."""
+
+    channel_id: bytes  # ObjectID binary of the backing arena object
+    node_addr: Tuple[str, int]  # supervisor owning the arena range
+    offset: int
+    size: int  # total (header + payload capacity)
+    n_readers: int
+
+    def key(self) -> bytes:
+        return self.channel_id
+
+
+@dataclasses.dataclass
+class StagePlan:
+    """One actor-method invocation inside a per-actor run loop.
+
+    ``args``/``kwargs`` entries are templates:
+      ("const", value)            — baked at compile time
+      ("chan", ChannelSpec, slot) — read this step's payload (slot = this
+                                    stage's reader-ack slot in the header)
+    """
+
+    method_name: str
+    args: List[tuple]
+    kwargs: Dict[str, tuple]
+    out_channel: Optional[ChannelSpec]  # local channel on this actor's node
+    out_mirrors: List[ChannelSpec]  # remote mirrors push-committed per step
+
+
+@dataclasses.dataclass
+class ActorLoopPlan:
+    """Everything one actor needs to run its compiled-execution loop."""
+
+    node_addr: Tuple[str, int]  # the actor's node (sanity-checked on entry)
+    stages: List[StagePlan]  # topological order
+
+
+# ------------------------------------------------------------ local channels
+
+
+class LocalChannel:
+    """Reader/writer over a channel range in THIS process's arena mmap."""
+
+    def __init__(self, arena, spec: ChannelSpec):
+        self.spec = spec
+        self._view = arena.view(spec.offset, spec.size)
+        if _U64.unpack_from(self._view, _OFF_MAGIC)[0] != MAGIC:
+            raise ValueError(
+                f"not a channel at offset {spec.offset} (bad magic)")
+        self.capacity = spec.size - HEADER_SIZE
+
+    # -- header accessors
+
+    def _u64(self, off: int) -> int:
+        return _U64.unpack_from(self._view, off)[0]
+
+    def _set_u64(self, off: int, value: int) -> None:
+        _U64.pack_into(self._view, off, value)
+
+    @property
+    def closed(self) -> bool:
+        return self._u64(_OFF_CLOSED) != 0
+
+    @property
+    def version(self) -> int:
+        return self._u64(_OFF_VERSION)
+
+    def close(self) -> None:
+        self._set_u64(_OFF_CLOSED, 1)
+
+    # -- protocol
+
+    def _wait(self, cond: Callable[[], bool], timeout: Optional[float],
+              what: str) -> None:
+        """Spin-then-sleep until cond() (shm polling IS the zero-RPC
+        steady state: sub-ms for a busy pipeline, 1 ms granularity when
+        idle). Closed beats waiting; cond is checked before closed so a
+        committed final value is still delivered after a close."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        spins = 0
+        delay = 5e-5
+        while True:
+            if cond():
+                return
+            if self.closed:
+                raise ChannelClosedError(
+                    f"channel {self.spec.channel_id.hex()[:12]} closed "
+                    f"while waiting to {what}")
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"channel {self.spec.channel_id.hex()[:12]}: {what} "
+                    f"timed out after {timeout}s")
+            spins += 1
+            if spins < 500:
+                time.sleep(0)  # yield the GIL; catches a busy pipeline
+            else:
+                # escalate 50us -> 2ms: a hot pipeline wakes within one
+                # short tick; an idle loop settles at 2ms polls (the
+                # CPU-burn/latency tradeoff of a no-RPC wait)
+                time.sleep(delay)
+                delay = min(delay * 1.5, 0.002)
+
+    def write(self, payload, version: int,
+              timeout: Optional[float] = None) -> None:
+        """Commit ``payload`` as ``version`` (even). Blocks until every
+        reader acked the previous step — channel capacity is exactly one
+        in-flight step, which is the compiled-DAG backpressure."""
+        n = len(payload)
+        if n > self.capacity:
+            raise ValueError(
+                f"channel payload of {n} bytes exceeds the channel buffer "
+                f"({self.capacity}); recompile with "
+                f"experimental_compile(buffer_size_bytes=...)")
+        chaos.maybe_delay("channel.write")
+        self._wait(lambda: readers_ready_view(self._view, version),
+                   timeout, f"write v{version}")
+        self._set_u64(_OFF_VERSION, version - 1)  # odd: write in progress
+        self._view[HEADER_SIZE:HEADER_SIZE + n] = payload
+        self._set_u64(_OFF_LENGTH, n)
+        self._set_u64(_OFF_VERSION, version)
+        _m_writes.inc()
+        _m_bytes.inc(n, labels={"op": "write"})
+
+    def read(self, version: int,
+             timeout: Optional[float] = None) -> memoryview:
+        """Read-only view of the payload once ``version`` is committed.
+        The view aliases the shared arena: it is valid until this reader
+        acks, after which the writer may overwrite it."""
+        chaos.maybe_delay("channel.read")
+        self._wait(
+            lambda: self.version >= version and self.version % 2 == 0,
+            timeout, f"read v{version}")
+        length = self._u64(_OFF_LENGTH)
+        _m_reads.inc()
+        _m_bytes.inc(length, labels={"op": "read"})
+        return self._view[HEADER_SIZE:HEADER_SIZE + length].toreadonly()
+
+    def ack(self, slot: int, version: int) -> None:
+        """Release the writer: this reader is done with ``version``."""
+        if not 0 <= slot < MAX_READERS:
+            # slot MAX_READERS would stamp the ack into payload byte 0
+            raise ValueError(f"reader slot {slot} out of range")
+        chaos.maybe_delay("channel.ack")
+        self._set_u64(_OFF_ACKS + 8 * slot, version)
+
+
+def readers_ready_view(view: memoryview, version: int) -> bool:
+    n = _U64.unpack_from(view, _OFF_NREADERS)[0]
+    for slot in range(n):
+        if _U64.unpack_from(view, _OFF_ACKS + 8 * slot)[0] < version - 2:
+            return False
+    return True
+
+
+# ----------------------------------------------------------- remote mirrors
+
+
+class MirrorWriter:
+    """Per-step push of a committed payload to one remote mirror channel.
+
+    The transport rides the established supervisor RPC clients (data
+    plane, pre-connected at compile time): one ``channel_push`` frame for
+    small payloads, a bounded window of ``channel_write_chunk`` frames +
+    one ``channel_commit`` for large ones (the PR2 transfer-window shape).
+    Versions are absolute, so chaos-retried frames converge; any delivery
+    failure means the remote peer is unreachable and surfaces as
+    ChannelClosedError so the whole graph unwinds."""
+
+    def __init__(self, core, spec: ChannelSpec):
+        self._core = core
+        self.spec = spec
+        self._chunk = core.config.object_transfer_chunk_bytes
+        self._window = max(1, core.config.object_transfer_window)
+        self._timeout = core.config.channel_remote_timeout_s
+
+    def push(self, payload, version: int) -> None:
+        try:
+            self._core._run(self._push_async(payload, version),
+                            timeout=self._timeout + 10)
+        except ChannelClosedError:
+            raise
+        except Exception as e:  # noqa: BLE001 — any transport/remote failure
+            cause = getattr(e, "cause", None)
+            if isinstance(cause, ChannelClosedError):
+                raise ChannelClosedError(str(cause)) from e
+            raise ChannelClosedError(
+                f"push to mirror on {self.spec.node_addr} failed: {e!r}"
+            ) from e
+        _m_writes.inc()
+        _m_bytes.inc(len(payload), labels={"op": "push"})
+
+    async def _push_async(self, payload, version: int) -> None:
+        import asyncio
+
+        client = self._core.clients.get(tuple(self.spec.node_addr))
+        cid = self.spec.channel_id
+        if len(payload) <= self._chunk:
+            await client.call(
+                "channel_push",
+                {"channel_id": cid, "version": version,
+                 "payload": bytes(payload)},
+                timeout=self._timeout)
+            return
+        sem = asyncio.Semaphore(self._window)
+        view = memoryview(payload)
+
+        async def send(pos: int) -> None:
+            async with sem:
+                await client.call(
+                    "channel_write_chunk",
+                    {"channel_id": cid, "version": version, "offset": pos,
+                     "data": bytes(view[pos:pos + self._chunk])},
+                    timeout=self._timeout)
+
+        tasks = [asyncio.ensure_future(send(pos))
+                 for pos in range(0, len(payload), self._chunk)]
+        try:
+            await asyncio.gather(*tasks)
+        except Exception:
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            raise
+        await client.call(
+            "channel_commit",
+            {"channel_id": cid, "version": version,
+             "length": len(payload)},
+            timeout=self._timeout)
+
+
+# ----------------------------------------------------- worker-side run loop
+
+
+def _pin_local_channel(core, spec: ChannelSpec) -> None:
+    """Take this process's own pin on a channel range (released through
+    the standard unpin batcher on loop exit; reclaimed by the supervisor
+    if this worker dies). Pinning also verifies the offset is still the
+    one the driver allocated — it must be, since the creation pin blocks
+    spill, so a mismatch is a protocol bug worth failing loudly on."""
+    from ray_tpu._private.core_worker import _m_pins
+
+    loc = core._run(core.clients.get(core.supervisor_addr).call(
+        "store_locate",
+        {"object_id": spec.channel_id, "pin": True,
+         "client": core._store_client_id, "client_addr": core.address},
+        timeout=60))
+    if loc is None:
+        raise ChannelClosedError(
+            f"channel {spec.channel_id.hex()[:12]} no longer in the local "
+            f"store (graph torn down before the loop started)")
+    _m_pins.inc()
+    if loc["offset"] != spec.offset:
+        raise RuntimeError(
+            f"channel {spec.channel_id.hex()[:12]} moved "
+            f"({loc['offset']} != {spec.offset}) despite the creation pin")
+
+
+def run_actor_loop(core, instance, plan: ActorLoopPlan) -> dict:
+    """The per-actor compiled-execution loop (installed as a long-running
+    actor task): read input channels -> run methods in topo order ->
+    write/push output channels -> ack inputs. Exits when the channels
+    close (teardown or participant death); any user-method exception
+    closes the graph and surfaces through this task's error report."""
+    from ray_tpu._private.ids import ObjectID
+
+    if tuple(plan.node_addr) != tuple(core.supervisor_addr):
+        raise RuntimeError(
+            f"channel loop planned for node {plan.node_addr} but this "
+            f"worker sits on {core.supervisor_addr}")
+
+    # open + pin every local channel this loop touches (one setup pass of
+    # control RPCs; the steady-state loop below does none)
+    local: Dict[bytes, LocalChannel] = {}
+
+    def open_local(spec: ChannelSpec) -> LocalChannel:
+        ch = local.get(spec.key())
+        if ch is None:
+            _pin_local_channel(core, spec)
+            ch = LocalChannel(core.arena, spec)
+            local[spec.key()] = ch
+        return ch
+
+    def release_pins() -> None:
+        for key in local:
+            core._schedule_unpin(ObjectID(key))
+
+    bound: List[tuple] = []  # (method, arg templates, out ch, mirrors)
+    try:
+        for stage in plan.stages:
+            method = getattr(instance, stage.method_name)
+            for entry in list(stage.args) + list(stage.kwargs.values()):
+                if entry[0] == "chan":
+                    open_local(entry[1])
+            out = (open_local(stage.out_channel)
+                   if stage.out_channel else None)
+            mirrors = [MirrorWriter(core, m) for m in stage.out_mirrors]
+            bound.append((method, stage, out, mirrors))
+    except BaseException:
+        # partial setup (e.g. the graph torn down mid-install): hand back
+        # the pins already taken instead of stranding them until this
+        # worker dies
+        release_pins()
+        raise
+
+    def close_everything() -> None:
+        for ch in local.values():
+            ch.close()
+        for _, stage, _, _ in bound:
+            for m in stage.out_mirrors:
+                core._run_nowait(core.clients.get(tuple(m.node_addr)).call(
+                    "channel_close", {"channel_id": m.channel_id},
+                    timeout=10))
+
+    steps = 0
+    async_loop = None  # created once, on the first async method
+    try:
+        while True:
+            version = 2 * (steps + 1)
+            chaos.maybe_crash("worker.channel_step")
+            for method, stage, out, mirrors in bound:
+                views: List[Tuple[LocalChannel, int]] = []
+
+                def resolve(entry):
+                    if entry[0] == "const":
+                        return entry[1]
+                    _, spec, slot = entry
+                    ch = local[spec.key()]
+                    view = ch.read(version)
+                    views.append((ch, slot))
+                    # zero-copy deserialization: out-of-band buffers
+                    # become read-only numpy views over the arena range,
+                    # valid until the ack below
+                    return serialization.unpack(view)
+
+                args = [resolve(a) for a in stage.args]
+                kwargs = {k: resolve(v) for k, v in stage.kwargs.items()}
+                result = method(*args, **kwargs)
+                if hasattr(result, "__await__"):
+                    # async-actor method from the sync loop: drive it
+                    # here, on an event loop kept for the run's lifetime
+                    # (per-step create/close is syscall churn on the
+                    # hot path this subsystem exists to strip bare)
+                    if async_loop is None:
+                        import asyncio
+
+                        async_loop = asyncio.new_event_loop()
+                    result = async_loop.run_until_complete(result)
+                payload = serialization.pack(result)
+                del result
+                if out is not None:
+                    out.write(payload, version)
+                for mirror in mirrors:
+                    mirror.push(payload, version)
+                del payload, args, kwargs
+                # inputs consumed (the output no longer references them):
+                # release the upstream writers
+                for ch, slot in views:
+                    ch.ack(slot, version)
+            steps += 1
+    except ChannelClosedError:
+        # normal exit: teardown (or a peer's death) closed the channels
+        return {"steps": steps}
+    except BaseException:
+        # user method raised (or this worker is wedged): poison the graph
+        # so every peer unwinds instead of hanging, then surface the real
+        # error through this loop task's report
+        try:
+            close_everything()
+        except Exception:
+            logger.exception("channel close-on-error failed")
+        raise
+    finally:
+        if async_loop is not None:
+            async_loop.close()
+        release_pins()

@@ -147,6 +147,26 @@ class FaultController:
             return NO_FAULT
         return decision
 
+    def local_delay(self, point: str) -> float:
+        """Deterministic delay (seconds, possibly 0) for a non-RPC point —
+        shared-memory protocols (compiled-graph channels) have no frame to
+        drop or duplicate, but their seqlock timing can be perturbed: a
+        delay between "reader observed version" and "reader acked" is
+        exactly the interleaving a torn protocol would lose data under.
+        Drawn from the same (seed, point, n) stream as rpc decisions, so a
+        failing seed replays byte-identically."""
+        if self._methods and point not in self._methods:
+            return 0.0
+        key = f"local:{point}"
+        n = self._counts.get(key, 0)
+        self._counts[key] = n + 1
+        _, _, u_delay, u_amount = self._uniforms(key, n)
+        delay = (u_amount * self.delay_max_ms / 1000.0
+                 if u_delay < self.delay_prob else 0.0)
+        if self.trace is not None:
+            self.trace.append((key, n, FaultDecision(delay_s=delay)))
+        return delay
+
     def schedule_bytes(self) -> bytes:
         """Canonical encoding of every decision drawn so far (record=True
         only) — the byte-identical replay artifact the determinism test
@@ -214,3 +234,17 @@ def maybe_crash(point: str) -> None:
     fc = fault_controller()
     if fc is not None:
         fc.maybe_crash(point)
+
+
+def maybe_delay(point: str) -> None:
+    """Synchronous deterministic delay at a named local point (channel
+    read/write/ack interleaving; no-op when chaos is off). Sync because
+    the channel protocol runs on executor/user threads, never on an
+    event loop."""
+    fc = fault_controller()
+    if fc is not None:
+        delay = fc.local_delay(point)
+        if delay > 0.0:
+            import time
+
+            time.sleep(delay)

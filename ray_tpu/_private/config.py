@@ -59,6 +59,14 @@ class Config:
     # external_storage.py:496): "" = local dir above; file:///path,
     # mock://dir (fake remote, tests), s3://bucket/prefix
     object_spilling_uri: str = ""
+    # ---- compiled-graph channels (dag.experimental_compile) ----
+    # payload capacity of each mutable channel; a compiled step whose
+    # packed value exceeds it raises (override per-graph via
+    # experimental_compile(buffer_size_bytes=...))
+    channel_buffer_bytes: int = 4 * 1024**2
+    # total budget for one cross-node per-step push (chunk window +
+    # commit); the commit side also waits for remote reader acks under it
+    channel_remote_timeout_s: float = 120.0
     # ---- OOM defense (≈ memory_monitor.h:52) ----
     # kill the newest leased worker when host memory use crosses this
     # fraction; <= 0 disables the monitor

@@ -1319,10 +1319,21 @@ class CoreWorker:
             return {"status": status, "size": entry.size,
                     "node_addr": entry.location}
         if status == "device":
-            # holder None -> the data is in THIS process's registry
+            meta_blob = entry.device_meta
+            if meta_blob is None:
+                # holder None -> the data is in THIS process's registry
+                meta = self.device_objects.meta(oid)
+                if meta is None:
+                    # registry entry is gone (freed or racing a drop):
+                    # report it as a lost device — distinct from
+                    # "unknown" (never owned, terminal) — so the
+                    # caller's object_lost/reconstruction loop engages;
+                    # the old dumps(None) reply crashed readers on
+                    # meta.shards instead
+                    return {"status": "device_lost"}
+                meta_blob = serialization.dumps(meta)
             return {"status": status,
-                    "meta": entry.device_meta or serialization.dumps(
-                        self.device_objects.meta(oid)),
+                    "meta": meta_blob,
                     "holder": entry.location}
         return {"status": status}
 
@@ -1372,7 +1383,10 @@ class CoreWorker:
         elif channel == "nodes" and isinstance(message, dict) \
                 and message.get("event") == "DEAD" and message.get("address"):
             await self._on_node_dead(tuple(message["address"]))
-        for handler in self._pub_handlers.get(channel, []):
+        # snapshot: unsubscribe() (e.g. a compiled-graph teardown on a
+        # user thread) may mutate the list mid-delivery; list.remove
+        # during iteration would silently skip another handler
+        for handler in list(self._pub_handlers.get(channel, [])):
             try:
                 handler(message)
             except Exception:
@@ -1389,6 +1403,17 @@ class CoreWorker:
                 "subscribe", {"channel": channel, "address": self.address}
             )
         )
+
+    def unsubscribe(self, channel: str, handler: Callable) -> None:
+        """Drop a handler registered via subscribe(). Local-only: the
+        controller-side subscription stays (it is one set entry shared
+        with this worker's own actor/node tracking, which must keep
+        receiving the channel's publishes)."""
+        handlers = self._pub_handlers.get(channel, [])
+        if handler in handlers:
+            handlers.remove(handler)
+        if not handlers:
+            self._pub_handlers.pop(channel, None)
 
     # ------------------------------------------------------------- objects
 
@@ -1662,6 +1687,27 @@ class CoreWorker:
                     continue
             if status == "error":
                 raise serialization.loads(r["error"])
+            if status == "device_lost":
+                # the owner's device registry entry vanished (freed or
+                # racing a drop): same stance as a dead holder — ask the
+                # owner to reconstruct from lineage, then keep polling
+                lost_attempts += 1
+                if lost_attempts > 3:
+                    raise ObjectLostError(
+                        oid.hex(), "device object registry entry lost; "
+                        "reconstruction failed")
+                try:
+                    recoverable = await self.clients.get(owner).call(
+                        "object_lost", {"object_id": oid.binary()})
+                except Exception:
+                    await asyncio.sleep(0.1)
+                    continue
+                if not recoverable:
+                    raise ObjectLostError(
+                        oid.hex(),
+                        "device object lost and not reconstructable")
+                await asyncio.sleep(0.05)
+                continue
             if status == "unknown":
                 raise ObjectLostError(oid.hex(), "owner does not know this object")
             if deadline is not None and time.monotonic() > deadline:

@@ -87,6 +87,14 @@ class ObjectLostError(RayTpuError):
         return (ObjectLostError, (self.object_id_hex, self.reason))
 
 
+class ChannelClosedError(RayTpuError):
+    """A compiled-graph channel was closed.
+
+    Raised at every peer blocked on (or about to touch) the channel when
+    the owning CompiledDAG is torn down or a participant actor/node dies.
+    """
+
+
 class GetTimeoutError(RayTpuError, TimeoutError):
     pass
 

@@ -51,6 +51,15 @@ class Metric:
         with self._lock:
             return getattr(self, "_values", {}).get(_label_key(labels), 0.0)
 
+    def total(self) -> float:
+        """Sum over every label set (Counter/Gauge): the
+        "did ANY series move" form counter-based tests need — e.g. the
+        compiled-graph suite proves a steady-state step issues zero
+        control RPCs by snapshotting the rpc client-call counter's total
+        across all method labels."""
+        with self._lock:
+            return float(sum(getattr(self, "_values", {}).values()))
+
 
 class Counter(Metric):
     TYPE = "counter"

@@ -253,6 +253,25 @@ class NodeObjectStore:
         )
         return offset
 
+    def create_channel(self, object_id: ObjectID, size: int,
+                       client: str) -> int:
+        """Allocate a compiled-graph channel range: create + seal + pin in
+        ONE store op, so there is no window in which the freshly sealed
+        range could be spilled (which would move its offset) before the
+        pin lands. The pin is attributed to ``client`` (the compiling
+        driver) exactly like a zero-copy read pin — release_client_pins
+        reclaims it if the driver dies, and the channel object itself is
+        freed through the normal deferred-free path once every
+        participant's pin is gone."""
+        offset = self.create(object_id, size)
+        meta = self._objects[object_id]
+        meta.state = IN_MEMORY
+        meta.pins += 1
+        meta.pin_clients[client] = meta.pin_clients.get(client, 0) + 1
+        self._client_pins.setdefault(client, set()).add(object_id)
+        meta.last_access = time.monotonic()
+        return offset
+
     def seal(self, object_id: ObjectID) -> None:
         meta = self._objects.get(object_id)
         if meta is None:

@@ -52,8 +52,17 @@ from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
 from ray_tpu._private import serialization
 from ray_tpu._private.chaos import fault_controller
+from ray_tpu._private.metrics import Counter
 
 logger = logging.getLogger(__name__)
+
+# every outbound RPC this process issues, by method. The compiled-graph
+# suite snapshots total() around a steady-state step window to PROVE the
+# channel path does zero control-plane RPCs (transparent retries of one
+# logical call count once — a retry is not a new control decision).
+_m_client_calls = Counter(
+    "ray_tpu_rpc_client_calls_total",
+    "Outbound RPC calls issued by this process (call + notify), by method")
 
 _LEN = struct.Struct("<I")
 REQUEST, REPLY, ERROR, ONEWAY = 0, 1, 2, 3
@@ -427,8 +436,12 @@ class RpcClient:
         budget = timeout if timeout is not None else self._request_timeout
         deadline = time.monotonic() + budget
         if _reuse_msg_id is not None:
+            # a retry_call attempt riding a shared replay-cache key: the
+            # logical call was already counted (by retry_call), and a
+            # redelivery is not a new control decision
             msg_id = _reuse_msg_id
         else:
+            _m_client_calls.inc(labels={"method": method})
             msg_id = self.reserve_msg_id()
         # the payload (same msg_id) is reused verbatim across retries so the
         # server-side replay cache can recognize the redelivery
@@ -517,6 +530,7 @@ class RpcClient:
 
     async def notify(self, method: str, body: Any = None) -> None:
         """Fire-and-forget (at-most-once; never retried)."""
+        _m_client_calls.inc(labels={"method": method})
         await self._ensure_connected()
         writer = self._writer  # see _attempt: never deref after an await
         if writer is None:
@@ -582,6 +596,8 @@ async def retry_call(
     budget = timeout if timeout is not None else client._request_timeout
     deadline = time.monotonic() + budget
     msg_id = client.reserve_msg_id()
+    # one logical call regardless of how many attempts share the msg_id
+    _m_client_calls.inc(labels={"method": method})
     attempt = 0
     while True:
         remaining = deadline - time.monotonic()

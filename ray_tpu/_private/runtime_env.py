@@ -122,14 +122,23 @@ class WorkerEnvSpec:
         self.env_vars = env_vars or {}
         # set for container runtime envs: {"engine","image","run_options"}
         self.container: Optional[Dict[str, Any]] = None
+        # env-files minted by wrap_command, pending deletion by the
+        # spawner once the engine has consumed them (they hold secrets)
+        self.env_files: List[str] = []
 
     def wrap_command(self, cmd: List[str], env: Dict[str, str],
-                     mounts: List[str]) -> List[str]:
+                     mounts: List[str],
+                     env_file_dir: Optional[str] = None) -> List[str]:
         """Wrap the worker argv in an engine invocation (ref
         `python/ray/_private/runtime_env/container.py` worker-command
         injection). Host networking + IPC so the worker reaches the
-        supervisor/controller sockets and maps the /dev/shm arena; env
-        is forwarded explicitly (containers do not inherit)."""
+        supervisor/controller sockets and maps the /dev/shm arena.
+
+        Env is forwarded through a 0600 ``--env-file``, NOT ``--env k=v``
+        argv: the worker env carries secrets (user env_vars, cloud
+        credentials inherited from the driver), and argv is world-readable
+        through ``ps``/``/proc/<pid>/cmdline`` for the lifetime of the
+        engine client process."""
         if not self.container:
             return cmd
         argv = [self.container["engine"], "run", "--rm",
@@ -138,8 +147,23 @@ class WorkerEnvSpec:
             argv += ["-v", f"{m}:{m}"]
         if self.cwd:
             argv += ["--workdir", self.cwd]
-        for k, v in env.items():
-            argv += ["--env", f"{k}={v}"]
+        import tempfile
+
+        fd, env_path = tempfile.mkstemp(  # mkstemp => mode 0600
+            prefix="rtpu_env_", suffix=".env", dir=env_file_dir)
+        with os.fdopen(fd, "w") as f:
+            for k, v in env.items():
+                if "\n" in k or "\n" in str(v):
+                    # the env-file format is line-based; a newline value
+                    # cannot be represented — drop it rather than corrupt
+                    # the vars after it
+                    logger.warning(
+                        "container env var %s dropped (embedded newline)",
+                        k)
+                    continue
+                f.write(f"{k}={v}\n")
+        self.env_files.append(env_path)
+        argv += ["--env-file", env_path]
         argv += list(self.container.get("run_options") or [])
         argv.append(self.container["image"])
         return argv + cmd

@@ -31,7 +31,7 @@ import time
 from collections import deque
 from typing import Any, Deque, Dict, List, Optional, Set, Tuple
 
-from ray_tpu._private import chaos, serialization
+from ray_tpu._private import channels, chaos, serialization
 from ray_tpu._private.config import Config
 from ray_tpu._private.http_util import MetricsHttpServer
 from ray_tpu._private.ids import NodeID, ObjectID, WorkerID
@@ -179,6 +179,13 @@ class Supervisor:
         # cluster view cache (synced from controller)
         self.cluster_view: List[NodeView] = []
         self._pulls_in_flight: Dict[ObjectID, asyncio.Future] = {}
+        # compiled-graph channels hosted in this node's arena:
+        # channel_id bytes -> {"oid", "offset", "size", "participants",
+        # "staging"} (see rpc_channel_create). A participant's death —
+        # worker exit, driver sweep, node-death view sync — closes every
+        # channel it took part in, so its peers raise ChannelClosedError
+        # instead of hanging on a version bump that will never come.
+        self._channels: Dict[bytes, dict] = {}
         self._sync_task: Optional[asyncio.Task] = None
         self._reap_task: Optional[asyncio.Task] = None
         self._monitor_task: Optional[asyncio.Task] = None
@@ -223,6 +230,12 @@ class Supervisor:
         self._m_pins_released = Counter(
             "ray_tpu_store_pins_released_total",
             "Pins force-released on behalf of dead clients")
+        self._m_channels_open = Gauge(
+            "ray_tpu_channels_open",
+            "Compiled-graph channels currently hosted in this node's arena")
+        self._m_channels_closed = Counter(
+            "ray_tpu_channels_closed_total",
+            "Channels closed, by cause (teardown/participant_death)")
         # node ids seen alive in the synced view; a node leaving this set
         # has its cross-node pull pins force-released (its pulls died
         # with it)
@@ -750,14 +763,33 @@ class Supervisor:
             env["PYTHONPATH"] = (
                 env["PYTHONPATH"] + os.pathsep + pkg_root
                 if env.get("PYTHONPATH") else pkg_root)
+        env_file = None
         if env_spec.container:
             # wrap in an engine run: host net/IPC, session dir + package
             # root + /dev/shm mounted, env forwarded explicitly
             cmd = env_spec.wrap_command(
                 cmd, env, mounts=[self.session_dir, pkg_root, "/dev/shm",
-                                  tempfile.gettempdir()])
-        proc = subprocess.Popen(cmd, env=env, stdout=out, stderr=err,
-                                cwd=env_spec.cwd)
+                                  tempfile.gettempdir()],
+                # env-file lives in the session dir: 0600, never visible
+                # in ps/argv, deleted below once the engine consumed it
+                env_file_dir=self.session_dir)
+            env_file = env_spec.env_files.pop() if env_spec.env_files \
+                else None
+        try:
+            proc = subprocess.Popen(cmd, env=env, stdout=out, stderr=err,
+                                    cwd=env_spec.cwd)
+        except Exception:
+            # engine/interpreter missing: the secrets env-file must not
+            # outlive the failed spawn (the registration-wait cleanup
+            # below is never reached)
+            if env_file is not None:
+                try:
+                    os.unlink(env_file)
+                except OSError:
+                    pass
+            out.close()
+            err.close()
+            raise
         out.close()  # child holds its own duplicates; keeping ours leaks fds
         err.close()
         self._spawned_log_paths[proc.pid] = (out.name, err.name)
@@ -786,6 +818,15 @@ class Supervisor:
                 f"worker failed to register within "
                 f"{self.config.worker_register_timeout_s}s (see {log_dir}/{wtag}.err)"
             )
+        finally:
+            # the engine parsed --env-file at launch; registration (or
+            # the kill above) means it is consumed — don't leave secrets
+            # on disk for the session's lifetime
+            if env_file is not None:
+                try:
+                    os.unlink(env_file)
+                except OSError:
+                    pass
         _trace(f"spawned {handle.worker_id_hex[:8]} pid={handle.pid}")
         return handle
 
@@ -869,6 +910,7 @@ class Supervisor:
     async def _release_dead_client_pins(self, client: str, what: str) -> None:
         """A pinning client died: reclaim its pins so spill/free unblock
         (a leaked pin would otherwise block spilling that object forever)."""
+        self._close_client_channels(client, cause="participant_death")
         self._mark_client_released(client)
         try:
             released = await self._store_op(
@@ -1302,6 +1344,10 @@ class Supervisor:
         """A departing client (driver/worker leaving the cluster
         gracefully) hands back every pin it still holds — its zero-copy
         views die with it, so the pins must not outlive it."""
+        # a departing driver's compiled graphs die with it: close its
+        # channels so participant loops exit instead of hanging
+        self._close_client_channels(body.get("client", ""),
+                                    cause="participant_death")
         self._mark_client_released(body.get("client", ""))
         released = await self._store_op(
             self.store.release_client_pins, body.get("client", ""))
@@ -1353,6 +1399,143 @@ class Supervisor:
     @idempotent
     async def rpc_store_stats(self, body=None) -> dict:
         return await self._store_op(self.store.stats)
+
+    # ------------------------------------------------- compiled-graph channels
+
+    @replay_cached  # allocates an arena range + a pin: must mint once
+    async def rpc_channel_create(self, body) -> dict:
+        """Allocate one mutable channel in this node's arena (compile
+        time): create + seal + pin in one store op, zero + stamp the
+        header, and register the participant set for death-driven close.
+        The pin belongs to ``client`` (the compiling driver)."""
+        chaos.maybe_crash("sup.channel_create")
+        client = body.get("client", "")
+        if client in self._released_clients:
+            raise ValueError(
+                f"channel_create from released client {client[:16]}")
+        if body.get("client_addr"):
+            self._pin_client_addrs[client] = tuple(body["client_addr"])
+        oid = ObjectID(body["channel_id"])
+        offset = await self._store_op(
+            self.store.create_channel, oid, body["size"], client)
+        await self._store_op(
+            channels.init_header, self.store.arena, offset,
+            body["n_readers"])
+        self._channels[oid.binary()] = {
+            "oid": oid,
+            "offset": offset,
+            "size": body["size"],
+            "participants": set(body.get("participants") or ()),
+            "staging": 0,
+        }
+        self._m_channels_open.set(len(self._channels))
+        return {"offset": offset}
+
+    def _close_channel_entry(self, key: bytes, cause: str) -> None:
+        ent = self._channels.pop(key, None)
+        if ent is None:
+            return
+        channels.mark_closed(self.store.arena, ent["offset"])
+        self._m_channels_open.set(len(self._channels))
+        self._m_channels_closed.inc(labels={"cause": cause})
+
+    def _close_client_channels(self, client: str, cause: str) -> None:
+        """Close every channel ``client`` participated in (it died or
+        departed): blocked peers observe the flag on their next poll tick
+        and raise ChannelClosedError instead of waiting forever."""
+        if not client:
+            return
+        for key in [k for k, ent in self._channels.items()
+                    if client in ent["participants"]]:
+            logger.warning(
+                "closing channel %s: participant %s is gone",
+                key.hex()[:12], client[:16])
+            self._close_channel_entry(key, cause)
+
+    @idempotent  # closing a closed/unknown channel is a no-op
+    async def rpc_channel_close(self, body) -> None:
+        self._close_channel_entry(body["channel_id"], cause="teardown")
+
+    async def _channel_wait_writable(self, ent: dict, version: int) -> bool:
+        """Park a remote push until the mirror's local readers acked the
+        previous step (the writer's flow control, carried across the
+        wire). Returns False when ``version`` is already committed — a
+        chaos-duplicated/retried frame that must be a no-op."""
+        from ray_tpu._private.exceptions import ChannelClosedError
+
+        deadline = time.monotonic() + self.config.channel_remote_timeout_s
+        while True:
+            closed, committed, _ = channels.read_header(
+                self.store.arena, ent["offset"])
+            if committed >= version:
+                return False
+            if closed or ent["oid"].binary() not in self._channels:
+                raise ChannelClosedError(
+                    f"channel {ent['oid'].hex()[:12]} closed")
+            if channels.readers_ready(self.store.arena, ent["offset"],
+                                      version):
+                return True
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"channel {ent['oid'].hex()[:12]}: readers did not "
+                    f"ack within {self.config.channel_remote_timeout_s}s")
+            await asyncio.sleep(0.001)
+
+    def _channel_entry(self, body) -> dict:
+        from ray_tpu._private.exceptions import ChannelClosedError
+
+        ent = self._channels.get(body["channel_id"])
+        if ent is None:
+            raise ChannelClosedError(
+                f"channel {body['channel_id'].hex()[:12]} closed or "
+                f"unknown on this node")
+        return ent
+
+    @idempotent  # absolute version: duplicated/retried pushes converge
+    async def rpc_channel_push(self, body) -> None:
+        """One-frame per-step push into a mirror channel (payload fits a
+        single chunk): wait for reader acks, write payload, commit."""
+        ent = self._channel_entry(body)
+        if not await self._channel_wait_writable(ent, body["version"]):
+            return  # duplicate delivery of an already-committed version
+        await self._store_op(
+            channels.host_write_commit, self.store.arena, ent["offset"],
+            body["payload"], body["version"])
+        self._m_transfer_bytes.inc(len(body["payload"]))
+
+    @idempotent  # same-offset same-version rewrites converge
+    async def rpc_channel_write_chunk(self, body) -> None:
+        """One chunk of a windowed large-payload push. The first chunk of
+        a new version waits for reader acks (after that the payload area
+        is the writer's until commit); chunks of an already-committed
+        version are duplicate deliveries and are dropped."""
+        ent = self._channel_entry(body)
+        version = body["version"]
+        _, committed, _ = channels.read_header(self.store.arena,
+                                               ent["offset"])
+        if committed >= version:
+            return
+        if ent["staging"] != version:
+            if not await self._channel_wait_writable(ent, version):
+                return
+            ent["staging"] = version
+        await self._store_op(
+            channels.host_write_chunk, self.store.arena, ent["offset"],
+            body["offset"], body["data"])
+        self._m_transfer_chunks.inc()
+        self._m_transfer_bytes.inc(len(body["data"]))
+
+    @idempotent  # version-guarded
+    async def rpc_channel_commit(self, body) -> None:
+        """Seal a chunked push: stamp length + version (readers wake)."""
+        ent = self._channel_entry(body)
+        _, committed, _ = channels.read_header(self.store.arena,
+                                               ent["offset"])
+        if committed >= body["version"]:
+            return
+        await self._store_op(
+            channels.host_commit, self.store.arena, ent["offset"],
+            body["length"], body["version"])
 
     @idempotent  # contains-check + in-flight dedupe make re-pulls converge
     async def rpc_pull_object(self, body) -> dict:

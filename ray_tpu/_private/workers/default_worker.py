@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import inspect
 import logging
 import os
 import threading
@@ -93,6 +94,15 @@ class Executor:
             and self.actor_spec is not None
             and self.actor_spec.is_async_actor
         ):
+            from ray_tpu._private.channels import CHANNEL_LOOP_METHOD
+
+            if spec.method_name == CHANNEL_LOOP_METHOD:
+                # the compiled-graph run loop is synchronous and
+                # long-lived: parking it on the async actor's event loop
+                # would starve every concurrent method and health ping —
+                # run it on the thread pool like a sync task
+                self._pool.submit(self._execute_guarded, spec)
+                return "ok"
             self._submit_async(spec)
             return "ok"
         self._pool.submit(self._execute_guarded, spec)
@@ -184,6 +194,18 @@ class Executor:
         if spec.kind == TaskKind.ACTOR_TASK:
             if self.actor_instance is None:
                 raise RuntimeError("actor task before actor creation")
+            from ray_tpu._private import channels
+
+            if spec.method_name == channels.CHANNEL_LOOP_METHOD:
+                # compiled-graph execution: the "method" IS the per-actor
+                # run loop (read input channels -> run stage methods ->
+                # write output channels); it occupies this slot until the
+                # graph is torn down or a participant dies
+                import functools
+
+                return functools.partial(
+                    channels.run_actor_loop, self.core,
+                    self.actor_instance)
             return getattr(self.actor_instance, spec.method_name)
         return self.core.get_function(spec.function_key)
 
@@ -210,7 +232,12 @@ class Executor:
                 return
             with self._task_span(spec):
                 result = fn(*args, **kwargs)
-                if asyncio.iscoroutine(result):
+                # inspect.iscoroutine, NOT asyncio.iscoroutine: on 3.10
+                # the latter also matches plain generators (legacy
+                # @asyncio.coroutine support), sending every sync
+                # streaming task into run_until_complete -> "Task got
+                # bad yield"
+                if inspect.iscoroutine(result):
                     # sync path hit an async def: run it to completion here
                     # (loop closed afterwards — each leaks an epoll fd +
                     # self-pipe otherwise, EMFILE on long-lived workers)
@@ -240,7 +267,9 @@ class Executor:
             fn = self._get_callable(spec)
             with self._task_span(spec):
                 result = fn(*args, **kwargs)
-                if asyncio.iscoroutine(result):
+                # inspect (strict), not asyncio: see _execute — a plain
+                # generator must reach the streaming path, not `await`
+                if inspect.iscoroutine(result):
                     result = await result
                 if spec.is_streaming:
                     await self._run_async_generator(spec, result)

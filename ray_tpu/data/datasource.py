@@ -545,13 +545,53 @@ def mongo_tasks(uri: str, database: str, collection: str,
     n = client_factory()[database][collection].estimated_document_count()
     per = max(1, -(-n // parallelism))  # ceil
 
+    # skip/limit paging is only deterministic over a total order. Sorting
+    # the collection scan on `_id` BEFORE the user pipeline gives every
+    # task the same order through order-preserving stages ($match,
+    # $project, $unwind, ...). Stages below DESTROY that order ($group
+    # emits groups in unspecified per-run order; a user $sort rarely
+    # totals), so the page grid must be re-sorted AFTER the pipeline —
+    # which needs `_id` in the output ($group always emits one; raise if
+    # a later stage provably drops it rather than silently drop/duplicate
+    # the rows between adjacent partitions' pages).
+    _ORDER_DESTROYING = {"$group", "$sort", "$sample", "$bucket",
+                         "$bucketAuto", "$sortByCount", "$facet",
+                         "$unionWith"}
+
+    def _stage_name(stage) -> str:
+        return next(iter(stage)) if isinstance(stage, dict) and stage else ""
+
+    def _drops_id(stage) -> bool:
+        name = _stage_name(stage)
+        if not name:
+            return False
+        body = stage[name]
+        if name == "$project" and isinstance(body, dict):
+            return body.get("_id") in (0, False)
+        if name == "$unset":
+            fields = body if isinstance(body, list) else [body]
+            return "_id" in fields
+        return name in ("$replaceRoot", "$replaceWith")
+
+    user_stages = list(pipeline or [])
+    needs_resort = any(
+        _stage_name(s) in _ORDER_DESTROYING for s in user_stages)
+    if needs_resort and any(_drops_id(s) for s in user_stages):
+        raise ValueError(
+            "read_mongo: the pipeline reorders documents (e.g. $group/"
+            "$sort) and then drops `_id`, so parallel skip/limit paging "
+            "has no deterministic order to page over; keep `_id` in the "
+            "output or read with parallelism=1")
+
     def part_task(index: int):
         def task():
             client = client_factory()
             coll = client[database][collection]
             start = index * per
-            stages = (list(pipeline or [])
-                      + [{"$sort": {"_id": 1}}, {"$skip": start}])
+            stages = ([{"$sort": {"_id": 1}}]
+                      + user_stages
+                      + ([{"$sort": {"_id": 1}}] if needs_resort else [])
+                      + [{"$skip": start}])
             if index < parallelism - 1:
                 stages.append({"$limit": per})
             rows = list(coll.aggregate(stages))

@@ -35,6 +35,16 @@ CHAOS_METHODS = ",".join([
     # pin-taking RPCs ride the replay cache, so drop/dup must converge)
     "store_locate_batch", "store_unpin", "store_unpin_batch",
     "store_read_chunk", "pull_object",
+    # compiled-graph channels: creation is replay-cached (mints an arena
+    # range + a pin), the per-step push/commit carry absolute versions so
+    # dropped/duplicated frames must converge, and close is idempotent
+    "channel_create", "channel_push", "channel_write_chunk",
+    "channel_commit", "channel_close",
+    # non-RPC seqlock perturbation points inside the shm channel protocol
+    # (chaos.maybe_delay): the method filter applies to these names too,
+    # so they must be listed or the in-process write/read/ack timing is
+    # never perturbed
+    "channel.write", "channel.read", "channel.ack",
 ])
 
 
@@ -146,6 +156,40 @@ def run_chaos_workload(
             cluster.remove_node(doomed)  # supervisor kill mid-run
             cluster.add_node(num_cpus=2, resources={"doomed": 100})
             cluster.wait_for_nodes(2)
+
+        # compiled-graph channels under the same schedule: a 2-stage
+        # cross-node pipeline (stable -> replacement node) whose per-step
+        # pushes stream ~4 chunk frames each through the attacked
+        # channel_write_chunk/commit path; results must stay exact
+        import numpy as np
+
+        @ray_tpu.remote
+        class ChanStage:
+            def mul2(self, x):
+                return x * 2.0
+
+        cs_a = ChanStage.options(resources={"stable": 1}).remote()
+        cs_b = ChanStage.options(resources={"doomed": 1}).remote()
+        ray_tpu.get([cs_a.mul2.remote(1.0), cs_b.mul2.remote(1.0)],
+                    timeout=120)
+        from ray_tpu.dag import InputNode
+
+        with InputNode() as inp:
+            chan_dag = cs_b.mul2.bind(cs_a.mul2.bind(inp))
+        compiled = chan_dag.experimental_compile()
+        # a chaos-induced compile failure falls back to dynamic execution,
+        # which would pass the exactness asserts while attacking none of
+        # the channel RPCs — the soak must fail loudly instead
+        assert compiled.is_channel_backed, (
+            "compiled-channel section fell back to dynamic execution")
+        try:
+            for i in range(4):
+                arr = np.full(120_000, float(i))  # ~1 MB -> chunked push
+                out = ray_tpu.get(compiled.execute(arr), timeout=120)
+                assert np.array_equal(out, arr * 4.0), (
+                    "compiled-channel pipeline corrupted under chaos")
+        finally:
+            compiled.teardown()
 
         # training runs FIRST so the tasks/actor calls above settle (with
         # their retries) concurrently under it — the asserts below are then

@@ -41,10 +41,21 @@ class _WatchedStream(ray_tpu.ObjectRefGenerator):
         self._replica_key = replica_key
 
     def _next(self, timeout=None):
+        import asyncio
+        import concurrent.futures
+
         try:
             return super()._next(timeout)
         except StopIteration:
             self._router._note_result(self._replica_key, ok=True)
+            raise
+        except (TimeoutError, GeneratorExit, asyncio.CancelledError,
+                concurrent.futures.CancelledError):
+            # NOT replica failures: a timeout is the CONSUMER's deadline
+            # on a slow-but-healthy stream (GetTimeoutError subclasses
+            # TimeoutError), GeneratorExit/Cancelled are consumer-side
+            # aborts. Marking these would penalize a replica for 10s in
+            # the pow-2 draw for merely streaming slowly.
             raise
         except BaseException:
             self._router._note_result(self._replica_key, ok=False)

@@ -1,0 +1,380 @@
+"""Compiled-graph execution: mutable channels + per-actor run loops.
+
+Reference analogs: `python/ray/dag/tests/experimental/test_accelerated_dag.py`
+(compiled execution, teardown, actor-death unwinding) over the channel
+subsystem in `ray_tpu/_private/channels.py`.
+
+Compiled actors are DEDICATED: the run loop occupies the actor until
+teardown, so each test uses fresh actors and kills them afterwards.
+"""
+
+import gc
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import ChannelClosedError, InputNode, MultiOutputNode
+
+
+@ray_tpu.remote
+class Stage:
+    def __init__(self, k=1):
+        self.k = k
+
+    def mul(self, x):
+        return x * self.k
+
+    def add(self, a, b):
+        return a + b
+
+    def try_mutate(self, arr):
+        try:
+            arr[0] = 99.0
+            return "mutated"
+        except (ValueError, TypeError):
+            return "readonly"
+
+
+def _alive(*actors):
+    ray_tpu.get([a.mul.remote(1) for a in actors], timeout=60)
+
+
+def _store_pins(core):
+    stats = core._run(core.clients.get(core.supervisor_addr).call(
+        "store_stats"))
+    return stats["pins_total"]
+
+
+class TestCompiledChain:
+    def test_parity_and_multi_step_reuse(self, ray_init):
+        a, b = Stage.remote(2), Stage.remote(3)
+        _alive(a, b)
+        with InputNode() as inp:
+            dag = b.mul.bind(a.mul.bind(inp))
+        # dynamic baseline BEFORE compiling (the loop dedicates the actors)
+        dynamic = [ray_tpu.get(dag.execute(i)) for i in range(3)]
+        assert dynamic == [0, 6, 12]
+
+        compiled = dag.experimental_compile()
+        assert compiled.is_channel_backed
+        try:
+            # the same channels serve every step: versions advance, no
+            # reallocation, results match the dynamic path
+            for i in range(10):
+                assert ray_tpu.get(compiled.execute(i)) == i * 6
+            # numpy payloads ride the same buffers
+            arr = np.arange(4096, dtype=np.float64)
+            out = compiled.execute(arr).get()
+            assert np.array_equal(out, arr * 6)
+        finally:
+            compiled.teardown()
+        ray_tpu.kill(a)
+        ray_tpu.kill(b)
+
+    def test_arity_validated_and_post_teardown_raises(self, ray_init):
+        a = Stage.remote(2)
+        _alive(a)
+        with InputNode() as inp:
+            dag = a.mul.bind(inp)
+        compiled = dag.experimental_compile()
+        try:
+            with pytest.raises(ValueError, match="expects 1"):
+                compiled.execute(1, 2)
+            assert ray_tpu.get(compiled.execute(4)) == 8
+        finally:
+            compiled.teardown()
+        compiled.teardown()  # idempotent
+        with pytest.raises(ChannelClosedError):
+            compiled.execute(1)
+        ray_tpu.kill(a)
+
+    def test_multi_output_shared_edge_and_passthrough(self, ray_init):
+        """One producer feeding two consumers (shared edge => two reader
+        slots on one channel) plus an InputNode passthrough output."""
+        a, b, c = Stage.remote(2), Stage.remote(3), Stage.remote(5)
+        _alive(a, b, c)
+        with InputNode() as inp:
+            mid = a.mul.bind(inp)
+            dag = MultiOutputNode([b.mul.bind(mid), c.mul.bind(mid), inp])
+        compiled = dag.experimental_compile()
+        assert compiled.is_channel_backed
+        try:
+            for i in range(5):
+                assert ray_tpu.get(compiled.execute(i)) == \
+                    [i * 6, i * 10, i]
+        finally:
+            compiled.teardown()
+        for actor in (a, b, c):
+            ray_tpu.kill(actor)
+
+    def test_constants_and_kwargs(self, ray_init):
+        a = Stage.remote()
+        _alive(a)
+        with InputNode() as inp:
+            dag = a.add.bind(inp, b=7)
+        compiled = dag.experimental_compile()
+        try:
+            assert ray_tpu.get(compiled.execute(3)) == 10
+        finally:
+            compiled.teardown()
+        ray_tpu.kill(a)
+
+    def test_get_accepts_lists_with_compiled_refs(self, ray_init):
+        """ray_tpu.get parity: CompiledDAGRefs resolve inside lists,
+        including mixed with ordinary ObjectRefs (order preserved)."""
+        a = Stage.remote(2)
+        _alive(a)
+        with InputNode() as inp:
+            dag = a.mul.bind(inp)
+        compiled = dag.experimental_compile()
+        try:
+            r1 = compiled.execute(1)
+            r2 = compiled.execute(2)
+            obj = ray_tpu.put(41)
+            assert ray_tpu.get([r1, obj, r2]) == [2, 41, 4]
+        finally:
+            compiled.teardown()
+        ray_tpu.kill(a)
+
+    def test_wide_fanout_falls_back_to_dynamic(self, ray_init):
+        """A producer with more same-node consumers than the header's
+        ack-slot array (MAX_READERS) must degrade to dynamic execution —
+        never silently drop flow control for the extra readers."""
+        from ray_tpu._private.channels import MAX_READERS
+
+        producer = Stage.remote(2)
+        consumers = [Stage.remote(k) for k in range(MAX_READERS + 1)]
+        _alive(producer, *consumers)
+        with InputNode() as inp:
+            mid = producer.mul.bind(inp)
+            dag = MultiOutputNode([c.mul.bind(mid) for c in consumers])
+        compiled = dag.experimental_compile()
+        assert not compiled.is_channel_backed
+        assert ray_tpu.get(compiled.execute(1)) == \
+            [2 * k for k in range(MAX_READERS + 1)]
+        compiled.teardown()
+        for actor in (producer, *consumers):
+            ray_tpu.kill(actor)
+
+    def test_teardown_drops_actor_subscriptions(self, ray_init):
+        """Compile/teardown cycles must not accumulate dead graphs in
+        the driver's pubsub handler lists."""
+        from ray_tpu._private import api
+
+        core = api._core
+        a = Stage.remote(2)
+        _alive(a)
+        hexid = a._actor_id.hex()
+        baseline = len(core._pub_handlers.get("actor:" + hexid, []))
+        for i in range(3):
+            with InputNode() as inp:
+                dag = a.mul.bind(inp)
+            compiled = dag.experimental_compile()
+            assert ray_tpu.get(compiled.execute(i)) == i * 2
+            compiled.teardown()
+        assert len(core._pub_handlers.get("actor:" + hexid, [])) == \
+            baseline
+        ray_tpu.kill(a)
+
+    def test_zero_input_graph_stays_dynamic(self, ray_init):
+        """No InputNode = no input channel for the run loop to block on;
+        a channel loop would free-run side-effecting methods ahead of
+        execute(), so these graphs keep the dynamic path."""
+        a = Stage.remote(2)
+        _alive(a)
+        dag = a.mul.bind(3)
+        compiled = dag.experimental_compile()
+        assert not compiled.is_channel_backed
+        assert ray_tpu.get(compiled.execute()) == 6
+        compiled.teardown()
+        ray_tpu.kill(a)
+
+    def test_function_dags_fall_back_to_dynamic(self, ray_init):
+        """Function nodes have no resident process for a run loop; their
+        compilation stays the frozen-topology dynamic path."""
+
+        @ray_tpu.remote
+        def double(x):
+            return x * 2
+
+        with InputNode() as inp:
+            dag = double.bind(inp)
+        compiled = dag.experimental_compile()
+        assert not compiled.is_channel_backed
+        assert ray_tpu.get(compiled.execute(5)) == 10
+        compiled.teardown()  # parity no-op
+
+
+class _FakeArena:
+    """Just enough of ArenaFile for header-level unit tests."""
+
+    def __init__(self, size):
+        self._buf = memoryview(bytearray(size))
+
+    def view(self, offset, size):
+        return self._buf[offset:offset + size]
+
+    def write(self, offset, data):
+        self._buf[offset:offset + len(data)] = data
+
+
+class TestChannelHeaderGuards:
+    """The header carries MAX_READERS ack slots; overflow must fail
+    loudly — a clamped count silently loses flow control and an
+    out-of-range ack would stamp into the payload bytes."""
+
+    def test_init_header_rejects_reader_overflow(self):
+        from ray_tpu._private import channels
+
+        arena = _FakeArena(channels.total_size(64))
+        with pytest.raises(ValueError, match="reader slots"):
+            channels.init_header(arena, 0, channels.MAX_READERS + 1)
+        channels.init_header(arena, 0, channels.MAX_READERS)  # boundary
+
+    def test_ack_slot_out_of_range_raises(self):
+        from ray_tpu._private import channels
+
+        arena = _FakeArena(channels.total_size(64))
+        channels.init_header(arena, 0, 2)
+        spec = channels.ChannelSpec(
+            channel_id=b"\x01" * 16, node_addr=("h", 1), offset=0,
+            size=channels.total_size(64), n_readers=2)
+        ch = channels.LocalChannel(arena, spec)
+        with pytest.raises(ValueError, match="out of range"):
+            ch.ack(channels.MAX_READERS, 2)
+
+
+class TestZeroCopyAndCounters:
+    def test_read_only_view_enforcement(self, ray_init):
+        """Channel payloads deserialize as read-only views over the
+        shared arena: a consumer mutating its input raises."""
+        a, b = Stage.remote(1), Stage.remote(1)
+        _alive(a, b)
+        with InputNode() as inp:
+            dag = b.try_mutate.bind(a.mul.bind(inp))
+        compiled = dag.experimental_compile()
+        try:
+            out = ray_tpu.get(
+                compiled.execute(np.arange(100, dtype=np.float64)))
+            assert out == "readonly"
+        finally:
+            compiled.teardown()
+        ray_tpu.kill(a)
+        ray_tpu.kill(b)
+
+    @pytest.mark.perf
+    def test_steady_state_step_is_zero_control_rpcs(self, ray_init):
+        """THE contract of the subsystem: once compiled, a step costs
+        channel writes/reads, not RPCs. Counter-based (never wall-clock):
+        the driver's outbound-RPC counter must not move across a window
+        of steps, while the channel counters advance step-for-step."""
+        from ray_tpu._private import channels
+        from ray_tpu._private.rpc import _m_client_calls
+
+        a, b = Stage.remote(2), Stage.remote(3)
+        _alive(a, b)
+        with InputNode() as inp:
+            dag = b.mul.bind(a.mul.bind(inp))
+        compiled = dag.experimental_compile()
+        try:
+            for i in range(3):  # warm: loops installed, pins taken
+                assert ray_tpu.get(compiled.execute(i)) == i * 6
+            # settle background traffic (pending unpin flushes, borrows)
+            gc.collect()
+            time.sleep(0.5)
+            rpc_before = _m_client_calls.total()
+            writes0 = channels._m_writes.total()
+            reads0 = channels._m_reads.total()
+            steps0 = channels._m_steps.total()
+            n = 15
+            for i in range(n):
+                assert ray_tpu.get(compiled.execute(i)) == i * 6
+            assert _m_client_calls.total() == rpc_before, (
+                "steady-state compiled steps issued control-plane RPCs")
+            assert channels._m_steps.total() == steps0 + n
+            # driver side: 1 input write + 1 output read per step
+            assert channels._m_writes.total() == writes0 + n
+            assert channels._m_reads.total() == reads0 + n
+        finally:
+            compiled.teardown()
+        ray_tpu.kill(a)
+        ray_tpu.kill(b)
+
+    def test_teardown_releases_pins(self, ray_init):
+        """Channel ranges are pin-backed; teardown must return the node
+        store's pin count AND the driver's outstanding-pin gauge to
+        baseline (leaked pins would block spill forever)."""
+        from ray_tpu._private import api
+        from ray_tpu._private.core_worker import _m_pins
+
+        core = api._core
+        gc.collect()
+        time.sleep(0.3)
+        pins_before = _store_pins(core)
+        gauge_before = _m_pins.value()
+        a, b = Stage.remote(2), Stage.remote(3)
+        _alive(a, b)
+        with InputNode() as inp:
+            dag = b.mul.bind(a.mul.bind(inp))
+        compiled = dag.experimental_compile()
+        for i in range(3):
+            assert ray_tpu.get(compiled.execute(i)) == i * 6
+        assert _store_pins(core) > pins_before  # channels are pinned
+        compiled.teardown()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if (_store_pins(core) == pins_before
+                    and _m_pins.value() == gauge_before):
+                break
+            time.sleep(0.2)
+        assert _store_pins(core) == pins_before, "store pins leaked"
+        assert _m_pins.value() == gauge_before, "driver pin gauge leaked"
+        ray_tpu.kill(a)
+        ray_tpu.kill(b)
+
+
+@pytest.mark.chaos
+class TestFailureUnwinding:
+    def test_participant_death_closes_all_peers(self, ray_init):
+        """Killing one participant mid-loop must (a) surface at the
+        driver within the failure-detection deadline, (b) end the OTHER
+        actor's loop with ChannelClosedError (clean exit), and (c) leak
+        no pins once the graph is torn down."""
+        from ray_tpu._private import api
+        from ray_tpu._private.exceptions import ActorDiedError
+
+        core = api._core
+        gc.collect()
+        time.sleep(0.3)
+        pins_before = _store_pins(core)
+        a, b = Stage.remote(2), Stage.remote(3)
+        _alive(a, b)
+        with InputNode() as inp:
+            dag = b.mul.bind(a.mul.bind(inp))
+        compiled = dag.experimental_compile()
+        assert ray_tpu.get(compiled.execute(1)) == 6
+
+        ray_tpu.kill(b)  # participant dies mid-loop
+
+        with pytest.raises((ChannelClosedError, ActorDiedError)):
+            deadline = time.monotonic() + 30
+            i = 2
+            while time.monotonic() < deadline:
+                ray_tpu.get(compiled.execute(i), timeout=10)
+                i += 1
+        # the surviving peer's loop observed the close and exited CLEANLY
+        # (ChannelClosedError internally -> a normal {'steps': N} return)
+        surviving = compiled._graph._loop_refs[0]
+        out = ray_tpu.get(surviving, timeout=30)
+        assert isinstance(out, dict) and out["steps"] >= 1
+        compiled.teardown()
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if _store_pins(core) == pins_before:
+                break
+            time.sleep(0.2)
+        assert _store_pins(core) == pins_before, (
+            "pins leaked after participant death + teardown")
+        ray_tpu.kill(a)

@@ -1,0 +1,92 @@
+"""Compiled-graph execution over multi-node clusters: cross-node
+channel edges (per-step chunked push) and deterministic chaos kills.
+
+Separate module from test_compiled_dag.py: these tests build their own
+`Cluster`s and must not coexist with the module-scoped single-node
+`ray_init` fixture.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import ChannelClosedError, InputNode
+
+
+@ray_tpu.remote
+class Stage:
+    def __init__(self, k=1):
+        self.k = k
+
+    def mul(self, x):
+        return x * self.k
+
+
+def _alive(*actors):
+    ray_tpu.get([a.mul.remote(1) for a in actors], timeout=60)
+
+
+class TestCrossNode:
+    def test_cross_node_edge_chunked_push(self, ray_cluster):
+        """A compiled edge between actors on different nodes rides the
+        pre-established per-step push (chunked: payload >> chunk size)."""
+        ray_cluster.add_node(num_cpus=4, resources={"left": 10})
+        ray_cluster.add_node(num_cpus=4, resources={"right": 10})
+        ray_cluster.wait_for_nodes(2)
+        ray_tpu.init(address=ray_cluster.address,
+                     _system_config={"object_transfer_chunk_bytes": 65536})
+        try:
+            left = Stage.options(resources={"left": 1}).remote(2)
+            right = Stage.options(resources={"right": 1}).remote(3)
+            _alive(left, right)
+            with InputNode() as inp:
+                dag = right.mul.bind(left.mul.bind(inp))
+            compiled = dag.experimental_compile()
+            assert compiled.is_channel_backed
+            try:
+                # ~800 KB payload -> a dozen chunk frames per push
+                arr = np.arange(100_000, dtype=np.float64)
+                for i in range(4):
+                    out = ray_tpu.get(compiled.execute(arr + i),
+                                      timeout=60)
+                    assert np.array_equal(out, (arr + i) * 6)
+            finally:
+                compiled.teardown()
+        finally:
+            ray_tpu.shutdown()
+
+
+@pytest.mark.chaos
+class TestChaosCrashPoint:
+    def test_chaos_crash_point_kills_loop_deterministically(self):
+        """The run loop is chaos-injectable: `worker.channel_step:<n>`
+        hard-exits a participant on its n-th iteration, and the graph
+        unwinds with ChannelClosedError at the driver."""
+        from ray_tpu._private.config import Config
+        from ray_tpu._private.exceptions import ActorDiedError, TaskError
+        from ray_tpu.cluster_utils import Cluster
+
+        cfg = Config.from_env()
+        cfg.chaos_seed = 7  # enables chaos; probabilities stay 0
+        cfg.chaos_crash_points = "worker.channel_step:3"
+        cluster = Cluster(config=cfg)
+        try:
+            cluster.add_node(num_cpus=4)
+            cluster.wait_for_nodes(1)
+            ray_tpu.init(address=cluster.address)
+            a, b = Stage.remote(2), Stage.remote(3)
+            _alive(a, b)
+            with InputNode() as inp:
+                dag = b.mul.bind(a.mul.bind(inp))
+            compiled = dag.experimental_compile()
+            with pytest.raises(
+                    (ChannelClosedError, ActorDiedError, TaskError)):
+                for i in range(50):
+                    ray_tpu.get(compiled.execute(i), timeout=30)
+            compiled.teardown()
+        finally:
+            if ray_tpu.is_initialized():
+                ray_tpu.shutdown()
+            cluster.shutdown()

@@ -169,3 +169,73 @@ def test_negative_int_roundtrip(ray_init, tmp_path):
     row = data.read_tfrecords(out).take_all()[0]
     assert row["a"] == -1
     assert row["b"] == -123456789
+
+
+class _FakeMongo:
+    """Minimal pymongo stand-in: canned docs, records every aggregate()
+    stage list, honors $skip/$limit so tasks produce real blocks."""
+
+    def __init__(self, docs, calls):
+        self._docs = docs
+        self.calls = calls
+
+    def __getitem__(self, _name):
+        return self
+
+    def estimated_document_count(self):
+        return len(self._docs)
+
+    def aggregate(self, stages):
+        self.calls.append(stages)
+        rows = [dict(d) for d in self._docs]
+        for st in stages:
+            if "$skip" in st:
+                rows = rows[st["$skip"]:]
+            elif "$limit" in st:
+                rows = rows[:st["$limit"]]
+        return rows
+
+
+class TestMongoPaging:
+    def _tasks_and_calls(self, pipeline, parallelism=2):
+        from ray_tpu.data.datasource import mongo_tasks
+
+        calls = []
+        docs = [{"_id": i, "v": i} for i in range(6)]
+        tasks = mongo_tasks("mongodb://x", "db", "c", pipeline=pipeline,
+                            parallelism=parallelism,
+                            client_factory=lambda: _FakeMongo(docs, calls))
+        return tasks, calls
+
+    def test_order_preserving_pipeline_presorts_only(self):
+        """$match keeps the scan order: the page grid is the single
+        pre-pipeline $sort on _id — no redundant post-sort."""
+        tasks, calls = self._tasks_and_calls([{"$match": {"v": {"$gte": 0}}}])
+        blocks = [t() for t in tasks]
+        assert sum(b.num_rows for b in blocks) == 6
+        for stages in calls:
+            assert stages[0] == {"$sort": {"_id": 1}}
+            assert stages[1] == {"$match": {"v": {"$gte": 0}}}
+            # exactly one $sort: the user pipeline preserves it
+            assert sum(1 for s in stages if "$sort" in s) == 1
+
+    def test_group_pipeline_resorted_after(self):
+        """$group emits groups in unspecified per-run order, so the page
+        grid must be re-established by a post-pipeline $sort on the _id
+        every $group emits."""
+        group = {"$group": {"_id": "$v", "n": {"$sum": 1}}}
+        tasks, calls = self._tasks_and_calls([group])
+        [t() for t in tasks]
+        for stages in calls:
+            gi = stages.index(group)
+            assert stages[gi + 1] == {"$sort": {"_id": 1}}, (
+                "skip/limit paged over $group's unspecified order")
+
+    def test_group_then_dropping_id_raises(self):
+        """Reordering pipeline + no _id in the output = nothing
+        deterministic to page over; refuse instead of silently
+        dropping/duplicating rows between partitions."""
+        with pytest.raises(ValueError, match="_id"):
+            self._tasks_and_calls([
+                {"$group": {"_id": "$v", "n": {"$sum": 1}}},
+                {"$project": {"_id": 0, "n": 1}}])

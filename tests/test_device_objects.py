@@ -211,12 +211,14 @@ class TestDeviceObjectsCrossProcess:
         ray_tpu.kill(p)
         ray_tpu.kill(c)
 
-    def test_compiled_dag_stage_pass_stays_on_device(self, ray_init):
-        """The compiled-DAG pattern the reference serves with mutable
-        plasma channels: actor stages bound into a DAG pass a large
-        jax.Array hop to hop; with device objects each hop is metadata
-        through the control plane + direct worker-to-worker shard
-        streaming — re-executable via the frozen topology."""
+    def test_compiled_dag_stage_device_hops(self, ray_init):
+        """Actor stages passing a large jax.Array hop to hop, under both
+        execution modes: COMPILED graphs host-stage the array through the
+        mutable shm channels (same-host shared memory; device state lives
+        inside the loop's process), while the DYNAMIC path keeps the hop
+        a DEVICE object — metadata through the control plane + direct
+        worker-to-worker shard streaming. The compiled loop dedicates the
+        actors, so the dynamic check runs after teardown frees them."""
         from ray_tpu.dag import InputNode
 
         @ray_tpu.remote
@@ -237,10 +239,14 @@ class TestDeviceObjectsCrossProcess:
         with InputNode() as inp:
             dag = b.total.bind(a.apply.bind(inp))
         compiled = dag.experimental_compile()
-        for factor in (1, 3):
-            out = ray_tpu.get(compiled.execute(factor), timeout=120)
-            assert out == 512 * 256 * factor
-        # the hop really is a DEVICE object (held ref so GC can't race)
+        try:
+            for factor in (1, 3):
+                out = ray_tpu.get(compiled.execute(factor), timeout=120)
+                assert out == 512 * 256 * factor
+        finally:
+            compiled.teardown()  # frees the actors for dynamic calls
+        # dynamic path: the hop really is a DEVICE object (held ref so
+        # GC can't race)
         from ray_tpu._private import api as api_mod
 
         hop = a.apply.remote(5)

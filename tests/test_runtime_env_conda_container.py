@@ -43,12 +43,12 @@ sys.exit(2)
 
 FAKE_PODMAN = """\
 #!{python}
-import os, sys
+import os, stat, sys
 args = sys.argv[1:]
 assert args and args[0] == "run", args
 args = args[1:]
-VALUE_FLAGS = {{"-v", "--volume", "--env", "--workdir", "--network",
-               "--ipc", "--gpus"}}
+VALUE_FLAGS = {{"-v", "--volume", "--env", "--env-file", "--workdir",
+               "--network", "--ipc", "--gpus"}}
 image, rest, envs, i = None, [], [], 0
 while i < len(args):
     a = args[i]
@@ -57,6 +57,18 @@ while i < len(args):
     elif a in VALUE_FLAGS:
         if a == "--env":
             envs.append(args[i + 1])
+        elif a == "--env-file":
+            path = args[i + 1]
+            # the secrecy contract: the env-file must not be
+            # world/group readable (mkstemp gives 0600)
+            mode = stat.S_IMODE(os.stat(path).st_mode)
+            assert mode == 0o600, oct(mode)
+            for line in open(path):
+                line = line.rstrip("\\n")
+                if line:
+                    envs.append(line)
+                    k, _, v = line.partition("=")
+                    os.environ[k] = v  # engines apply the file's vars
         i += 2
     elif a.startswith("-"):
         i += 1
@@ -64,6 +76,7 @@ while i < len(args):
         image = a
         rest = args[i + 1:]
         break
+assert not [e for e in envs if "\\t" in e.split("=", 1)[0]]
 with open(os.environ["FAKE_PODMAN_LOG"], "a") as f:
     f.write(image + "\\t" + str(len(envs)) + "\\n")
 os.execvp(rest[0], rest)  # "inside the container"
@@ -163,11 +176,17 @@ class TestContainerRuntimeEnv:
         assert out == 42
         log = fake_engines["podman_log"].read_text()
         assert "fake.registry/ml:v1" in log
-        # env was forwarded explicitly via --env flags
+        # env was forwarded via the 0600 --env-file (never --env k=v
+        # argv, which leaks secrets through ps//proc)
         n_envs = int(log.strip().splitlines()[-1].split("\t")[1])
         assert n_envs > 5
         # container workers live in their own pool keyed by image
         assert env_key
+        # ...and the env-file itself is deleted once the engine consumed
+        # it (worker registration): secrets must not persist on disk
+        import glob
+        session_dir = fresh_cluster["session_dir"]
+        assert glob.glob(os.path.join(session_dir, "rtpu_env_*.env")) == []
 
     def test_string_shorthand(self, fresh_cluster, fake_engines):
         @ray_tpu.remote(runtime_env={"container": "plain:latest"})
