@@ -351,7 +351,7 @@ class MirrorWriter:
         _m_bytes.inc(len(payload), labels={"op": "push"})
 
     async def _push_async(self, payload, version: int) -> None:
-        import asyncio
+        from ray_tpu._private import rpc
 
         client = self._core.clients.get(tuple(self.spec.node_addr))
         cid = self.spec.channel_id
@@ -362,26 +362,11 @@ class MirrorWriter:
                  "payload": bytes(payload)},
                 timeout=self._timeout)
             return
-        sem = asyncio.Semaphore(self._window)
-        view = memoryview(payload)
-
-        async def send(pos: int) -> None:
-            async with sem:
-                await client.call(
-                    "channel_write_chunk",
-                    {"channel_id": cid, "version": version, "offset": pos,
-                     "data": bytes(view[pos:pos + self._chunk])},
-                    timeout=self._timeout)
-
-        tasks = [asyncio.ensure_future(send(pos))
-                 for pos in range(0, len(payload), self._chunk)]
-        try:
-            await asyncio.gather(*tasks)
-        except Exception:
-            for t in tasks:
-                t.cancel()
-            await asyncio.gather(*tasks, return_exceptions=True)
-            raise
+        await rpc.call_chunked(
+            client, "channel_write_chunk",
+            {"channel_id": cid, "version": version}, payload,
+            chunk_bytes=self._chunk, window=self._window,
+            timeout=self._timeout)
         await client.call(
             "channel_commit",
             {"channel_id": cid, "version": version,

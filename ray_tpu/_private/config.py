@@ -59,6 +59,30 @@ class Config:
     # external_storage.py:496): "" = local dir above; file:///path,
     # mock://dir (fake remote, tests), s3://bucket/prefix
     object_spilling_uri: str = ""
+    # ---- control-plane payload guard ----
+    # kv_put rejects values above this size with a pointer at the object
+    # store / collectives: the controller KV is a metadata plane, and a
+    # tensor-sized value would approach MAX_FRAME and stall every other
+    # control RPC behind one pickled socket
+    kv_max_value_bytes: int = 64 * 1024**2
+    # ---- collectives (util/collective, "host" backend data plane) ----
+    # data-path algorithm: "auto" picks shared-memory channels when every
+    # rank sits on one node (and the world fits the channel reader slots),
+    # else the cross-node ring; "shm"/"ring" force one; "kv" forces the
+    # legacy controller-KV rounds (rendezvous-only baseline, comparison
+    # target for the collective_speedup microbench probe)
+    collective_algo: str = "auto"
+    # per-frame chunk size + bounded window of in-flight chunk RPCs for
+    # ring segments (the RAY_TPU_OBJECT_TRANSFER_WINDOW pattern): tensors
+    # larger than MAX_FRAME stream as many small frames
+    collective_chunk_bytes: int = 4 * 1024**2
+    collective_window: int = 4
+    # payload capacity of each rank's shared-memory collective channel;
+    # larger tensors stream through it in multiple seqlock rounds
+    collective_channel_bytes: int = 4 * 1024**2
+    # allreduce_coalesced packs same-dtype tensors into buckets of at
+    # most this many bytes (one collective round per bucket)
+    collective_coalesce_bytes: int = 32 * 1024**2
     # ---- compiled-graph channels (dag.experimental_compile) ----
     # payload capacity of each mutable channel; a compiled step whose
     # packed value exceeds it raises (override per-graph via

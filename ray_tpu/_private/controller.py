@@ -161,6 +161,10 @@ class Controller:
         self.pgs: Dict[str, PGRecord] = {}
         self.jobs: Dict[str, JobRecord] = {}
         self.kv: Dict[str, Dict[str, bytes]] = {}
+        # kv_wait long-pollers: (ns, key) -> futures resolved by the next
+        # put (collective rendezvous, PG readiness — replaces client-side
+        # busy-polling on the control plane)
+        self._kv_waiters: Dict[Tuple[str, str], List[asyncio.Future]] = {}
         self.subscribers: Dict[str, Set[Address]] = {}
         self.task_events: deque = deque(maxlen=config.task_event_buffer_size)
         self._health_task: Optional[asyncio.Task] = None
@@ -364,6 +368,7 @@ class Controller:
                     h not in self.nodes for h in pg.assignment):
                 pg.state = PG_PENDING
                 pg.assignment = []
+                self._pg_kv_update(pg.pg_id_hex, None)
                 await self._publish(
                     "pg:" + pg.pg_id_hex,
                     {"state": PG_PENDING, "pg_id_hex": pg.pg_id_hex})
@@ -730,6 +735,7 @@ class Controller:
             if pg.state == PG_CREATED and node_hex in pg.assignment:
                 pg.state = PG_PENDING
                 pg.assignment = []
+                self._pg_kv_update(pg.pg_id_hex, None)
                 await self._publish(
                     "pg:" + pg.pg_id_hex, {"state": PG_PENDING, "pg_id_hex": pg.pg_id_hex}
                 )
@@ -737,24 +743,74 @@ class Controller:
 
     # ------------------------------------------------------------- KV / functions
 
+    def _kv_notify(self, ns: str, key: str, value) -> None:
+        """Resolve kv_wait long-pollers parked on (ns, key)."""
+        waiters = self._kv_waiters.pop((ns, key), None)
+        if not waiters:
+            return
+        for fut in waiters:
+            if not fut.done():
+                fut.set_result(value)
+
     @replay_cached  # overwrite=False must answer a retry like the original
     async def rpc_kv_put(self, body) -> bool:
+        value = body["value"]
+        size = serialization.payload_nbytes(value)
+        if size > self.config.kv_max_value_bytes:
+            # the KV is a metadata plane: a tensor-sized value would creep
+            # toward MAX_FRAME and stall every control RPC behind one
+            # pickled socket — fail loudly with a pointer at the data plane
+            raise ValueError(
+                f"kv_put value for {body['key']!r} is {size} bytes, above "
+                f"the control-plane cap of {self.config.kv_max_value_bytes} "
+                f"(RAY_TPU_KV_MAX_VALUE_BYTES). Move tensor-sized payloads "
+                f"through the object store (ray_tpu.put) or the collective "
+                f"data plane (ray_tpu.util.collective), not the controller "
+                f"KV.")
         ns = self.kv.setdefault(body.get("ns", ""), {})
         overwrite = body.get("overwrite", True)
         if not overwrite and body["key"] in ns:
             return False
-        ns[body["key"]] = body["value"]
+        ns[body["key"]] = value
         self._mark_dirty()
         # KV writes back named-actor rendezvous, collective groups, and
         # runtime-env manifests — registrations in spirit: durable before
         # the ack, O(entry) via the WAL
         await self._wal_append("kv", (body.get("ns", ""), body["key"],
-                                      body["value"]))
+                                      value))
+        self._kv_notify(body.get("ns", ""), body["key"], value)
         return True
 
     @idempotent
     async def rpc_kv_get(self, body):
         return self.kv.get(body.get("ns", ""), {}).get(body["key"])
+
+    @idempotent  # pure read with a deadline; retries just re-park
+    async def rpc_kv_wait(self, body) -> dict:
+        """Long-poll for a key: return immediately when present, else park
+        until the next kv_put on it (or the timeout). One RPC replaces a
+        client-side sleep-and-repoll loop — the rendezvous latency floor,
+        and far fewer control-plane round trips."""
+        ns = body.get("ns", "")
+        key = body["key"]
+        held = self.kv.get(ns, {})
+        if key in held:
+            return {"found": True, "value": held[key]}
+        timeout = min(float(body.get("timeout", 30.0)), 30.0)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._kv_waiters.setdefault((ns, key), []).append(fut)
+        try:
+            value = await asyncio.wait_for(fut, timeout)
+            return {"found": True, "value": value}
+        except asyncio.TimeoutError:
+            return {"found": False, "value": None}
+        finally:
+            waiters = self._kv_waiters.get((ns, key))
+            if waiters is not None:
+                if fut in waiters:
+                    waiters.remove(fut)
+                if not waiters:
+                    self._kv_waiters.pop((ns, key), None)
 
     @replay_cached  # retry after a lost reply must still report existed=True
     async def rpc_kv_del(self, body) -> bool:
@@ -1026,6 +1082,24 @@ class Controller:
         await self._try_place_pg(pg)
         return {"state": pg.state, "assignment": pg.assignment}
 
+    def _pg_kv_update(self, pg_id_hex: str, state: Optional[str]) -> None:
+        """Mirror a PG's terminal-ish state into the KV ns 'pg' so
+        PlacementGroup.wait() can long-poll it via kv_wait instead of
+        hammering pg_get on a 50 ms sleep loop. ``None`` clears the key
+        (reversion to PENDING on node death). REMOVED notifies parked
+        waiters and then reaps the key — it is terminal, wait() re-checks
+        pg_get on every wake anyway, and keeping it would grow the KV by
+        one entry per PG ever removed."""
+        ns = self.kv.setdefault("pg", {})
+        if state is None:
+            ns.pop(pg_id_hex, None)
+        elif state == PG_REMOVED:
+            self._kv_notify("pg", pg_id_hex, state)
+            ns.pop(pg_id_hex, None)
+        else:
+            ns[pg_id_hex] = state
+            self._kv_notify("pg", pg_id_hex, state)
+
     async def _try_place_pg(self, pg: PGRecord) -> None:
         views = [r.view() for r in self.nodes.values() if r.alive]
         try:
@@ -1065,6 +1139,7 @@ class Controller:
             return
         pg.assignment = assignment
         pg.state = PG_CREATED
+        self._pg_kv_update(pg.pg_id_hex, PG_CREATED)
         self._mark_dirty()
         await self._publish(
             "pg:" + pg.pg_id_hex,
@@ -1104,6 +1179,7 @@ class Controller:
                 pass
         pg.state = PG_REMOVED
         pg.assignment = []
+        self._pg_kv_update(pg.pg_id_hex, PG_REMOVED)
         self._mark_dirty()
         await self._publish("pg:" + pg.pg_id_hex, {"state": PG_REMOVED})
 

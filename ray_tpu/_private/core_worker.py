@@ -362,6 +362,10 @@ class CoreWorker:
         self._actor_submit_locks: Dict[str, asyncio.Lock] = {}
         self._actor_events: Dict[str, asyncio.Event] = {}
         self._pub_handlers: Dict[str, List[Callable]] = {}
+        # (node_id_hex, supervisor_addr) callbacks run on node-death
+        # fan-out BEFORE lease requeue — e.g. the collective transport
+        # poisons ring waits on peers of the dead node
+        self.node_death_hooks: List[Callable] = []
         self._task_events: deque = deque()
         # lineage: specs of finished tasks whose returns live in node arenas,
         # kept (bounded by lineage_max_bytes) so a lost SHARED object can be
@@ -1265,13 +1269,21 @@ class CoreWorker:
                         or f"worker {dead_hex[:8]} died "
                            f"(exit {body.get('exitcode')})")
 
-    async def _on_node_dead(self, supervisor_addr: Address) -> None:
+    async def _on_node_dead(self, supervisor_addr: Address,
+                            node_id_hex: str = "") -> None:
         """Controller declared a node dead: every lease granted by that
         node's supervisor is gone, and its supervisor can no longer send
         worker_failed for them — requeue their in-flight tasks here (the
         gap the double-fault chaos test exposed: tasks running on a killed
         node used to hang their owners forever)."""
         addr = tuple(supervisor_addr)
+        # fail-fast fan-out to subsystems blocked on peers of that node
+        # (collective ring waits poison instead of burning their timeout)
+        for hook in list(self.node_death_hooks):
+            try:
+                hook(node_id_hex, addr)
+            except Exception:
+                logger.exception("node-death hook failed")
         for shape, leases in self._leases.items():
             for lease in list(leases):
                 if tuple(lease.supervisor_addr) == addr:
@@ -1382,7 +1394,8 @@ class CoreWorker:
             self._on_actor_update(channel[len("actor:") :], message)
         elif channel == "nodes" and isinstance(message, dict) \
                 and message.get("event") == "DEAD" and message.get("address"):
-            await self._on_node_dead(tuple(message["address"]))
+            await self._on_node_dead(tuple(message["address"]),
+                                     message.get("node_id_hex", ""))
         # snapshot: unsubscribe() (e.g. a compiled-graph teardown on a
         # user thread) may mutate the list mid-delivery; list.remove
         # during iteration would silently skip another handler

@@ -19,6 +19,18 @@ def _core():
 
 def kv_put(key: str, value: Any, *, ns: str = "default", overwrite: bool = True) -> bool:
     core = _core()
+    from ray_tpu._private.serialization import payload_nbytes
+
+    size = payload_nbytes(value)
+    if size > core.config.kv_max_value_bytes:
+        # fail before serializing a tensor-sized frame onto the control
+        # plane (the controller enforces the same cap authoritatively)
+        raise ValueError(
+            f"kv_put value for {key!r} is {size} bytes, above the "
+            f"control-plane cap of {core.config.kv_max_value_bytes} "
+            f"(RAY_TPU_KV_MAX_VALUE_BYTES). Move tensor-sized payloads "
+            f"through the object store (ray_tpu.put) or the collective "
+            f"data plane (ray_tpu.util.collective), not the controller KV.")
     return core._run(
         core.clients.get(core.controller_addr).call(
             "kv_put", {"ns": ns, "key": key, "value": value, "overwrite": overwrite}
@@ -45,6 +57,32 @@ def kv_del(key: str, *, ns: str = "default") -> bool:
     return core._run(
         core.clients.get(core.controller_addr).call("kv_del", {"ns": ns, "key": key})
     )
+
+
+def kv_wait(key: str, timeout: float = 30.0, *, ns: str = "default") -> Any:
+    """Long-poll for ``key``: returns its value as soon as it exists
+    (possibly immediately), raises TimeoutError after ``timeout`` seconds.
+    ONE parked RPC per ~30 s slice replaces client-side sleep-and-repoll
+    loops on the control plane (collective rendezvous, PG readiness)."""
+    import time
+
+    core = _core()
+    deadline = time.monotonic() + timeout
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise TimeoutError(
+                f"kv_wait: key {key!r} (ns={ns!r}) did not appear within "
+                f"{timeout}s")
+        slice_s = min(remaining, 30.0)
+        reply = core._run(
+            core.clients.get(core.controller_addr).call(
+                "kv_wait", {"ns": ns, "key": key, "timeout": slice_s},
+                timeout=slice_s + core.config.rpc_request_timeout_s,
+            )
+        )
+        if reply.get("found"):
+            return reply["value"]
 
 
 def kv_keys(prefix: str = "", *, ns: str = "default") -> List[str]:

@@ -114,6 +114,26 @@ DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
                    60.0, 300.0)
 
 
+class _HistogramTimer:
+    """Context manager recording a wall-clock span into a Histogram."""
+
+    def __init__(self, hist: "Histogram", labels: Optional[Dict[str, str]]):
+        self._hist = hist
+        self._labels = labels
+        self._t0 = 0.0
+
+    def __enter__(self) -> "_HistogramTimer":
+        import time
+
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        import time
+
+        self._hist.observe(time.perf_counter() - self._t0, self._labels)
+
+
 class Histogram(Metric):
     TYPE = "histogram"
 
@@ -135,6 +155,11 @@ class Histogram(Metric):
                 counts[idx] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
+
+    def time(self, labels: Optional[Dict[str, str]] = None) -> _HistogramTimer:
+        """``with hist.time():`` — observe the block's wall-clock seconds
+        (the collective round / RPC latency idiom)."""
+        return _HistogramTimer(self, labels)
 
     def render(self) -> List[str]:
         out: List[str] = []

@@ -618,6 +618,52 @@ async def retry_call(
             await asyncio.sleep(delay)
 
 
+async def call_chunked(
+    client: RpcClient,
+    method: str,
+    base_body: dict,
+    payload,
+    *,
+    chunk_bytes: int,
+    window: int,
+    timeout: float,
+) -> int:
+    """Ship ``payload`` as a bounded window of ``method`` frames.
+
+    The shared transfer shape of the data plane (object pulls, compiled-DAG
+    mirror pushes, collective ring segments): each frame is
+    ``{**base_body, "offset": <byte offset>, "data": <chunk>}``, at most
+    ``window`` frames in flight at once, every frame under the caller's one
+    deadline budget. Handlers must be idempotent (same-offset rewrites
+    converge), which makes drop/dup/retry safe without a replay cache.
+    A zero-length payload still sends one frame so the receiver observes
+    the message. Returns the number of frames sent; any frame failure
+    cancels the rest of the window and propagates."""
+    view = memoryview(payload)
+    chunk = max(1, int(chunk_bytes))
+    offsets = list(range(0, len(view), chunk)) or [0]
+    deadline = time.monotonic() + timeout
+    sem = asyncio.Semaphore(max(1, int(window)))
+
+    async def send(pos: int) -> None:
+        async with sem:
+            await client.call(
+                method,
+                {**base_body, "offset": pos,
+                 "data": bytes(view[pos:pos + chunk])},
+                timeout=max(0.05, deadline - time.monotonic()))
+
+    tasks = [asyncio.ensure_future(send(pos)) for pos in offsets]
+    try:
+        await asyncio.gather(*tasks)
+    except BaseException:
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        raise
+    return len(offsets)
+
+
 class ClientPool:
     """Cache of RpcClients keyed by address."""
 

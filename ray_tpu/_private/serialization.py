@@ -160,6 +160,31 @@ def write_packed(dest: memoryview, meta: bytes,
     return pos
 
 
+def payload_nbytes(obj: Any) -> int:
+    """Cheap size estimate for control-plane payload caps: exact for the
+    bulk carriers (bytes-likes, numpy/jax arrays — the things users
+    mistakenly push through the KV), 0 for small structured values whose
+    serialized size is not worth computing. Containers sum recursively so
+    a list/dict/tuple of arrays is still caught."""
+    if isinstance(obj, memoryview):
+        return obj.nbytes  # len() is the first-dimension element count
+    if isinstance(obj, (bytes, bytearray)):
+        return len(obj)
+    if isinstance(obj, str):
+        return len(obj)  # ≈ utf-8 bytes for the ascii bulk cases
+    nbytes = getattr(obj, "nbytes", None)
+    if nbytes is not None:
+        try:
+            return int(nbytes)
+        except (TypeError, ValueError):
+            return 0
+    if isinstance(obj, (list, tuple)):
+        return sum(payload_nbytes(v) for v in obj)
+    if isinstance(obj, dict):
+        return sum(payload_nbytes(v) for v in obj.values())
+    return 0
+
+
 def dumps(obj: Any) -> bytes:
     """Plain in-band pickle (for RPC messages, not object payloads)."""
     return cloudpickle.dumps(obj, protocol=PICKLE_PROTOCOL)

@@ -715,6 +715,13 @@ def main() -> None:
 
     core.server.register("profile", profile)
 
+    # p2p collective transport (util/collective/ring.py): register the
+    # chunked-frame handler before this worker's address is published
+    # anywhere, so no ring segment can ever arrive unroutable
+    from ray_tpu.util.collective import ring as _collective_ring
+
+    _collective_ring.ensure_registered(core)
+
     # make the worker-side public API work inside tasks
     from ray_tpu._private import api
 

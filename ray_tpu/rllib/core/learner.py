@@ -96,15 +96,18 @@ class Learner:
 
         from ray_tpu.util import collective
 
+        # bucketed coalesced allreduce: same-dtype leaves pack into
+        # bounded buckets (one collective round each) instead of one
+        # monolithic np.concatenate copy of the whole gradient tree per
+        # step — and on the p2p data plane each bucket streams chunked,
+        # so no full-tree staging copy exists anywhere
         flat, tree = jax.tree.flatten(grads)
-        sizes = [int(np.prod(f.shape)) for f in flat]
-        vec = np.concatenate([np.asarray(f).ravel() for f in flat])
-        summed = collective.allreduce(vec, group_name="learners")
-        mean = summed / self._world
-        outs, off = [], 0
-        for f, sz in zip(flat, sizes):
-            outs.append(jnp.asarray(mean[off:off + sz]).reshape(f.shape))
-            off += sz
+        arrs = [np.asarray(f) for f in flat]
+        summed = collective.allreduce_coalesced(arrs, group_name="learners")
+        outs = [
+            jnp.asarray(s / self._world).reshape(f.shape)
+            for f, s in zip(flat, summed)
+        ]
         return jax.tree.unflatten(tree, outs)
 
     # --------------------------------------------------------------- state

@@ -45,6 +45,10 @@ CHAOS_METHODS = ",".join([
     # so they must be listed or the in-process write/read/ack timing is
     # never perturbed
     "channel.write", "channel.read", "channel.ack",
+    # p2p collectives: ring segments stream as idempotent offset-keyed
+    # chunk frames (drop/dup/retry must converge to exact sums), and the
+    # controller rendezvous rides the kv_wait long-poll
+    "collective_chunk", "kv_wait",
 ])
 
 
@@ -262,7 +266,147 @@ def run_chaos_workload(
         chaos.reset()
 
 
+def run_collective_chaos(
+    seed: int,
+    *,
+    drop_prob: float = 0.02,
+    dup_prob: float = 0.05,
+    delay_prob: float = 0.05,
+    delay_max_ms: int = 20,
+    kills: bool = True,
+) -> None:
+    """One seeded chaos run against the p2p collective data plane.
+
+    Builds a 2-node cluster, rings 4 ranks across both nodes with a small
+    chunk size (every segment streams as many attacked ``collective_chunk``
+    frames), and drives repeated allreduces whose sums must stay EXACT
+    under drop/dup/delay — a dropped frame may cost a retry, never a wrong
+    reduction. With ``kills``, a participant is then hard-killed mid-group:
+    the survivors' next collective must surface a clean TimeoutError /
+    peer-dead / channel-closed error (and the shm variant's channel pins
+    reclaim through the supervisor's dead-client path), never a hang or a
+    silently wrong sum.
+    """
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private import chaos
+    from ray_tpu._private.chaos import FaultController
+    from ray_tpu._private.config import Config
+    from ray_tpu.cluster_utils import Cluster
+
+    cfg = Config.from_env()
+    cfg.chaos_seed = seed
+    cfg.chaos_drop_prob = drop_prob
+    cfg.chaos_dup_prob = dup_prob
+    cfg.chaos_delay_prob = delay_prob
+    cfg.chaos_delay_max_ms = delay_max_ms
+    cfg.chaos_methods = CHAOS_METHODS
+    # ~12 frames per ring segment at this size: plenty of attack surface
+    cfg.collective_chunk_bytes = 128 * 1024
+
+    cluster = Cluster(config=cfg)
+    try:
+        cluster.add_node(num_cpus=4, resources={"left": 100})
+        cluster.add_node(num_cpus=4, resources={"right": 100})
+        cluster.wait_for_nodes(2)
+        ray_tpu.init(address=cluster.address)
+        chaos.set_fault_controller(FaultController(
+            seed=seed, drop_prob=drop_prob, dup_prob=dup_prob,
+            delay_prob=delay_prob, delay_max_ms=delay_max_ms,
+            methods=CHAOS_METHODS))
+
+        @ray_tpu.remote
+        class Rank:
+            def init_group(self, world, rank, name, algo=None):
+                from ray_tpu.util import collective as col
+
+                col.init_collective_group(world, rank, backend="host",
+                                          group_name=name, algo=algo)
+                return rank
+
+            def algo(self, name):
+                from ray_tpu.util.collective.collective import _manager
+
+                return _manager.get(name).algo
+
+            def allreduce_checked(self, n, fill, name, timeout_ms=60000):
+                from ray_tpu.util import collective as col
+
+                out = col.allreduce(np.full(n, float(fill), np.float64),
+                                    group_name=name, timeout_ms=timeout_ms)
+                return float(out[0]), float(out[-1])
+
+        ranks = [
+            Rank.options(
+                resources={("left" if i % 2 == 0 else "right"): 1}).remote()
+            for i in range(4)
+        ]
+        ray_tpu.get([r.init_group.remote(4, i, "soak")
+                     for i, r in enumerate(ranks)], timeout=120)
+        ray_tpu.get([r.allreduce_checked.remote(10, 1.0, "soak")
+                     for r in ranks], timeout=120)  # rendezvous + warm
+        # auto must have picked the ring (a silent shm/kv fallback would
+        # attack none of the p2p RPCs and pass vacuously)
+        assert ray_tpu.get(ranks[0].algo.remote("soak"),
+                           timeout=60) == "ring", \
+            "cross-node group did not resolve to the ring data plane"
+        for step in range(4):
+            # ~1.2 MB/rank -> chunked ring segments under the schedule
+            outs = ray_tpu.get(
+                [r.allreduce_checked.remote(150_000, step + i + 1, "soak")
+                 for i, r in enumerate(ranks)], timeout=180)
+            want = float(sum(step + i + 1 for i in range(4)))
+            for first, last in outs:
+                assert first == want and last == want, (
+                    f"ring allreduce corrupted under chaos: got "
+                    f"({first}, {last}), want {want}")
+
+        if kills:
+            # participant kill mid-group: survivors must fail CLEAN
+            victims = [
+                Rank.options(
+                    resources={("left" if i % 2 == 0 else "right"): 1}
+                ).remote()
+                for i in range(3)
+            ]
+            ray_tpu.get([r.init_group.remote(3, i, "doomed")
+                         for i, r in enumerate(victims)], timeout=120)
+            ray_tpu.get(
+                [r.allreduce_checked.remote(1000, 1.0, "doomed")
+                 for r in victims], timeout=120)
+            ray_tpu.kill(victims[2])
+            time.sleep(0.5)
+            refs = [r.allreduce_checked.remote(1000, 1.0, "doomed", 5000)
+                    for r in victims[:2]]
+            for ref in refs:
+                try:
+                    ray_tpu.get(ref, timeout=120)
+                    raise AssertionError(
+                        "collective with a dead participant returned a "
+                        "result instead of a clean error")
+                except AssertionError:
+                    raise
+                except Exception as e:  # noqa: BLE001 — the expected path
+                    msg = str(e).lower()
+                    assert ("timed out" in msg or "unreachable" in msg
+                            or "dead" in msg or "closed" in msg), (
+                        f"unclean error from dead-peer collective: {e!r}")
+    finally:
+        chaos.set_fault_controller(None)  # calm teardown
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        cluster.shutdown()
+        chaos.reset()
+
+
 def _run_one(seed: int, args) -> None:
+    if args.collective:
+        run_collective_chaos(
+            seed,
+            drop_prob=args.drop, dup_prob=args.dup, delay_prob=args.delay,
+            delay_max_ms=args.delay_max_ms, kills=not args.no_kills)
+        return
     run_chaos_workload(
         seed,
         drop_prob=args.drop, dup_prob=args.dup, delay_prob=args.delay,
@@ -283,6 +427,10 @@ def main() -> int:
     parser.add_argument("--delay-max-ms", type=int, default=20)
     parser.add_argument("--no-kills", action="store_true")
     parser.add_argument("--no-train", action="store_true")
+    parser.add_argument("--collective", action="store_true",
+                        help="attack the p2p collective data plane (ring "
+                             "chunk frames + participant kill) instead of "
+                             "the task/actor/training workload")
     args = parser.parse_args()
 
     if args.one is not None:
@@ -301,6 +449,8 @@ def main() -> int:
             child.append("--no-kills")
         if args.no_train:
             child.append("--no-train")
+        if args.collective:
+            child.append("--collective")
         proc = subprocess.run(child)
         took = time.monotonic() - t0
         if proc.returncode != 0:

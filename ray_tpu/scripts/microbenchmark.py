@@ -224,6 +224,71 @@ def run_all(budget_s: float = 2.0) -> List[Dict[str, float]]:
         compiled.teardown()
     for s in (s1, s2, s3):
         ray_tpu.kill(s)
+
+    # -- collectives: 4-rank host-backend allreduce. The p2p data plane
+    # (same-node: shared-memory channel rounds, zero steady-state control
+    # RPCs) against the legacy controller-KV rounds (every rank's full
+    # tensor through one control-plane socket). The acceptance bar is
+    # >= 5x on the 64 MiB probe.
+    @ray_tpu.remote
+    class _Rank:
+        def init_group(self, world, rank, name, algo):
+            from ray_tpu.util import collective as col
+
+            col.init_collective_group(world, rank, backend="host",
+                                      group_name=name, algo=algo)
+            return rank
+
+        def algo(self, name):
+            from ray_tpu.util.collective.collective import _manager
+
+            return _manager.get(name).algo
+
+        def allreduce_rounds(self, name, n_elems, rounds):
+            from ray_tpu.util import collective as col
+
+            arr = np.ones(n_elems, np.float64)
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                out = col.allreduce(arr, group_name=name, timeout_ms=120000)
+            dt = time.perf_counter() - t0
+            assert out[0] == 4.0, "allreduce produced a wrong sum"
+            return dt
+
+    def bench_allreduce(algo, name, n_elems, rounds, warmup):
+        ranks = [_Rank.remote() for _ in range(4)]
+        ray_tpu.get([r.init_group.remote(4, i, name, algo)
+                     for i, r in enumerate(ranks)])
+        if warmup:
+            ray_tpu.get([r.allreduce_rounds.remote(name, n_elems, warmup)
+                         for r in ranks], timeout=300)
+        times = ray_tpu.get(
+            [r.allreduce_rounds.remote(name, n_elems, rounds)
+             for r in ranks], timeout=600)
+        resolved = ray_tpu.get(ranks[0].algo.remote(name))
+        for r in ranks:
+            ray_tpu.kill(r)
+        # slowest rank bounds the collective's wall clock
+        return max(times) / rounds, resolved
+
+    small_s, resolved = bench_allreduce("auto", "bench_small", 8192, 30, 3)
+    # a setup fallback would silently benchmark the wrong data plane
+    assert resolved in ("shm", "ring"), (
+        f"collective probe fell back to {resolved!r}")
+    record("collective_allreduce_4rank_small", 1.0 / small_s)
+
+    big_elems = 8 * 1024 * 1024  # 64 MiB float64 per rank
+    big_s, resolved = bench_allreduce("auto", "bench_64mib", big_elems, 3, 1)
+    assert resolved in ("shm", "ring"), (
+        f"collective probe fell back to {resolved!r}")
+    results.append({"benchmark": "collective_allreduce_4rank_64MiB",
+                    "value": round(big_elems * 8 / big_s / 1024**3, 3),
+                    "unit": "GiB/s"})
+
+    kv_s, _ = bench_allreduce("kv", "bench_64mib_kv", big_elems, 1, 0)
+    results.append({"benchmark": "collective_speedup",
+                    "value": round(kv_s / max(big_s, 1e-9), 1),
+                    "unit": "x"})
     return results
 
 

@@ -1,6 +1,7 @@
 from ray_tpu.util.collective.collective import (  # noqa: F401
     allgather,
     allreduce,
+    allreduce_coalesced,
     barrier,
     broadcast,
     create_collective_group,
@@ -15,4 +16,8 @@ from ray_tpu.util.collective.collective import (  # noqa: F401
     send,
     synchronize,
 )
-from ray_tpu.util.collective.types import Backend, ReduceOp  # noqa: F401
+from ray_tpu.util.collective.types import (  # noqa: F401
+    Backend,
+    CollectiveError,
+    ReduceOp,
+)

@@ -1,0 +1,29 @@
+"""Shared instrumentation for the host-backend collective data paths.
+
+One definition site for the counters every algorithm (shm / ring / kv)
+reports into — keeping the shm and kv paths free of any dependency on
+the ring transport module.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from ray_tpu._private.metrics import Counter, Histogram
+
+ops_total = Counter(
+    "ray_tpu_collective_ops_total",
+    "Collective operations completed in this process, by algo/backend")
+bytes_total = Counter(
+    "ray_tpu_collective_bytes_total",
+    "Collective payload bytes moved by this process, by algo/backend")
+chunks_total = Counter(
+    "ray_tpu_collective_chunks_total",
+    "Collective transfer frames/chunk rounds issued, by algo/backend")
+round_seconds = Histogram(
+    "ray_tpu_collective_round_seconds",
+    "Wall-clock seconds per collective call, by algo")
+
+
+def labels(algo: str) -> Dict[str, str]:
+    return {"algo": algo, "backend": "host"}

@@ -10,12 +10,15 @@ for TPU:
     device-to-device NCCL calls, ranks join one `jax.distributed` runtime and
     every collective is a jitted XLA program over a one-axis device mesh, so
     the bytes ride ICI/DCN exactly as GSPMD would move them.
-  * backend "host" ≈ the reference's Gloo group — a controller-KV rendezvous
-    over the control plane. Works between any processes with no device
-    requirements; sized for control-plane payloads (weight broadcast at init,
-    metrics reduction), not the tensor hot path. The tensor hot path in this
-    framework is mesh-sharded jit (see ray_tpu.parallel), which needs no
-    explicit collective calls at all.
+  * backend "host" ≈ the reference's Gloo group — the controller is used for
+    **group rendezvous only**; tensor bytes move peer-to-peer. Same-node
+    groups reduce through pin-backed shared-memory channels
+    (`collective/shm.py` — a steady-state allreduce issues ZERO control-plane
+    RPCs), cross-node groups run ring reduce-scatter + allgather over direct
+    worker↔worker chunked RPCs (`collective/ring.py` — O(N) per link instead
+    of O(N·world) through one controller socket, tensors larger than the RPC
+    MAX_FRAME stream as bounded-window frames). The legacy controller-KV
+    rounds survive as the explicit ``algo="kv"`` baseline.
 
 Both imperative (`init_collective_group` inside each worker) and declarative
 (`create_collective_group` from the driver over actor handles) setup are
@@ -32,7 +35,8 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ray_tpu.util.collective.types import Backend, ReduceOp
+from ray_tpu.util.collective.types import (Backend, CollectiveError,
+                                           ReduceOp)
 
 logger = logging.getLogger(__name__)
 
@@ -43,6 +47,17 @@ def _kv():
     from ray_tpu._private import internal_kv
 
     return internal_kv
+
+
+def _sweep_group_keys(group_name: str) -> None:
+    """Best-effort delete of every wire key under ``{group_name}:`` (group
+    teardown; the controller may already be gone at shutdown)."""
+    kv = _kv()
+    try:
+        for k in kv.kv_keys(group_name + ":", ns=_KV_NS):
+            kv.kv_del(k, ns=_KV_NS)
+    except Exception:
+        pass
 
 
 def _node_ip() -> str:
@@ -83,10 +98,87 @@ class BaseGroup:
         self.world_size = world_size
         self.rank = rank
         self.group_name = group_name
+        self._public_name = group_name
         self._decl_gen = None  # set when created from declarative KV metadata
 
     def destroy(self) -> None:
         pass
+
+    def allreduce_coalesced(
+        self,
+        tensors: Sequence[Any],
+        op: ReduceOp,
+        timeout_ms: int,
+        bucket_bytes: Optional[int] = None,
+    ) -> List[np.ndarray]:
+        """Allreduce a LIST of tensors in same-dtype buckets: adjacent
+        tensors pack into one flat vector per bucket (bounded by
+        ``collective_coalesce_bytes``), so a gradient tree costs one
+        collective round per bucket — not one per leaf, and not one
+        monolithic ``np.concatenate`` copy of the whole tree either.
+        Returns reduced arrays with the input shapes, in input order."""
+        arrs = [np.asarray(t) for t in tensors]
+        if not arrs:
+            return []
+        if bucket_bytes is None:
+            try:
+                from ray_tpu._private.api import _require_core
+
+                bucket_bytes = _require_core().config.collective_coalesce_bytes
+            except Exception:
+                bucket_bytes = 32 * 1024**2
+        results: List[Optional[np.ndarray]] = [None] * len(arrs)
+        bucket: List[int] = []
+        bucket_sz = 0
+
+        def flush() -> None:
+            if not bucket:
+                return
+            if len(bucket) == 1:
+                i = bucket[0]
+                results[i] = np.asarray(
+                    self.allreduce(arrs[i], op, timeout_ms))
+            else:
+                dtype = arrs[bucket[0]].dtype
+                total = sum(arrs[i].size for i in bucket)
+                vec = np.empty(total, dtype)
+                off = 0
+                for i in bucket:
+                    vec[off:off + arrs[i].size] = arrs[i].reshape(-1)
+                    off += arrs[i].size
+                red = np.asarray(self.allreduce(vec, op, timeout_ms))
+                off = 0
+                for i in bucket:
+                    results[i] = red[off:off + arrs[i].size].reshape(
+                        arrs[i].shape)
+                    off += arrs[i].size
+            bucket.clear()
+
+        for i, a in enumerate(arrs):
+            if bucket and (a.dtype != arrs[bucket[0]].dtype
+                           or bucket_sz + a.nbytes > bucket_bytes):
+                flush()
+                bucket_sz = 0
+            bucket.append(i)
+            bucket_sz += a.nbytes
+        flush()
+        return results  # type: ignore[return-value]
+
+    def _raise_if_stale(self) -> None:
+        """After a timeout/peer failure on a declaratively-created group,
+        distinguish 'the driver destroyed/re-created this group' from a
+        plain peer problem. This runs ONLY on the failure path — the
+        steady state never re-validates membership, so a healthy
+        collective issues no control-plane RPCs for it."""
+        if self._decl_gen is None:
+            return
+        meta = _kv().kv_get(f"decl:{self._public_name}", ns=_KV_NS)
+        if meta is None or meta["gen"] != self._decl_gen:
+            _manager.destroy(self._public_name)
+            raise RuntimeError(
+                f"collective group {self._public_name!r} was destroyed or "
+                f"re-created by the driver while this rank was using it; "
+                f"retry the collective to join the new generation")
 
 
 def _reduce_fn(op: ReduceOp):
@@ -99,22 +191,70 @@ def _reduce_fn(op: ReduceOp):
     }[op]
 
 
-class HostGroup(BaseGroup):
-    """Control-plane collectives over the controller KV (gloo analog).
+class _SoloGroup:
+    """world_size == 1: every collective is the identity, locally."""
+
+    algo = "solo"
+
+    def allreduce(self, arr, op, timeout_ms):
+        return np.array(arr, copy=True)
+
+    def reduce(self, arr, op, root_rank, timeout_ms):
+        return np.array(arr, copy=True)
+
+    def broadcast(self, arr, root_rank, timeout_ms):
+        return np.asarray(arr)
+
+    def allgather(self, arr, timeout_ms):
+        return [np.asarray(arr)]
+
+    def reducescatter(self, arr, op, timeout_ms):
+        return np.array_split(np.asarray(arr), 1, axis=0)[0]
+
+    def barrier(self, timeout_ms):
+        pass
+
+    def send(self, arr, dst_rank, timeout_ms):
+        raise RuntimeError("send/recv needs world_size > 1")
+
+    def recv(self, src_rank, timeout_ms):
+        raise RuntimeError("send/recv needs world_size > 1")
+
+    def destroy(self):
+        pass
+
+
+class KvGroup:
+    """Legacy control-plane collectives over the controller KV.
+
+    Kept as the explicit ``algo="kv"`` baseline (the `collective_speedup`
+    microbench probe compares the p2p data plane against it) and as the
+    fallback when no peer data plane is possible. Every rank's full
+    tensor transits the controller — O(N·world) through one socket; the
+    payload cap (`RAY_TPU_KV_MAX_VALUE_BYTES`) bounds the damage.
 
     Protocol: every collective call gets a per-group sequence number (all
     ranks call collectives in the same order — the standard requirement).
-    Ranks post contributions under ``{group}:{seq}:c:{rank}``; rank 0 reduces
-    and posts ``{group}:{seq}:r``; ranks poll for the result. Rank 0 deletes
-    the previous call's result right before posting the next one — safe,
-    because holding every contribution of call N implies every rank has read
-    the result of call N-1.
+    Ranks post contributions under ``{group}:{seq}:c:{rank}``; rank 0
+    reduces and posts ``{group}:{seq}:r``; ranks poll for the result.
+    Rank 0 deletes the previous call's result right before posting the
+    next one — safe, because holding every contribution of call N implies
+    every rank has read the result of call N-1. The FINAL round's result
+    key is reaped by a deferred sweep (one timer per group) once the
+    call's timeout window has passed, so a long-lived idle group leaks
+    nothing even without ``destroy()``.
     """
 
+    algo = "kv"
+
     def __init__(self, world_size: int, rank: int, group_name: str):
-        super().__init__(world_size, rank, group_name)
+        self.world_size = world_size
+        self.rank = rank
+        self.group_name = group_name
         self._seq = 0
         self._p2p_seq: Dict[tuple, int] = {}
+        self._sweeper: Optional[threading.Timer] = None
+        self._destroyed = False
 
     # ----- kv plumbing
 
@@ -140,6 +280,26 @@ class HostGroup(BaseGroup):
             time.sleep(pause)
             pause = min(pause * 1.5, 0.05)
 
+    def _schedule_sweep(self, seq: int, timeout_ms: int) -> None:
+        """Rank 0 only: reap ``{seq}:r`` after the call's timeout window
+        if no newer round superseded it (at that point every other rank
+        has either read the result or timed out — deleting is safe)."""
+        if self._sweeper is not None:
+            self._sweeper.cancel()
+
+        def sweep() -> None:
+            if self._destroyed or self._seq != seq + 1:
+                return
+            try:
+                _kv().kv_del(self._key(seq, "r"), ns=_KV_NS)
+            except Exception:
+                pass  # controller may be gone at shutdown
+
+        t = threading.Timer(max(1.0, timeout_ms / 1000.0), sweep)
+        t.daemon = True
+        t.start()
+        self._sweeper = t
+
     def _round(self, payload, combine, timeout_ms: int):
         """One gather-to-root + broadcast round; returns the combined result."""
         kv = _kv()
@@ -156,6 +316,7 @@ class HostGroup(BaseGroup):
             if seq > 0:
                 kv.kv_del(self._key(seq - 1, "r"), ns=_KV_NS)
             kv.kv_put(self._key(seq, "r"), result, ns=_KV_NS)
+            self._schedule_sweep(seq, timeout_ms)
             return result
         kv.kv_put(self._key(seq, "c", self.rank), payload, ns=_KV_NS)
         return self._poll(self._key(seq, "r"), timeout_ms)
@@ -163,10 +324,17 @@ class HostGroup(BaseGroup):
     # ----- ops
 
     def allreduce(self, arr: np.ndarray, op: ReduceOp, timeout_ms: int) -> np.ndarray:
+        from ray_tpu.util.collective import _metrics
+
         fn = _reduce_fn(op)
-        return self._round(
-            np.asarray(arr), lambda parts: fn(np.stack(parts)), timeout_ms
-        )
+        with _metrics.round_seconds.time(labels={"algo": self.algo}):
+            out = self._round(
+                np.asarray(arr), lambda parts: fn(np.stack(parts)), timeout_ms
+            )
+        _metrics.ops_total.inc(labels=_metrics.labels(self.algo))
+        _metrics.bytes_total.inc(np.asarray(arr).nbytes,
+                                 labels=_metrics.labels(self.algo))
+        return out
 
     def reduce(self, arr, op: ReduceOp, root_rank: int, timeout_ms: int):
         out = self.allreduce(arr, op, timeout_ms)
@@ -210,12 +378,217 @@ class HostGroup(BaseGroup):
         )
 
     def destroy(self) -> None:
-        kv = _kv()
+        self._destroyed = True
+        if self._sweeper is not None:
+            self._sweeper.cancel()
+        _sweep_group_keys(self.group_name)
+
+
+class HostGroup(BaseGroup):
+    """Host-backend facade: one controller-KV rendezvous, then a
+    peer-to-peer data plane.
+
+    The data-path algorithm resolves lazily on the first collective call
+    (by then every rank has initialized, so the rendezvous completes):
+
+      * ``shm``  — every rank on one node: pin-backed shared-memory
+        channel rounds, zero steady-state control-plane RPCs;
+      * ``ring`` — ranks span nodes: ring reduce-scatter + allgather over
+        chunked direct worker↔worker RPCs;
+      * ``kv``   — the legacy controller-KV rounds (explicit opt-in /
+        comparison baseline);
+      * ``auto`` (default) — shm when possible, else ring.
+
+    Force one via ``RAY_TPU_COLLECTIVE_ALGO`` or the ``algo=`` argument
+    of ``init_collective_group``.
+    """
+
+    def __init__(self, world_size: int, rank: int, group_name: str,
+                 *, algo: Optional[str] = None):
+        super().__init__(world_size, rank, group_name)
+        self._algo_override = algo
+        self._impl = None
+        self._impl_lock = threading.Lock()
+        self._poisoned: Optional[str] = None
+        # publish this rank's rendezvous record EAGERLY (best-effort): a
+        # peer's send/recv must be able to reach a rank that initialized
+        # the group but has not yet issued a collective of its own —
+        # otherwise pairwise p2p would hang waiting on bystander ranks
+        if world_size > 1 and (algo or "").lower() != "kv":
+            try:
+                self._publish_rendezvous()
+            except Exception:
+                logger.debug("eager collective rendezvous publish failed "
+                             "(will retry on first use)", exc_info=True)
+
+    def _publish_rendezvous(self) -> dict:
+        from ray_tpu._private.api import _require_core
+        from ray_tpu.util.collective import ring as _ring_mod
+
+        core = _require_core()
+        # handlers register BEFORE the address goes public, so no peer
+        # frame can ever arrive unroutable
+        _ring_mod.ensure_registered(core)
+        me = {"addr": list(core.address), "node": core.node_id_hex,
+              "client": core._store_client_id}
+        if self.rank == 0:
+            # Rank 0 mints a per-incarnation token that every rank folds
+            # into the transport wire name: a destroy + re-init of the SAME
+            # imperative group name gets fresh inbox keys and fresh shm
+            # channel-spec KV keys, so a chaos-delayed duplicate frame (or
+            # a stale channel record) from the previous incarnation can
+            # never be mistaken for this one's data. (Declarative groups
+            # already get this from their gen-suffixed wire name.)
+            if not hasattr(self, "_rv_token"):
+                self._rv_token = os.urandom(8).hex()
+            me["token"] = self._rv_token
+        _kv().kv_put(f"{self.group_name}:rv:{self.rank}", me, ns=_KV_NS)
+        return me
+
+    @property
+    def algo(self) -> str:
+        """Resolved data-path algorithm ('' until the first collective)."""
+        return self._impl.algo if self._impl is not None else ""
+
+    def _impl_for(self, timeout_ms: int):
+        if self._impl is not None:
+            return self._impl
+        with self._impl_lock:
+            if self._impl is None:
+                self._impl = self._resolve_impl(timeout_ms)
+        return self._impl
+
+    def _resolve_impl(self, timeout_ms: int):
+        from ray_tpu._private.api import _require_core
+        from ray_tpu.util.collective import ring as _ring_mod
+        from ray_tpu.util.collective import shm as _shm_mod
+
+        if self.world_size == 1:
+            return _SoloGroup()
+        core = _require_core()
+        algo = (self._algo_override or core.config.collective_algo
+                or "auto").lower()
+        if algo == "kv":
+            return KvGroup(self.world_size, self.rank, self.group_name)
+        if algo not in ("auto", "shm", "ring"):
+            raise ValueError(
+                f"unknown collective algo {algo!r}; use auto/shm/ring/kv")
+        # rendezvous: (re-)publish this rank's worker RPC address + node
+        # identity; the controller carries these few hundred bytes and
+        # never a tensor.
+        me = self._publish_rendezvous()
+        deadline = time.monotonic() + max(1.0, timeout_ms / 1000.0)
+        peers: Dict[int, dict] = {self.rank: me}
+        for p in range(self.world_size):
+            if p == self.rank:
+                continue
+            peers[p] = _kv().kv_wait(
+                f"{self.group_name}:rv:{p}",
+                timeout=max(0.1, deadline - time.monotonic()),
+                ns=_KV_NS)
+        same_node = bool(core.node_id_hex) and all(
+            peers[p]["node"] == core.node_id_hex for p in peers)
+        # rank 0's incarnation token keys the data plane (see
+        # _publish_rendezvous); every rank read the same rv:0 record, so
+        # every rank derives the same wire name
+        wire = f"{self.group_name}#{peers[0].get('token', '')}"
+        if algo == "auto":
+            from ray_tpu._private.channels import MAX_READERS
+
+            shm_ok = (same_node and core.arena is not None
+                      and self.world_size - 1 <= MAX_READERS)
+            algo = "shm" if shm_ok else "ring"
+        if algo == "shm":
+            if not same_node:
+                raise CollectiveError(
+                    f"collective group {self.group_name!r}: algo 'shm' "
+                    f"forced but ranks span nodes — use 'ring' or 'auto'")
+            if core.arena is None:
+                raise CollectiveError(
+                    f"collective group {self.group_name!r}: algo 'shm' "
+                    f"forced but this process has no node arena mapping — "
+                    f"use 'ring' or 'auto'")
+            # no silent ring fallback on a setup failure: the algo choice
+            # above is a pure function of the rendezvous records, so every
+            # rank picks the same one — a per-rank fallback would leave
+            # this rank ringing while its peers sit on channels (mutual
+            # timeout at best, and the failure deserves to be loud anyway)
+            return _shm_mod.ShmGroup(
+                core, self.world_size, self.rank, wire, peers, timeout_ms)
+        return _ring_mod.RingGroup(
+            core, self.world_size, self.rank, wire, peers)
+
+    # ----- delegated ops (stale-generation check on the failure path)
+
+    def _delegate(self, timeout_ms: int, fn):
+        if self._poisoned is not None:
+            # staleness first: if the driver already destroyed and
+            # re-created this declarative group (the documented remedy for
+            # a poisoned group), _raise_if_stale drops the cached member so
+            # the next call joins the new generation instead of raising
+            # 'poisoned' forever
+            self._raise_if_stale()
+            raise CollectiveError(
+                f"collective group {self._public_name!r} is poisoned by an "
+                f"earlier failure ({self._poisoned}); destroy and re-create "
+                f"the group")
+        impl = self._impl_for(timeout_ms)
         try:
-            for k in kv.kv_keys(self.group_name + ":", ns=_KV_NS):
-                kv.kv_del(k, ns=_KV_NS)
-        except Exception:  # controller may already be gone at shutdown
-            pass
+            return fn(impl)
+        except (TimeoutError, CollectiveError) as e:
+            # A mid-collective failure can leave per-pair sequence counters
+            # (ring inbox) or seqlock versions (shm channels) out of step
+            # with what peers actually committed; a RETRIED collective could
+            # then consume a stale round as fresh data. Poison the group so
+            # every later call fails clean — never a silently wrong sum.
+            self._poisoned = f"{type(e).__name__}: {e}"
+            self._raise_if_stale()
+            raise
+        except Exception as e:  # noqa: BLE001 — e.g. a shape ValueError
+            # ANY exception escaping mid-op may have advanced transport
+            # state already (segments sent, versions bumped) — same poison,
+            # same reason
+            self._poisoned = f"{type(e).__name__}: {e}"
+            raise
+
+    def allreduce(self, arr, op: ReduceOp, timeout_ms: int) -> np.ndarray:
+        return self._delegate(
+            timeout_ms, lambda g: g.allreduce(arr, op, timeout_ms))
+
+    def reduce(self, arr, op: ReduceOp, root_rank: int, timeout_ms: int):
+        return self._delegate(
+            timeout_ms, lambda g: g.reduce(arr, op, root_rank, timeout_ms))
+
+    def broadcast(self, arr, root_rank: int, timeout_ms: int):
+        return self._delegate(
+            timeout_ms, lambda g: g.broadcast(arr, root_rank, timeout_ms))
+
+    def allgather(self, arr, timeout_ms: int) -> List[np.ndarray]:
+        return self._delegate(
+            timeout_ms, lambda g: g.allgather(arr, timeout_ms))
+
+    def reducescatter(self, arr, op: ReduceOp, timeout_ms: int) -> np.ndarray:
+        return self._delegate(
+            timeout_ms, lambda g: g.reducescatter(arr, op, timeout_ms))
+
+    def barrier(self, timeout_ms: int) -> None:
+        self._delegate(timeout_ms, lambda g: g.barrier(timeout_ms))
+
+    def send(self, arr, dst_rank: int, timeout_ms: int) -> None:
+        self._delegate(
+            timeout_ms, lambda g: g.send(arr, dst_rank, timeout_ms))
+
+    def recv(self, src_rank: int, timeout_ms: int) -> np.ndarray:
+        return self._delegate(
+            timeout_ms, lambda g: g.recv(src_rank, timeout_ms))
+
+    def destroy(self) -> None:
+        if self._impl is not None:
+            try:
+                self._impl.destroy()
+            except Exception:
+                logger.debug("collective impl destroy failed", exc_info=True)
+        _sweep_group_keys(self.group_name)
 
 
 class XlaGroup(BaseGroup):
@@ -292,7 +665,7 @@ class XlaGroup(BaseGroup):
 
         self._mesh = Mesh(np.array(devs), ("ranks",))
         self._local_device = per_proc[jax.process_index()]
-        # KV side-channel for p2p
+        # p2p side-channel (host data plane)
         self._host = HostGroup(world_size, rank, group_name + ":p2p") if world_size > 1 else None
         # One jitted program per op kind, reused across calls (jax.jit caches
         # by function identity — fresh lambdas per call would recompile).
@@ -386,6 +759,7 @@ class GroupManager:
         name: str,
         *,
         public_name: Optional[str] = None,
+        algo: Optional[str] = None,
     ) -> BaseGroup:
         """`name` keys the wire protocol (KV keys); `public_name` (default:
         same) keys the local registry callers look groups up by."""
@@ -393,7 +767,12 @@ class GroupManager:
             key = public_name or name
             if key in self._groups:
                 raise RuntimeError(f"collective group {key!r} already initialized")
-            group = _BACKENDS[backend](world_size, rank, name)
+            if backend is Backend.HOST:
+                group: BaseGroup = HostGroup(world_size, rank, name,
+                                             algo=algo)
+            else:
+                group = _BACKENDS[backend](world_size, rank, name)
+            group._public_name = key
             self._groups[key] = group
             return group
 
@@ -414,15 +793,12 @@ _manager = GroupManager()
 def _resolve_group(group_name: str) -> BaseGroup:
     group = _manager.get(group_name)
     if group is not None:
-        if getattr(group, "_decl_gen", None) is not None:
-            # Declaratively-created: guard against the driver having destroyed
-            # and re-created a same-named group with different membership.
-            meta = _kv().kv_get(f"decl:{group_name}", ns=_KV_NS)
-            if meta is None or meta["gen"] != group._decl_gen:
-                _manager.destroy(group_name)
-                group = None
-        if group is not None:
-            return group
+        # Steady state: trust the cached group — no per-call KV round-trip
+        # (a stale declarative generation surfaces as a timeout whose
+        # failure path re-validates via BaseGroup._raise_if_stale; wire
+        # keys are generation-suffixed, so cross-generation traffic can
+        # never silently mix).
+        return group
     # Declarative path (≈ collective.py:151): the driver stored group metadata
     # in the controller KV keyed by group name; resolve our rank by actor id.
     meta = _kv().kv_get(f"decl:{group_name}", ns=_KV_NS)
@@ -460,10 +836,14 @@ def init_collective_group(
     rank: int,
     backend: str = "host",
     group_name: str = "default",
+    *,
+    algo: Optional[str] = None,
 ) -> None:
     """Imperative init, called inside each participating task/actor
-    (≈ collective.py:120)."""
-    _manager.create(Backend.parse(backend), world_size, rank, group_name)
+    (≈ collective.py:120). ``algo`` (host backend only) forces the data
+    path: auto/shm/ring/kv — default ``RAY_TPU_COLLECTIVE_ALGO``."""
+    _manager.create(Backend.parse(backend), world_size, rank, group_name,
+                    algo=algo)
 
 
 def create_collective_group(
@@ -532,6 +912,21 @@ def allreduce(
 ):
     """Allreduce across the group (returns the reduced array; ≈ collective.py:258)."""
     return _resolve_group(group_name).allreduce(tensor, op, timeout_ms)
+
+
+def allreduce_coalesced(
+    tensors: Sequence[Any],
+    group_name: str = "default",
+    op: ReduceOp = ReduceOp.SUM,
+    timeout_ms: int = DEFAULT_TIMEOUT_MS,
+    bucket_bytes: Optional[int] = None,
+) -> List[np.ndarray]:
+    """Allreduce a list of tensors in same-dtype buckets (one collective
+    round per bucket). The bucketed twin of torch's
+    ``allreduce_coalesced`` — what the RLlib learner uses for its
+    gradient tree instead of one monolithic concatenate."""
+    return _resolve_group(group_name).allreduce_coalesced(
+        tensors, op, timeout_ms, bucket_bytes)
 
 
 def reduce(

@@ -37,3 +37,23 @@ class ReduceOp(enum.Enum):
     MAX = "max"
     MIN = "min"
     MEAN = "mean"
+
+
+class CollectiveError(RuntimeError):
+    """A collective failed cleanly (peer death, membership change, oversize
+    payload) — never a silently wrong result."""
+
+
+def reduce_ufunc(op: ReduceOp):
+    """Elementwise pairwise accumulator for streaming reductions (ring
+    segments, shared-memory chunk rounds). MEAN accumulates with add;
+    callers divide by world_size once at the end."""
+    import numpy as np
+
+    return {
+        ReduceOp.SUM: np.add,
+        ReduceOp.MEAN: np.add,
+        ReduceOp.PRODUCT: np.multiply,
+        ReduceOp.MAX: np.maximum,
+        ReduceOp.MIN: np.minimum,
+    }[op]

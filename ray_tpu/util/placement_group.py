@@ -50,9 +50,16 @@ class PlacementGroup:
         ).remote()
 
     def wait(self, timeout: float = 30) -> bool:
+        """Block until placed. Long-polls the controller's PG-state KV key
+        via ``kv_wait`` (one parked RPC) instead of the old 50 ms
+        pg_get/sleep loop; pg_get re-checks around each wait slice so a
+        missing key (e.g. a controller restart) degrades to slower polls,
+        never to a wrong answer."""
+        from ray_tpu._private import internal_kv
+
         core = api._require_core()
         deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        while True:
             rec = core._run(
                 core.clients.get(core.controller_addr).call(
                     "pg_get", {"pg_id_hex": self.id.hex()}
@@ -62,8 +69,14 @@ class PlacementGroup:
                 return True
             if rec and rec["state"] == "REMOVED":
                 raise PlacementGroupError("placement group was removed")
-            time.sleep(0.05)
-        return False
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return False
+            try:
+                internal_kv.kv_wait(self.id.hex(),
+                                    timeout=min(remaining, 5.0), ns="pg")
+            except TimeoutError:
+                pass
 
     def __reduce__(self):
         return (PlacementGroup, (self.id, self.bundle_specs))

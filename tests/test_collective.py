@@ -1,6 +1,12 @@
 """Collective API tests (≈ reference python/ray/util/collective/tests/):
-imperative + declarative group setup across real actor processes, host
-backend; single-rank xla backend smoke."""
+imperative + declarative group setup across real actor processes; the
+host backend's three data paths (shared-memory channels, p2p chunked
+ring, legacy controller-KV), the zero-control-plane-RPC steady-state
+contract, straggler/peer-death semantics, the control-plane payload
+guards, and a cross-node ring on the multinode harness; single-rank xla
+backend smoke."""
+
+import time
 
 import numpy as np
 import pytest
@@ -14,17 +20,43 @@ class Worker:
     def __init__(self):
         self.rank = None
 
-    def init_group(self, world_size, rank, backend="host", name="default"):
+    def init_group(self, world_size, rank, backend="host", name="default",
+                   algo=None):
         from ray_tpu.util import collective as col
 
-        col.init_collective_group(world_size, rank, backend=backend, group_name=name)
+        col.init_collective_group(world_size, rank, backend=backend,
+                                  group_name=name, algo=algo)
         self.rank = rank
         return rank
 
-    def allreduce(self, value, name="default", op=ReduceOp.SUM):
+    def algo(self, name="default"):
+        from ray_tpu.util.collective.collective import _manager
+
+        return _manager.get(name).algo
+
+    def allreduce(self, value, name="default", op=ReduceOp.SUM,
+                  delay_s=0.0, timeout_ms=30000):
         from ray_tpu.util import collective as col
 
-        return col.allreduce(np.asarray(value, np.float32), group_name=name, op=op)
+        if delay_s:
+            time.sleep(delay_s)
+        return col.allreduce(np.asarray(value, np.float32), group_name=name,
+                             op=op, timeout_ms=timeout_ms)
+
+    def allreduce_big(self, n, fill, name="default", dtype="float64"):
+        """Reduce a large array; return (first, last, shape) — shipping
+        the full result back through the object store is not the point."""
+        from ray_tpu.util import collective as col
+
+        out = col.allreduce(np.full(n, float(fill), np.dtype(dtype)),
+                            group_name=name)
+        return float(out[0]), float(out[-1]), tuple(out.shape)
+
+    def allreduce_coalesced(self, values, name="default"):
+        from ray_tpu.util import collective as col
+
+        return col.allreduce_coalesced(
+            [np.asarray(v) for v in values], group_name=name)
 
     def broadcast(self, value, src, name="default"):
         from ray_tpu.util import collective as col
@@ -57,6 +89,31 @@ class Worker:
 
         return col.recv(src, group_name=name)
 
+    def steady_state_rpc_delta(self, name, steps):
+        """Outbound-RPC counter delta across ``steps`` allreduces (the
+        zero-control-plane proof, same counter the compiled-DAG suite
+        uses). Runs INSIDE one actor method so the task-completion report
+        itself is outside the window."""
+        import gc
+
+        from ray_tpu._private.rpc import _m_client_calls
+        from ray_tpu.util import collective as col
+
+        gc.collect()
+        time.sleep(0.3)  # let background traffic (unpin flushes) settle
+        before = _m_client_calls.total()
+        for i in range(steps):
+            out = col.allreduce(np.full(1000, float(i), np.float32),
+                                group_name=name)
+            assert out[0] == pytest.approx(4.0 * i)
+        return _m_client_calls.total() - before
+
+    def destroy(self, name="default"):
+        from ray_tpu.util import collective as col
+
+        col.destroy_collective_group(name)
+        return True
+
 
 @pytest.fixture(scope="module")
 def pair(ray_init):
@@ -64,6 +121,19 @@ def pair(ray_init):
     ray_tpu.get(
         [w.init_group.remote(2, i, "host", "pair") for i, w in enumerate(workers)]
     )
+    yield workers
+    for w in workers:
+        ray_tpu.kill(w)
+
+
+@pytest.fixture(scope="module")
+def quad(ray_init):
+    """world_size-4 same-node group — auto algo resolves to shm."""
+    workers = [Worker.remote() for _ in range(4)]
+    ray_tpu.get(
+        [w.init_group.remote(4, i, "host", "quad") for i, w in enumerate(workers)]
+    )
+    ray_tpu.get([w.allreduce.remote([0.0], "quad") for w in workers])  # warm
     yield workers
     for w in workers:
         ray_tpu.kill(w)
@@ -133,6 +203,331 @@ class TestHostBackend:
             )
             for o in out:
                 np.testing.assert_allclose(o, [2.0 * i])
+
+
+class TestShmWorld4:
+    """Same-node world-4 group over shared-memory channels."""
+
+    def test_resolves_to_shm(self, quad):
+        assert ray_tpu.get(quad[0].algo.remote("quad")) == "shm"
+
+    def test_allreduce(self, quad):
+        out = ray_tpu.get(
+            [w.allreduce.remote([float(i + 1)], "quad")
+             for i, w in enumerate(quad)]
+        )
+        for o in out:
+            np.testing.assert_allclose(o, [10.0])
+
+    def test_allreduce_mean(self, quad):
+        out = ray_tpu.get(
+            [w.allreduce.remote([float(i + 1)], "quad", ReduceOp.MEAN)
+             for i, w in enumerate(quad)]
+        )
+        for o in out:
+            np.testing.assert_allclose(o, [2.5])
+
+    def test_broadcast_world4(self, quad):
+        outs = ray_tpu.get(
+            [w.broadcast.remote([9.0 + i], 2, "quad")
+             for i, w in enumerate(quad)]
+        )
+        for o in outs:
+            np.testing.assert_allclose(o, [11.0])
+
+    def test_allgather_world4(self, quad):
+        outs = ray_tpu.get(
+            [w.allgather.remote([float(i), float(-i)], "quad")
+             for i, w in enumerate(quad)]
+        )
+        expected = [[i, -i] for i in range(4)]
+        for o in outs:
+            np.testing.assert_allclose(np.stack(o), expected)
+
+    def test_reducescatter_world4(self, quad):
+        base = [1.0, 2.0, 3.0, 4.0]
+        outs = ray_tpu.get(
+            [w.reducescatter.remote(base, "quad") for w in quad]
+        )
+        for i, o in enumerate(outs):
+            np.testing.assert_allclose(o, [4.0 * (i + 1)])
+
+    def test_multichunk_streams_through_channel(self, quad):
+        # 8 MB/rank > the 4 MiB channel capacity: streams as multiple
+        # seqlock rounds, memory bounded by the channel
+        outs = ray_tpu.get(
+            [w.allreduce_big.remote(1_000_000, i + 1, "quad")
+             for i, w in enumerate(quad)]
+        )
+        for first, last, shape in outs:
+            assert first == 10.0 and last == 10.0 and shape == (1_000_000,)
+
+    def test_allreduce_coalesced(self, quad):
+        vals = [np.ones(3, np.float32), np.full(2, 2.0, np.float64),
+                np.full((2, 2), 3.0, np.float32)]
+        outs = ray_tpu.get(
+            [w.allreduce_coalesced.remote([v.tolist() for v in vals], "quad")
+             for w in quad]
+        )
+        for o in outs:
+            np.testing.assert_allclose(o[0], [4.0] * 3)
+            np.testing.assert_allclose(o[1], [8.0] * 2)
+            np.testing.assert_allclose(o[2], np.full((2, 2), 12.0))
+
+    def test_straggler_rank(self, quad):
+        """One rank joins 1.5 s late; the others block in the channel
+        protocol (no spinning on the controller) and the sum is exact."""
+        refs = [w.allreduce.remote([float(i + 1)], "quad",
+                                   ReduceOp.SUM, 1.5 if i == 2 else 0.0)
+                for i, w in enumerate(quad)]
+        for o in ray_tpu.get(refs, timeout=60):
+            np.testing.assert_allclose(o, [10.0])
+
+    @pytest.mark.perf
+    def test_steady_state_allreduce_is_zero_control_rpcs(self, quad):
+        """THE tentpole contract: after the one-time rendezvous, a
+        same-node allreduce is seqlock rounds over the shared arena —
+        the outbound-RPC counter must not move in ANY rank across a
+        window of allreduces (counter-based, never wall-clock; same
+        proof shape as the compiled-DAG suite)."""
+        deltas = ray_tpu.get(
+            [w.steady_state_rpc_delta.remote("quad", 10) for w in quad]
+        )
+        assert deltas == [0.0, 0.0, 0.0, 0.0], (
+            f"steady-state shm allreduce issued control-plane RPCs: {deltas}")
+
+
+class TestRingForced:
+    """The cross-node algorithm, forced onto one node for hermetic runs."""
+
+    @pytest.fixture(scope="class")
+    def ring4(self, ray_init):
+        workers = [Worker.remote() for _ in range(4)]
+        ray_tpu.get(
+            [w.init_group.remote(4, i, "host", "ring4", "ring")
+             for i, w in enumerate(workers)]
+        )
+        ray_tpu.get([w.allreduce.remote([0.0], "ring4") for w in workers])
+        yield workers
+        for w in workers:
+            ray_tpu.kill(w)
+
+    def test_resolves_to_ring(self, ring4):
+        assert ray_tpu.get(ring4[0].algo.remote("ring4")) == "ring"
+
+    def test_allreduce(self, ring4):
+        out = ray_tpu.get(
+            [w.allreduce.remote([float(i + 1), 10.0 * (i + 1)], "ring4")
+             for i, w in enumerate(ring4)]
+        )
+        for o in out:
+            np.testing.assert_allclose(o, [10.0, 100.0])
+
+    def test_allreduce_min(self, ring4):
+        out = ray_tpu.get(
+            [w.allreduce.remote([float(i + 1)], "ring4", ReduceOp.MIN)
+             for i, w in enumerate(ring4)]
+        )
+        for o in out:
+            np.testing.assert_allclose(o, [1.0])
+
+    def test_broadcast(self, ring4):
+        outs = ray_tpu.get(
+            [w.broadcast.remote([5.0 + i], 3, "ring4")
+             for i, w in enumerate(ring4)]
+        )
+        for o in outs:
+            np.testing.assert_allclose(o, [8.0])
+
+    def test_reducescatter(self, ring4):
+        base = [1.0, 2.0, 3.0, 4.0]
+        outs = ray_tpu.get(
+            [w.reducescatter.remote(base, "ring4") for w in ring4]
+        )
+        for i, o in enumerate(outs):
+            np.testing.assert_allclose(o, [4.0 * (i + 1)])
+
+    def test_uneven_split_allreduce(self, ring4):
+        # 7 elements over 4 ranks: ragged ring segments (sizes 2,2,2,1)
+        out = ray_tpu.get(
+            [w.allreduce.remote([float(i)] * 7, "ring4")
+             for i, w in enumerate(ring4)]
+        )
+        for o in out:
+            np.testing.assert_allclose(o, [6.0] * 7)
+
+    def test_peer_death_surfaces_clean_error(self, ray_init):
+        """Killing a rank mid-group must surface TimeoutError /
+        peer-unreachable at the surviving ranks — never a wrong sum."""
+        workers = [Worker.remote() for _ in range(3)]
+        ray_tpu.get(
+            [w.init_group.remote(3, i, "host", "ring_dead", "ring")
+             for i, w in enumerate(workers)]
+        )
+        ray_tpu.get([w.allreduce.remote([1.0], "ring_dead")
+                     for w in workers])
+        ray_tpu.kill(workers[2])
+        time.sleep(0.5)
+        refs = [w.allreduce.remote([1.0], "ring_dead", ReduceOp.SUM, 0.0,
+                                   4000)
+                for w in workers[:2]]
+        for ref in refs:
+            with pytest.raises(Exception) as ei:
+                ray_tpu.get(ref, timeout=60)
+            msg = str(ei.value).lower()
+            assert ("timed out" in msg or "unreachable" in msg
+                    or "dead" in msg), msg
+        # the failed collective may have left per-pair sequence counters
+        # out of step with what peers delivered: the group must be
+        # POISONED — a retry fails fast and clean, it can never fold a
+        # stale round into a fresh-looking result
+        with pytest.raises(Exception) as ei:
+            ray_tpu.get(
+                workers[0].allreduce.remote([1.0], "ring_dead",
+                                            ReduceOp.SUM, 0.0, 4000),
+                timeout=60)
+        assert "poisoned" in str(ei.value).lower()
+        for w in workers[:2]:
+            ray_tpu.kill(w)
+
+
+class TestP2PWithoutBystanders:
+    def test_send_recv_without_bystander_collectives(self, ray_init):
+        """Pairwise send/recv between two ranks of a world-3 group must
+        complete even though rank 2 never issues any collective: the
+        rendezvous publishes eagerly at init, and the shm channel stage
+        builds lazily on the first COLLECTIVE, not on p2p."""
+        workers = [Worker.remote() for _ in range(3)]
+        ray_tpu.get(
+            [w.init_group.remote(3, i, "host", "p2ponly")
+             for i, w in enumerate(workers)]
+        )
+        r = workers[1].recv.remote(0, "p2ponly")
+        ray_tpu.get(workers[0].send.remote([9.25], 1, "p2ponly"))
+        np.testing.assert_allclose(ray_tpu.get(r, timeout=30), [9.25])
+        for w in workers:
+            ray_tpu.kill(w)
+
+
+class TestShmPeerDeath:
+    def test_participant_kill_closes_channels(self, ray_init):
+        """A dead shm participant closes every group channel through the
+        supervisor's dead-client path: survivors raise (channel closed /
+        timeout), pins are reclaimed — never a hang or a wrong sum."""
+        workers = [Worker.remote() for _ in range(2)]
+        ray_tpu.get(
+            [w.init_group.remote(2, i, "host", "shm_dead")
+             for i, w in enumerate(workers)]
+        )
+        ray_tpu.get([w.allreduce.remote([1.0], "shm_dead")
+                     for w in workers])
+        assert ray_tpu.get(workers[0].algo.remote("shm_dead")) == "shm"
+        ray_tpu.kill(workers[1])
+        time.sleep(1.0)
+        with pytest.raises(Exception) as ei:
+            ray_tpu.get(
+                workers[0].allreduce.remote([1.0], "shm_dead",
+                                            ReduceOp.SUM, 0.0, 5000),
+                timeout=60)
+        msg = str(ei.value).lower()
+        assert ("closed" in msg or "timed out" in msg or "died" in msg), msg
+        ray_tpu.kill(workers[0])
+
+
+class TestKvBaseline:
+    """The legacy controller-KV rounds, kept as an explicit algo."""
+
+    def test_forced_kv_allreduce(self, ray_init):
+        workers = [Worker.remote() for _ in range(2)]
+        ray_tpu.get(
+            [w.init_group.remote(2, i, "host", "kvgrp", "kv")
+             for i, w in enumerate(workers)]
+        )
+        out = ray_tpu.get(
+            [w.allreduce.remote([2.0], "kvgrp") for w in workers])
+        for o in out:
+            np.testing.assert_allclose(o, [4.0])
+        assert ray_tpu.get(workers[0].algo.remote("kvgrp")) == "kv"
+        for w in workers:
+            ray_tpu.kill(w)
+
+    def test_final_result_key_swept(self, ray_init):
+        """The final round's result key must not linger until destroy():
+        rank 0's deferred sweep reaps it after the call's timeout
+        window (the old code leaked one key per long-lived group)."""
+        from ray_tpu._private import internal_kv
+
+        workers = [Worker.remote() for _ in range(2)]
+        ray_tpu.get(
+            [w.init_group.remote(2, i, "host", "kvsweep", "kv")
+             for i, w in enumerate(workers)]
+        )
+        ray_tpu.get([w.allreduce.remote([1.0], "kvsweep", ReduceOp.SUM,
+                                        0.0, 2000)
+                     for w in workers])
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            leftover = [k for k in internal_kv.kv_keys("kvsweep:",
+                                                       ns="collective")
+                        if ":r" in k or ":c" in k]
+            if not leftover:
+                break
+            time.sleep(0.25)
+        assert not leftover, f"result keys leaked: {leftover}"
+        for w in workers:
+            ray_tpu.kill(w)
+
+
+class TestControlPlaneGuards:
+    def test_payload_nbytes_estimates(self):
+        from ray_tpu._private.serialization import payload_nbytes
+
+        assert payload_nbytes(b"x" * 10) == 10
+        arr = np.zeros(1000, np.float64)
+        assert payload_nbytes(arr) == 8000
+        # memoryview len() is the first-dim ELEMENT count; the cap must
+        # see bytes or a float64 view sails under it 8x too light
+        assert payload_nbytes(memoryview(arr)) == 8000
+        assert payload_nbytes({"a": [arr, b"xy"]}) == 8002
+        assert payload_nbytes(42) == 0
+
+    def test_kv_put_payload_cap(self, ray_init):
+        from ray_tpu._private import internal_kv
+
+        big = np.zeros(20_000_000, np.float64)  # 160 MB > 64 MiB cap
+        with pytest.raises(ValueError) as ei:
+            internal_kv.kv_put("too-big", big, ns="captest")
+        assert "collective" in str(ei.value)
+        assert "RAY_TPU_KV_MAX_VALUE_BYTES" in str(ei.value)
+        # controller-side enforcement too (bypass the client check)
+        from ray_tpu._private import api as _api
+        from ray_tpu._private.rpc import RemoteError
+
+        core = _api._require_core()
+        with pytest.raises(RemoteError):
+            core._run(core.clients.get(core.controller_addr).call(
+                "kv_put", {"ns": "captest", "key": "too-big2",
+                           "value": b"x" * (80 * 1024 * 1024)}))
+
+    def test_kv_wait_long_poll(self, ray_init):
+        import threading
+
+        from ray_tpu._private import internal_kv
+
+        internal_kv.kv_put("now", 7, ns="waittest")
+        assert internal_kv.kv_wait("now", timeout=5, ns="waittest") == 7
+
+        def late_put():
+            time.sleep(0.4)
+            internal_kv.kv_put("late", 11, ns="waittest")
+
+        threading.Thread(target=late_put, daemon=True).start()
+        t0 = time.monotonic()
+        assert internal_kv.kv_wait("late", timeout=10, ns="waittest") == 11
+        assert time.monotonic() - t0 < 5  # long-poll, not timeout-poll
+
+        with pytest.raises(TimeoutError):
+            internal_kv.kv_wait("never", timeout=0.5, ns="waittest")
 
 
 class TestDeclarative:

@@ -1,0 +1,77 @@
+"""Cross-node collective tests over real supervisor processes (the
+multinode harness): the p2p ring data plane with chunked, bounded-window
+frames — including the >MAX_FRAME shape the controller-KV path could
+never carry."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from tests.test_collective import Worker
+
+
+class TestCrossNodeRing:
+    def test_ring_across_nodes_chunked(self, ray_cluster):
+        """4 ranks over 2 real supervisor processes; tiny chunk size so
+        every ring segment streams as many frames (the >MAX_FRAME shape
+        at test scale)."""
+        from ray_tpu._private.config import Config
+
+        cfg = Config.from_env()
+        cfg.collective_chunk_bytes = 64 * 1024
+        ray_cluster.config = cfg  # supervisors (and their workers) inherit
+        ray_cluster.add_node(num_cpus=4, resources={"nodeA": 10})
+        ray_cluster.add_node(num_cpus=4, resources={"nodeB": 10})
+        ray_cluster.wait_for_nodes(2)
+        ray_tpu.init(address=ray_cluster.address)
+        workers = [
+            Worker.options(
+                resources={("nodeA" if i % 2 == 0 else "nodeB"): 1}).remote()
+            for i in range(4)
+        ]
+        ray_tpu.get(
+            [w.init_group.remote(4, i, "host", "xnode")
+             for i, w in enumerate(workers)]
+        )
+        # ~1.6 MB/rank -> ~7 frames per ring segment at 64 KiB chunks
+        outs = ray_tpu.get(
+            [w.allreduce_big.remote(200_000, i + 1, "xnode")
+             for i, w in enumerate(workers)], timeout=120)
+        for first, last, shape in outs:
+            assert first == 10.0 and last == 10.0 and shape == (200_000,)
+        assert ray_tpu.get(workers[0].algo.remote("xnode")) == "ring"
+        bouts = ray_tpu.get(
+            [w.broadcast.remote([3.0 + i], 1, "xnode")
+             for i, w in enumerate(workers)], timeout=60)
+        for o in bouts:
+            np.testing.assert_allclose(o, [4.0])
+        for w in workers:
+            ray_tpu.kill(w)
+
+    @pytest.mark.slow
+    def test_gt_max_frame_allreduce(self, ray_cluster):
+        """A tensor LARGER than the RPC MAX_FRAME (512 MiB) must complete
+        cross-node — impossible through the old controller-KV path (one
+        pickled frame) and through any single-frame transport."""
+        from ray_tpu._private.rpc import MAX_FRAME
+
+        ray_cluster.add_node(num_cpus=2, resources={"nodeA": 10})
+        ray_cluster.add_node(num_cpus=2, resources={"nodeB": 10})
+        ray_cluster.wait_for_nodes(2)
+        ray_tpu.init(address=ray_cluster.address)
+        n = MAX_FRAME // 4 + 8_000_000  # float32 elems: ~544 MiB > MAX_FRAME
+        workers = [
+            Worker.options(resources={node: 1}).remote()
+            for node in ("nodeA", "nodeB")
+        ]
+        ray_tpu.get(
+            [w.init_group.remote(2, i, "host", "huge")
+             for i, w in enumerate(workers)]
+        )
+        outs = ray_tpu.get(
+            [w.allreduce_big.remote(n, i + 1, "huge", "float32")
+             for i, w in enumerate(workers)], timeout=600)
+        for first, last, shape in outs:
+            assert first == 3.0 and last == 3.0 and shape == (n,)
+        for w in workers:
+            ray_tpu.kill(w)
