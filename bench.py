@@ -25,6 +25,7 @@ import time
 TPU_ATTEMPTS = int(os.environ.get("RAY_TPU_BENCH_ATTEMPTS", "2"))
 TPU_TIMEOUT_S = float(os.environ.get("RAY_TPU_BENCH_TIMEOUT_S", "900"))
 CPU_TIMEOUT_S = float(os.environ.get("RAY_TPU_BENCH_CPU_TIMEOUT_S", "600"))
+PROBE_ATTEMPTS = int(os.environ.get("RAY_TPU_BENCH_PROBE_ATTEMPTS", "3"))
 
 PEAK_FLOPS = {
     # bf16 peak per chip
@@ -193,28 +194,47 @@ def child_main() -> None:
                   file=sys.stderr)
 
 
+def acquire_tpu(log) -> tuple:
+    """Robust TPU acquisition (the r03/r05 flaky-blind fix): up to
+    ``PROBE_ATTEMPTS`` probe rounds with exponential backoff, and a
+    stale-arena/daemon sweep before EVERY attempt — not just the first.
+    A leaked worker holding the single-client TPU tunnel is often freed
+    by the sweep, but a daemon that dies BETWEEN attempts (the r05 mode)
+    needs the re-sweep too. Returns ``(tpu_ok, attempts_used)``.
+    """
+    from ray_tpu._private.harness import preflight_sweep, tpu_probe
+
+    probe_s = float(os.environ.get("RAY_TPU_BENCH_PROBE_TIMEOUT_S", "180"))
+    backoff = 2.0
+    for attempt in range(PROBE_ATTEMPTS):
+        preflight_sweep(log)
+        if attempt:
+            log(f"tpu probe backoff {backoff:.0f}s before attempt "
+                f"{attempt + 1}/{PROBE_ATTEMPTS}")
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 30.0)
+        # first attempt gets the full budget (a cold tunnel can be slow);
+        # retries run shorter — a wedge that survived a sweep won't heal
+        if tpu_probe(probe_s if attempt == 0 else min(probe_s, 90.0), log):
+            return True, attempt + 1
+    return False, PROBE_ATTEMPTS
+
+
 def main() -> None:
     """Parent orchestrator: reap, run child with timeout, retry, fall back."""
     repo = os.path.dirname(os.path.abspath(__file__))
     sys.path.insert(0, repo)
     from ray_tpu._private.harness import (preflight_sweep, run_killable,
-                                          scrub_axon_cpu, tpu_probe)
+                                          scrub_axon_cpu)
 
     log = lambda m: print(f"bench: {m}", file=sys.stderr)  # noqa: E731
-    preflight_sweep(log)
 
     # fast gate: a wedged tunnel makes jax init BLOCK (not fail), so a
     # blind TPU attempt burns its full timeout; probe with a short
     # killable child and go straight to the CPU smoke when the backend
     # is unreachable — the record must exist even under a tight driver
-    # budget. One re-sweep + re-probe in between (a just-reaped daemon
-    # can free the tunnel).
-    probe_s = float(os.environ.get("RAY_TPU_BENCH_PROBE_TIMEOUT_S", "180"))
-    tpu_ok = tpu_probe(probe_s, log)
-    if not tpu_ok:
-        preflight_sweep(log)
-        time.sleep(2)
-        tpu_ok = tpu_probe(min(probe_s, 90.0), log)
+    # budget.
+    tpu_ok, probe_attempts = acquire_tpu(log)
 
     def attempt(env, timeout):
         rc, out, _err, timed_out = run_killable(
@@ -243,6 +263,7 @@ def main() -> None:
         return None
 
     line = None
+    cpu_fallback = False
     if tpu_ok:
         for i in range(TPU_ATTEMPTS):
             line = attempt(dict(os.environ), TPU_TIMEOUT_S)
@@ -255,9 +276,22 @@ def main() -> None:
         log("TPU backend unreachable (probe)")
     if not line:
         log("falling back to CPU smoke")
+        cpu_fallback = True
         line = attempt(scrub_axon_cpu(), CPU_TIMEOUT_S)
     if not line:
         sys.exit(1)
+    # stamp acquisition provenance into the record so downstream
+    # trajectory tooling can tell a CPU-smoke fallback (tpu_lost) from a
+    # real perf regression instead of comparing the two blindly
+    try:
+        rec = json.loads(line)
+        detail = rec.setdefault("detail", {})
+        detail["tpu_lost"] = bool(cpu_fallback or not tpu_ok)
+        detail["tpu_probe_ok"] = bool(tpu_ok)
+        detail["tpu_probe_attempts"] = probe_attempts
+        line = json.dumps(rec)
+    except Exception as e:  # provenance must never eat a valid record
+        log(f"detail stamping failed ({e!r}); emitting raw record")
     print(line)
 
 

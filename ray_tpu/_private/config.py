@@ -83,6 +83,15 @@ class Config:
     # allreduce_coalesced packs same-dtype tensors into buckets of at
     # most this many bytes (one collective round per bucket)
     collective_coalesce_bytes: int = 32 * 1024**2
+    # async overlapped collectives (allreduce_coalesced_async): the
+    # per-group runner pipelines device->host bucket transfers against
+    # shm/ring reduce rounds so communication hides behind compute; 0
+    # forces the synchronous coalesced fallback everywhere
+    collective_overlap: bool = True
+    # mover->reducer handoff depth: how many packed staging buckets may
+    # sit between the transfer stage and the reduce stage (bounds memory
+    # at depth x coalesce_bytes while keeping both stages busy)
+    collective_overlap_depth: int = 2
     # ---- compiled-graph channels (dag.experimental_compile) ----
     # payload capacity of each mutable channel; a compiled step whose
     # packed value exceeds it raises (override per-graph via

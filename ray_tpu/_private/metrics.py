@@ -161,6 +161,19 @@ class Histogram(Metric):
         (the collective round / RPC latency idiom)."""
         return _HistogramTimer(self, labels)
 
+    def count_total(self) -> int:
+        """Observations across every label set — the "did this span get
+        recorded at all" form counter-based tests need (e.g. proving
+        CollectiveWork.wait() instrumented its block)."""
+        with self._lock:
+            return int(sum(self._totals.values()))
+
+    def sum_total(self) -> float:
+        """Sum of observed values across every label set (overlap-fraction
+        arithmetic: wait_seconds.sum_total() / round_seconds.sum_total())."""
+        with self._lock:
+            return float(sum(self._sums.values()))
+
     def render(self) -> List[str]:
         out: List[str] = []
         with self._lock:

@@ -49,6 +49,10 @@ class Learner:
         self._rank = collective_rank
         self._world = collective_world
         self._jitted: Dict[Any, Callable] = {}
+        # overlapped grad-allreduce driver (persistent landing buffers,
+        # signature-keyed reallocation, copy-on-wait) — built lazily so
+        # it binds to the driver-declared "learners" group
+        self._grad_avg = None
 
     def setup_collective(self) -> bool:
         from ray_tpu.util import collective
@@ -91,24 +95,25 @@ class Learner:
         return out
 
     def _allreduce_grads(self, grads):
-        import jax
-        import jax.numpy as jnp
+        # Overlapped coalesced mean over the driver-declared "learners"
+        # group, via the shared GradientAverager (persistent landing
+        # buffers, signature-keyed reallocation, copy-on-wait): device
+        # leaves go to the group's runner AS-IS — it materializes one
+        # BUCKET at a time (one batched jax.device_get each, reverse-
+        # backward order, not the old serial per-leaf np.asarray loop)
+        # and pipelines each bucket's shm/ring rounds behind the next
+        # bucket's transfer. op="mean" pre-scales into the pack copy, so
+        # the old per-leaf `s / world` divide (one full gradient-tree
+        # copy per step) is gone on the sync fallback path too
+        # (RAY_TPU_COLLECTIVE_OVERLAP=0 completes the handle in place).
+        if self._grad_avg is None:
+            from ray_tpu.train._internal.gradients import GradientAverager
 
-        from ray_tpu.util import collective
-
-        # bucketed coalesced allreduce: same-dtype leaves pack into
-        # bounded buckets (one collective round each) instead of one
-        # monolithic np.concatenate copy of the whole gradient tree per
-        # step — and on the p2p data plane each bucket streams chunked,
-        # so no full-tree staging copy exists anywhere
-        flat, tree = jax.tree.flatten(grads)
-        arrs = [np.asarray(f) for f in flat]
-        summed = collective.allreduce_coalesced(arrs, group_name="learners")
-        outs = [
-            jnp.asarray(s / self._world).reshape(f.shape)
-            for f, s in zip(flat, summed)
-        ]
-        return jax.tree.unflatten(tree, outs)
+            self._grad_avg = GradientAverager(
+                group_name="learners", world_size=self._world,
+                rank=self._rank if self._rank is not None else 0,
+                init_group=False)
+        return self._grad_avg.average(grads)
 
     # --------------------------------------------------------------- state
 

@@ -400,7 +400,180 @@ def run_collective_chaos(
         chaos.reset()
 
 
+def run_collective_overlap_chaos(
+    seed: int,
+    *,
+    drop_prob: float = 0.02,
+    dup_prob: float = 0.05,
+    delay_prob: float = 0.05,
+    delay_max_ms: int = 20,
+    kills: bool = True,
+) -> None:
+    """One seeded chaos run against the ASYNC overlap collective path.
+
+    Same 2-node / 4-rank cross-node ring and fault schedule as
+    ``run_collective_chaos``, but every step goes through
+    ``allreduce_coalesced_async`` handles: two submissions in flight per
+    step, simulated compute between submit and wait, waits OUT OF ORDER
+    — sums must stay exact under drop/dup/delay. With ``kills``, a rank
+    dies with async work in flight: every pending handle at the
+    survivors must raise a clean error, the group must poison (a later
+    submit fails fast), and destroy must leave no pins behind — never a
+    hang or a silently wrong gradient.
+    """
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private import chaos
+    from ray_tpu._private.chaos import FaultController
+    from ray_tpu._private.config import Config
+    from ray_tpu.cluster_utils import Cluster
+
+    cfg = Config.from_env()
+    cfg.chaos_seed = seed
+    cfg.chaos_drop_prob = drop_prob
+    cfg.chaos_dup_prob = dup_prob
+    cfg.chaos_delay_prob = delay_prob
+    cfg.chaos_delay_max_ms = delay_max_ms
+    cfg.chaos_methods = CHAOS_METHODS
+    cfg.collective_chunk_bytes = 128 * 1024
+
+    cluster = Cluster(config=cfg)
+    try:
+        cluster.add_node(num_cpus=4, resources={"left": 100})
+        cluster.add_node(num_cpus=4, resources={"right": 100})
+        cluster.wait_for_nodes(2)
+        ray_tpu.init(address=cluster.address)
+        chaos.set_fault_controller(FaultController(
+            seed=seed, drop_prob=drop_prob, dup_prob=dup_prob,
+            delay_prob=delay_prob, delay_max_ms=delay_max_ms,
+            methods=CHAOS_METHODS))
+
+        @ray_tpu.remote
+        class Rank:
+            def init_group(self, world, rank, name, algo=None):
+                from ray_tpu.util import collective as col
+
+                col.init_collective_group(world, rank, backend="host",
+                                          group_name=name, algo=algo)
+                return rank
+
+            def algo(self, name):
+                from ray_tpu.util.collective.collective import _manager
+
+                return _manager.get(name).algo
+
+            def warm(self, name, timeout_ms=60000):
+                from ray_tpu.util import collective as col
+
+                out = col.allreduce(np.full(10, 1.0, np.float64),
+                                    group_name=name, timeout_ms=timeout_ms)
+                return float(out[0])
+
+            def overlapped_step(self, name, step, n, timeout_ms=120000):
+                """Two async submissions in flight, compute between,
+                waits out of order; returns firsts of each result."""
+                from ray_tpu.util import collective as col
+
+                a = [np.full(n, step + 1.0), np.full(n // 2, step + 2.0)]
+                b = [np.full(n // 4, step + 3.0)]
+                w1 = col.allreduce_coalesced_async(
+                    a, group_name=name, timeout_ms=timeout_ms, overlap=True)
+                w2 = col.allreduce_coalesced_async(
+                    b, group_name=name, timeout_ms=timeout_ms, overlap=True)
+                time.sleep(0.02)  # simulated device compute
+                r2 = w2.wait(timeout_ms)
+                r1 = w1.wait(timeout_ms)
+                assert w1.overlapped and w2.overlapped, \
+                    "chaos overlap step fell back to the sync path"
+                return (float(r1[0][0]), float(r1[1][0]), float(r2[0][0]))
+
+            def overlap_fail_probe(self, name, timeout_ms=5000):
+                from ray_tpu.util import collective as col
+
+                w1 = col.allreduce_coalesced_async(
+                    [np.ones(5000, np.float64)], group_name=name,
+                    timeout_ms=timeout_ms, overlap=True)
+                w2 = col.allreduce_coalesced_async(
+                    [np.ones(100, np.float64)], group_name=name,
+                    timeout_ms=timeout_ms, overlap=True)
+                errs = []
+                for w in (w2, w1):
+                    try:
+                        w.wait(timeout_ms * 5)
+                        errs.append("NO-ERROR")
+                    except Exception as e:  # noqa: BLE001 — expected
+                        errs.append(f"{type(e).__name__}: {e}")
+                try:
+                    col.allreduce_coalesced_async(
+                        [np.ones(10, np.float64)], group_name=name,
+                        overlap=True)
+                    poisoned = False
+                except Exception as e:  # noqa: BLE001
+                    poisoned = "poisoned" in str(e).lower()
+                col.destroy_collective_group(name)  # pins must unwind
+                return errs, poisoned
+
+        ranks = [
+            Rank.options(
+                resources={("left" if i % 2 == 0 else "right"): 1}).remote()
+            for i in range(4)
+        ]
+        ray_tpu.get([r.init_group.remote(4, i, "ovl_soak")
+                     for i, r in enumerate(ranks)], timeout=120)
+        ray_tpu.get([r.warm.remote("ovl_soak") for r in ranks], timeout=120)
+        assert ray_tpu.get(ranks[0].algo.remote("ovl_soak"),
+                           timeout=60) == "ring", \
+            "cross-node group did not resolve to the ring data plane"
+        for step in range(4):
+            outs = ray_tpu.get(
+                [r.overlapped_step.remote("ovl_soak", step, 60_000)
+                 for r in ranks], timeout=240)
+            for f1, f1b, f2 in outs:
+                assert f1 == 4 * (step + 1.0), (f1, step)
+                assert f1b == 4 * (step + 2.0), (f1b, step)
+                assert f2 == 4 * (step + 3.0), (f2, step)
+
+        if kills:
+            victims = [
+                Rank.options(
+                    resources={("left" if i % 2 == 0 else "right"): 1}
+                ).remote()
+                for i in range(3)
+            ]
+            ray_tpu.get([r.init_group.remote(3, i, "ovl_doomed")
+                         for i, r in enumerate(victims)], timeout=120)
+            ray_tpu.get([r.warm.remote("ovl_doomed") for r in victims],
+                        timeout=120)
+            ray_tpu.kill(victims[2])
+            time.sleep(0.5)
+            for probe in ray_tpu.get(
+                    [r.overlap_fail_probe.remote("ovl_doomed")
+                     for r in victims[:2]], timeout=240):
+                errs, poisoned = probe
+                for e in errs:
+                    low = e.lower()
+                    assert ("timed out" in low or "unreachable" in low
+                            or "dead" in low or "closed" in low
+                            or "destroyed" in low or "poisoned" in low), (
+                        f"unclean error from in-flight handle: {e!r}")
+                assert poisoned, \
+                    "submit after mid-flight failure did not fail fast"
+    finally:
+        chaos.set_fault_controller(None)  # calm teardown
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        cluster.shutdown()
+        chaos.reset()
+
+
 def _run_one(seed: int, args) -> None:
+    if args.collective_overlap:
+        run_collective_overlap_chaos(
+            seed,
+            drop_prob=args.drop, dup_prob=args.dup, delay_prob=args.delay,
+            delay_max_ms=args.delay_max_ms, kills=not args.no_kills)
+        return
     if args.collective:
         run_collective_chaos(
             seed,
@@ -431,6 +604,11 @@ def main() -> int:
                         help="attack the p2p collective data plane (ring "
                              "chunk frames + participant kill) instead of "
                              "the task/actor/training workload")
+    parser.add_argument("--collective-overlap", action="store_true",
+                        help="attack the ASYNC overlap collective path: "
+                             "in-flight allreduce_coalesced_async handles "
+                             "with out-of-order waits under drop/dup/delay "
+                             "+ a participant kill mid-flight")
     args = parser.parse_args()
 
     if args.one is not None:
@@ -451,6 +629,8 @@ def main() -> int:
             child.append("--no-train")
         if args.collective:
             child.append("--collective")
+        if args.collective_overlap:
+            child.append("--collective-overlap")
         proc = subprocess.run(child)
         took = time.monotonic() - t0
         if proc.returncode != 0:

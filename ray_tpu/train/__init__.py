@@ -19,6 +19,7 @@ from ray_tpu.train._internal.session import (  # noqa: F401
     get_dataset_shard,
     report,
 )
+from ray_tpu.train._internal.gradients import GradientAverager  # noqa: F401
 from ray_tpu.train.backend import Backend, BackendConfig, JaxConfig  # noqa: F401
 from ray_tpu.train.trainer import (  # noqa: F401
     BaseTrainer,

@@ -1,0 +1,141 @@
+"""Data-parallel gradient averaging for `ray_tpu.train` worker loops.
+
+The train analog of the RLlib learner's `_allreduce_grads`: a worker
+group's ranks average their gradient trees over the host-backend
+collective data plane (shm on one node, ring across nodes), riding the
+async overlap API so the host-side movement hides behind device compute:
+
+    from ray_tpu.train import GradientAverager
+
+    def train_loop_per_worker():
+        avg = GradientAverager()          # ranks/world from the session
+        for batch in loader:
+            grads = grad_fn(params, batch)         # device arrays
+            work = avg.begin(grads)                # returns immediately
+            aux = other_device_work()              # overlaps the reduce
+            grads = work.wait_tree()               # averaged tree
+            params = apply(params, grads)
+
+`average(grads)` is the one-call form (begin + wait). Buckets
+materialize device->host one batched transfer at a time in
+reverse-backward order, a MEAN is pre-scaled into the pack copy, and the
+averager keeps persistent landing buffers, so a steady-state step
+allocates nothing. ``RAY_TPU_COLLECTIVE_OVERLAP=0`` drops the whole
+path to the synchronous coalesced reduce without any call-site change.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, List, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class _TreeWork:
+    """Wraps a CollectiveWork so callers get the tree back, not leaves."""
+
+    def __init__(self, work, treedef, as_device: bool):
+        self._work = work
+        self._treedef = treedef
+        self._as_device = as_device
+
+    def done(self) -> bool:
+        return self._work.done()
+
+    def wait_tree(self, timeout_ms: Optional[int] = None):
+        import jax
+
+        leaves = self._work.wait(timeout_ms)
+        if self._as_device:
+            import jax.numpy as jnp
+
+            # copy=True: the averager's landing buffers are reused next
+            # step; an aliasing device_put would race the next reduce
+            leaves = [jnp.array(x) for x in leaves]
+        return jax.tree.unflatten(self._treedef, leaves)
+
+
+class GradientAverager:
+    """Per-worker handle on the training group's gradient collective.
+
+    ``world_size``/``rank`` default to the train session's world rank
+    (``ray_tpu.train.get_context()``), so a ``train_loop_per_worker``
+    needs no arguments; pass them explicitly to use the averager outside
+    a session (tests, custom actor pools). The group is initialized
+    imperatively and idempotently on first use — every rank constructs
+    its own averager, exactly like `jax.distributed` setup."""
+
+    def __init__(self, group_name: str = "train_grads",
+                 world_size: Optional[int] = None,
+                 rank: Optional[int] = None,
+                 timeout_ms: int = 60_000,
+                 init_group: bool = True):
+        """``init_group=False`` skips the imperative group init — for
+        callers whose group membership is already published some other
+        way (the RLlib learner rides its driver-declared "learners"
+        group, whose generation machinery an imperative init would
+        bypass)."""
+        if world_size is None or rank is None:
+            from ray_tpu.train._internal.session import get_session
+
+            sess = get_session()
+            if sess is None:
+                raise RuntimeError(
+                    "GradientAverager needs world_size/rank outside a "
+                    "training worker session")
+            world_size = sess.world_size if world_size is None else world_size
+            rank = sess.world_rank if rank is None else rank
+        self.group_name = group_name
+        self.world_size = world_size
+        self.rank = rank
+        self.timeout_ms = timeout_ms
+        self._out: Optional[List[np.ndarray]] = None
+        self._sig: Optional[List[Any]] = None
+        if world_size > 1 and init_group:
+            from ray_tpu.util import collective
+
+            if not collective.is_group_initialized(group_name):
+                collective.init_collective_group(
+                    world_size, rank, backend="host", group_name=group_name)
+
+    def begin(self, grads: Any) -> _TreeWork:
+        """Start the overlapped average of a gradient pytree; returns a
+        handle whose ``wait_tree()`` yields the averaged tree. Device
+        leaves are handed to the runner untouched — the device->host
+        transfers are part of what overlaps."""
+        import jax
+
+        from ray_tpu.util import collective
+        from ray_tpu.util.collective import ReduceOp
+        from ray_tpu.util.collective.async_work import _CompletedWork
+
+        flat, tree = jax.tree.flatten(grads)
+        if self.world_size <= 1:
+            return _TreeWork(
+                _CompletedWork(self.group_name,
+                               [np.asarray(f) for f in flat]),
+                tree, as_device=True)
+        # (shape, dtype) signature, not leaf count: a same-arity tree
+        # with one resized leaf must reallocate the landing buffers
+        sig = [(tuple(f.shape), np.dtype(f.dtype)) for f in flat]
+        if self._out is None or self._sig != sig:
+            self._out = [np.empty(s, d) for s, d in sig]
+            self._sig = sig
+        work = collective.allreduce_coalesced_async(
+            flat, group_name=self.group_name, op=ReduceOp.MEAN,
+            timeout_ms=self.timeout_ms, out=self._out)
+        return _TreeWork(work, tree, as_device=True)
+
+    def average(self, grads: Any) -> Any:
+        """Synchronous convenience: ``begin(grads).wait_tree()``."""
+        return self.begin(grads).wait_tree()
+
+    def shutdown(self) -> None:
+        """Destroy the group (fails any in-flight work cleanly)."""
+        if self.world_size > 1:
+            from ray_tpu.util import collective
+
+            collective.destroy_collective_group(self.group_name)

@@ -1,7 +1,9 @@
+from ray_tpu.util.collective.async_work import CollectiveWork  # noqa: F401
 from ray_tpu.util.collective.collective import (  # noqa: F401
     allgather,
     allreduce,
     allreduce_coalesced,
+    allreduce_coalesced_async,
     barrier,
     broadcast,
     create_collective_group,

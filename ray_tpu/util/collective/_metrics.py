@@ -9,7 +9,7 @@ from __future__ import annotations
 
 from typing import Dict
 
-from ray_tpu._private.metrics import Counter, Histogram
+from ray_tpu._private.metrics import Counter, Gauge, Histogram
 
 ops_total = Counter(
     "ray_tpu_collective_ops_total",
@@ -23,6 +23,23 @@ chunks_total = Counter(
 round_seconds = Histogram(
     "ray_tpu_collective_round_seconds",
     "Wall-clock seconds per collective call, by algo")
+# ---- async overlap (allreduce_coalesced_async / CollectiveWork) ----
+overlap_rounds_total = Counter(
+    "ray_tpu_collective_overlap_rounds_total",
+    "Bucket rounds reduced by the async overlap runner, by algo/backend "
+    "(zero means every coalesced call took the synchronous path)")
+wait_seconds = Histogram(
+    "ray_tpu_collective_wait_seconds",
+    "Wall-clock seconds callers block in CollectiveWork.wait() — compare "
+    "against ray_tpu_collective_round_seconds for the overlap fraction")
+staging_bytes = Gauge(
+    "ray_tpu_collective_staging_bytes",
+    "Bytes held in the overlap runner's persistent staging-buffer pool "
+    "(flat after warmup = steady state allocates nothing)")
+staging_allocs_total = Counter(
+    "ray_tpu_collective_staging_allocs_total",
+    "Staging buffer allocations by the overlap runner (stops moving once "
+    "the pool serves every bucket)")
 
 
 def labels(algo: str) -> Dict[str, str]:

@@ -1,0 +1,460 @@
+"""Async overlapped collectives: work handles + the per-group runner.
+
+The synchronous coalesced path (PR 4) serializes three stages that have
+no data dependency across buckets: materialize the gradients on the host
+(device->host copy), reduce them over the shm/ring transport, and hand
+the results back. This module pipelines them — the shape the
+concurrency-limits study (arXiv:2011.03641) and the MLPerf TPU-v3
+scaling report (arXiv:1909.09756) both identify as the remaining win
+once the device plane is fast:
+
+  * ``allreduce_coalesced_async(...) -> CollectiveWork`` returns
+    immediately; the caller's thread goes straight back to dispatching
+    device compute while the group's runner does the gradient movement.
+  * The runner is TWO persistent daemon threads per group. The *mover*
+    materializes one BUCKET at a time (one batched ``jax.device_get``
+    per bucket, never one per leaf) and packs it into a pooled staging
+    buffer; the *reducer* runs the transport rounds. A bounded handoff
+    queue between them means bucket i's ring reduce-scatter streams
+    while bucket i+1's gradients are still leaving the device.
+  * Buckets materialize in REVERSE flatten order: backprop produces the
+    last layers' gradients first, so the first bucket the reducer sees
+    is the one whose bytes are ready earliest.
+  * Staging buffers come from a persistent pool keyed by (dtype, size)
+    — a steady-state training step re-acquires the same buffers and
+    allocates nothing (``ray_tpu_collective_staging_bytes`` goes flat
+    after warmup), and a MEAN is pre-scaled into the pack copy so no
+    post-reduce divide pass exists anywhere.
+
+Failure semantics match the synchronous path exactly: ANY exception
+escaping a round poisons the group (a retried collective could otherwise
+consume a stale transport round as fresh data), the failing handle gets
+the real error, and every queued handle fails with a clean
+``CollectiveError`` — never a hang, never a silently wrong sum.
+``destroy()`` with work in flight fails all pending handles first, then
+tears down the transport (closing channels/inboxes, which also unblocks
+a reducer parked mid-round), so the group's pins unwind through the same
+paths the sync collectives use.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ray_tpu.util.collective import _metrics
+from ray_tpu.util.collective.types import (CollectiveError, ReduceOp,
+                                           prescale_factor)
+
+logger = logging.getLogger(__name__)
+
+_STOP = object()
+
+
+# ----------------------------------------------------------------- handles
+
+
+class CollectiveWork:
+    """Handle for one in-flight ``allreduce_coalesced_async`` call."""
+
+    def __init__(self, group_name: str):
+        self._group_name = group_name
+        self._event = threading.Event()
+        self._result: Optional[List[np.ndarray]] = None
+        self._exc: Optional[BaseException] = None
+
+    #: False only on handles returned by the synchronous fallback — lets
+    #: benchmarks assert the overlap path actually engaged.
+    overlapped = True
+
+    def done(self) -> bool:
+        """True once the result (or the failure) is available."""
+        return self._event.is_set()
+
+    def wait(self, timeout_ms: Optional[int] = None) -> List[np.ndarray]:
+        """Block for the reduced arrays (input order). Raises the round's
+        error if the work failed. The blocked span is recorded in
+        ``ray_tpu_collective_wait_seconds`` — against
+        ``round_seconds`` it gives the overlap fraction."""
+        t0 = time.perf_counter()
+        ok = self._event.wait(
+            None if timeout_ms is None else timeout_ms / 1000.0)
+        _metrics.wait_seconds.observe(time.perf_counter() - t0)
+        if not ok:
+            raise TimeoutError(
+                f"collective group {self._group_name!r}: async work not "
+                f"done within {timeout_ms} ms")
+        if self._exc is not None:
+            raise self._exc
+        return self._result  # type: ignore[return-value]
+
+    def exception(self) -> Optional[BaseException]:
+        """The failure, if the work is done and failed (None otherwise)."""
+        return self._exc if self._event.is_set() else None
+
+    # -- runner side (first finish/fail wins; late poison fan-out is a no-op)
+
+    def _finish(self, result: List[np.ndarray]) -> None:
+        if not self._event.is_set():
+            self._result = result
+            self._event.set()
+
+    def _fail(self, exc: BaseException) -> None:
+        if not self._event.is_set():
+            self._exc = exc
+            self._event.set()
+
+
+class _CompletedWork(CollectiveWork):
+    """Synchronous-fallback handle: already done at construction."""
+
+    overlapped = False
+
+    def __init__(self, group_name: str, result: List[np.ndarray]):
+        super().__init__(group_name)
+        self._finish(result)
+
+
+# ------------------------------------------------------------ staging pool
+
+
+class StagingPool:
+    """Persistent flat staging buffers keyed by (dtype, elements).
+
+    A training step's bucket layout is a pure function of its gradient
+    tree, so after one warmup step every ``acquire`` is a pool hit: the
+    allocs counter stops moving and the bytes gauge goes flat — the
+    zero-new-allocations proof the overlap acceptance bar asks for."""
+
+    def __init__(self):
+        self._free: Dict[Tuple[str, int], List[np.ndarray]] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def acquire(self, dtype: np.dtype, nelems: int) -> np.ndarray:
+        key = (np.dtype(dtype).str, int(nelems))
+        with self._lock:
+            bufs = self._free.get(key)
+            if bufs:
+                return bufs.pop()
+        buf = np.empty(nelems, np.dtype(dtype))
+        _metrics.staging_allocs_total.inc()
+        _metrics.staging_bytes.inc(buf.nbytes)
+        return buf
+
+    def release(self, buf: np.ndarray) -> None:
+        with self._lock:
+            if self._closed:
+                # a buffer in flight when drain() ran: drop it (nothing
+                # will ever acquire from a drained pool) and settle its
+                # share of the gauge so bytes return to baseline
+                _metrics.staging_bytes.dec(buf.nbytes)
+                return
+            self._free.setdefault((buf.dtype.str, buf.size), []).append(buf)
+
+    def drain(self) -> None:
+        """Drop every pooled buffer (group destroy); buffers still in
+        flight settle through ``release`` above."""
+        with self._lock:
+            self._closed = True
+            freed = sum(b.nbytes for bufs in self._free.values()
+                        for b in bufs)
+            self._free.clear()
+        if freed:
+            _metrics.staging_bytes.dec(freed)
+
+
+# ---------------------------------------------------------- bucket layout
+
+
+def bucket_layout(arrs: Sequence[Any], bucket_bytes: int) -> List[List[int]]:
+    """Greedy adjacent same-dtype buckets bounded by ``bucket_bytes`` —
+    the PR-4 coalescing rule, factored out so the sync path and the
+    async runner pack identically (works on device arrays too: only
+    ``.dtype`` / ``.size`` are touched, never the bytes)."""
+    buckets: List[List[int]] = []
+    cur: List[int] = []
+    cur_sz = 0
+    for i, a in enumerate(arrs):
+        dt = np.dtype(a.dtype)
+        nbytes = int(a.size) * dt.itemsize
+        if cur and (dt != np.dtype(arrs[cur[0]].dtype)
+                    or cur_sz + nbytes > bucket_bytes):
+            buckets.append(cur)
+            cur = []
+            cur_sz = 0
+        cur.append(i)
+        cur_sz += nbytes
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def validate_out(leaves: Sequence[Any], op: ReduceOp,
+                 out: Optional[Sequence[np.ndarray]],
+                 world_size: int) -> None:
+    """Fail bad ``out=`` combinations LOUDLY on the caller's thread —
+    inside the runner they would poison the whole group (and a shape
+    slip could silently land bytes in a detached reshape copy)."""
+    if out is None:
+        return
+    if len(out) != len(leaves):
+        raise ValueError(
+            f"out has {len(out)} arrays for {len(leaves)} tensors")
+    if op is ReduceOp.MEAN and any(
+            prescale_factor(op, a.dtype, world_size) is None
+            for a in leaves):  # per leaf — buckets split by dtype, so one
+        # integer leaf anywhere would widen ITS bucket and fail its copyto
+        raise ValueError(
+            "op='mean' over integer tensors widens to float — it cannot "
+            "land in integer out= buffers; drop out= or cast the inputs")
+    for i, (a, o) in enumerate(zip(leaves, out)):
+        if tuple(o.shape) != tuple(a.shape) or \
+                np.dtype(o.dtype) != np.dtype(a.dtype):
+            raise ValueError(
+                f"out[{i}] is {np.dtype(o.dtype)}{tuple(o.shape)} but "
+                f"tensor {i} is {np.dtype(a.dtype)}{tuple(a.shape)} — "
+                f"out= buffers must match the inputs exactly")
+
+
+def _materialize(leaves: List[Any]) -> List[np.ndarray]:
+    """One batched device->host transfer for a whole bucket (the per-leaf
+    ``np.asarray`` loop this replaces serialized one copy per tensor)."""
+    if all(isinstance(x, np.ndarray) for x in leaves):
+        return leaves  # host-side already; nothing to move
+    import jax
+
+    return [np.asarray(x) for x in jax.device_get(list(leaves))]
+
+
+# ----------------------------------------------------------------- runner
+
+
+class _Submission:
+    __slots__ = ("work", "leaves", "op", "timeout_ms", "bucket_bytes",
+                 "out", "results", "remaining")
+
+    def __init__(self, work: CollectiveWork, leaves: List[Any],
+                 op: ReduceOp, timeout_ms: int, bucket_bytes: int,
+                 out: Optional[Sequence[np.ndarray]]):
+        self.work = work
+        self.leaves = leaves
+        self.op = op
+        self.timeout_ms = timeout_ms
+        self.bucket_bytes = bucket_bytes
+        self.out = out
+        self.results: List[Optional[np.ndarray]] = [None] * len(leaves)
+        self.remaining = 0  # buckets still to reduce (set by the mover)
+
+
+class _BucketTask:
+    __slots__ = ("sub", "staging", "meta", "scale")
+
+    def __init__(self, sub: _Submission, staging: np.ndarray,
+                 meta: List[Tuple[int, tuple, int]], scale: Optional[float]):
+        self.sub = sub
+        self.staging = staging
+        self.meta = meta  # (leaf index, shape, elements) per packed leaf
+        self.scale = scale  # non-None: MEAN pre-scaled into the pack copy
+
+
+class AsyncRunner:
+    """Per-group two-stage pipeline executing async collective work.
+
+    Submissions run strictly in submission order and buckets within a
+    submission in reverse flatten order — deterministic, so every rank's
+    transport sees the identical op sequence (the standard collective
+    ordering requirement) as long as ranks submit in the same order,
+    exactly as they must for the sync API."""
+
+    def __init__(self, group):
+        self._group = group  # HostGroup
+        try:
+            from ray_tpu._private.api import _require_core
+
+            depth = max(1, int(
+                _require_core().config.collective_overlap_depth))
+        except Exception:
+            depth = 2
+        self.pool = StagingPool()
+        self._subq: "queue.Queue" = queue.Queue()
+        self._bucketq: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._lock = threading.Lock()
+        self._idle = threading.Condition(self._lock)
+        self._pending: List[_Submission] = []
+        self._dead: Optional[str] = None
+        name = group.group_name
+        self._mover = threading.Thread(
+            target=self._mover_loop, daemon=True, name=f"col-mover-{name}")
+        self._reducer = threading.Thread(
+            target=self._reducer_loop, daemon=True,
+            name=f"col-reduce-{name}")
+        self._mover.start()
+        self._reducer.start()
+
+    # ------------------------------------------------------------- public
+
+    def submit(self, tensors: Sequence[Any], op: ReduceOp, timeout_ms: int,
+               bucket_bytes: int,
+               out: Optional[Sequence[np.ndarray]]) -> CollectiveWork:
+        work = CollectiveWork(self._group._public_name)
+        if not len(tensors):
+            work._finish([])
+            return work
+        leaves = [t if hasattr(t, "dtype") and hasattr(t, "size")
+                  else np.asarray(t) for t in tensors]
+        validate_out(leaves, op, out, self._group.world_size)
+        sub = _Submission(work, leaves, op, timeout_ms, bucket_bytes, out)
+        with self._lock:
+            if self._dead is not None:
+                raise CollectiveError(
+                    f"collective group {self._group._public_name!r} is "
+                    f"poisoned by an earlier failure ({self._dead}); "
+                    f"destroy and re-create the group")
+            self._pending.append(sub)
+        self._subq.put(sub)
+        return work
+
+    def flush(self, timeout_s: float) -> None:
+        """Block until no async work is in flight (sync ops interleave
+        AFTER the queue drains, so the transport op order stays identical
+        on every rank). A poisoned runner returns immediately — the sync
+        caller then hits the group's poison check."""
+        deadline = time.monotonic() + timeout_s
+        with self._idle:
+            while self._pending and self._dead is None:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(
+                        f"collective group {self._group._public_name!r}: "
+                        f"sync collective blocked {timeout_s:.1f}s behind "
+                        f"unfinished async work")
+                self._idle.wait(min(left, 0.5))
+
+    def shutdown(self, reason: str = "group destroyed") -> None:
+        """Fail every unfinished handle NOW and stop the threads. The
+        caller destroys the transport right after — which is what
+        unblocks a reducer parked mid-round, so its error lands on an
+        already-failed handle (idempotent)."""
+        self._fail_pending(CollectiveError(
+            f"collective group {self._group._public_name!r}: {reason} "
+            f"with collective work in flight"), mark_dead=reason)
+        self._subq.put(_STOP)
+        self.pool.drain()
+
+    # ----------------------------------------------------------- internals
+
+    def _fail_pending(self, exc: BaseException, mark_dead: str) -> None:
+        with self._lock:
+            if self._dead is None:
+                self._dead = mark_dead
+            pending, self._pending = self._pending, []
+            self._idle.notify_all()
+        for sub in pending:
+            sub.work._fail(exc)
+
+    def _poison(self, exc: BaseException) -> None:
+        """A round failed: poison the GROUP (same invariant as the sync
+        ``_delegate`` path — transport state may be out of step with
+        peers) and fail every handle."""
+        detail = f"{type(exc).__name__}: {exc}"
+        self._group._poisoned = detail
+        self._fail_pending(
+            exc if isinstance(exc, (CollectiveError, TimeoutError))
+            else CollectiveError(detail),
+            mark_dead=detail)
+
+    def _finish_bucket(self, sub: _Submission) -> None:
+        sub.remaining -= 1
+        if sub.remaining == 0:
+            with self._lock:
+                if sub in self._pending:
+                    self._pending.remove(sub)
+                self._idle.notify_all()
+            sub.work._finish(sub.results)  # type: ignore[arg-type]
+
+    def _mover_loop(self) -> None:
+        while True:
+            sub = self._subq.get()
+            if sub is _STOP:
+                self._bucketq.put(_STOP)
+                return
+            if self._dead is not None:
+                continue  # already failed by poison/shutdown fan-out
+            try:
+                buckets = bucket_layout(sub.leaves, sub.bucket_bytes)
+                sub.remaining = len(buckets)
+                # reverse-backward: the LAST flattened leaves (deepest
+                # layers, first gradients out of backprop) feed the first
+                # reduce round, so the reducer never waits on bytes the
+                # device hasn't produced yet
+                for bucket in reversed(buckets):
+                    if self._dead is not None:
+                        break
+                    host = _materialize([sub.leaves[i] for i in bucket])
+                    dtype = host[0].dtype
+                    total = sum(a.size for a in host)
+                    scale = prescale_factor(
+                        sub.op, dtype, self._group.world_size)
+                    staging = self.pool.acquire(dtype, total)
+                    off = 0
+                    meta: List[Tuple[int, tuple, int]] = []
+                    for i, a in zip(bucket, host):
+                        flat = np.ascontiguousarray(a).reshape(-1)
+                        seg = staging[off:off + a.size]
+                        if scale is None:
+                            seg[...] = flat
+                        else:
+                            np.multiply(flat, scale, out=seg)
+                        meta.append((i, tuple(a.shape), int(a.size)))
+                        off += a.size
+                    self._bucketq.put(
+                        _BucketTask(sub, staging, meta, scale))
+            except BaseException as e:  # noqa: BLE001 — fail loud + clean
+                logger.debug("collective mover failed", exc_info=True)
+                self._poison(e)
+
+    def _reducer_loop(self) -> None:
+        while True:
+            task = self._bucketq.get()
+            if task is _STOP:
+                return
+            if self._dead is not None:
+                self.pool.release(task.staging)
+                continue  # drain mode: unblock the mover, drop the work
+            sub = task.sub
+            try:
+                impl = self._group._impl_for(sub.timeout_ms)
+                # MEAN was either pre-scaled into the pack (float dtypes)
+                # or falls back to SUM + one divide at unpack — the
+                # transport only ever runs an in-place SUM-family round
+                op = ReduceOp.SUM if sub.op is ReduceOp.MEAN else sub.op
+                red = np.asarray(impl.allreduce(
+                    task.staging, op, sub.timeout_ms, out=task.staging))
+                _metrics.overlap_rounds_total.inc(
+                    labels=_metrics.labels(impl.algo))
+                if sub.op is ReduceOp.MEAN and task.scale is None:
+                    red = red / self._group.world_size  # integer mean
+                off = 0
+                for i, shape, size in task.meta:
+                    seg = red[off:off + size]
+                    if sub.out is not None:
+                        # copyto(dst, view-of-seg): correct for ANY dst
+                        # layout — dst.reshape(-1) on a non-contiguous
+                        # array would write into a detached copy
+                        np.copyto(sub.out[i], seg.reshape(shape))
+                        sub.results[i] = sub.out[i]
+                    else:
+                        sub.results[i] = seg.reshape(shape).copy()
+                    off += size
+                self.pool.release(task.staging)
+                self._finish_bucket(sub)
+            except BaseException as e:  # noqa: BLE001 — fail loud + clean
+                logger.debug("collective reducer failed", exc_info=True)
+                self.pool.release(task.staging)
+                self._poison(e)

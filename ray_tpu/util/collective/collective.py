@@ -36,7 +36,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from ray_tpu.util.collective.types import (Backend, CollectiveError,
-                                           ReduceOp)
+                                           ReduceOp, check_inplace_out)
 
 logger = logging.getLogger(__name__)
 
@@ -88,6 +88,27 @@ def _node_ip() -> str:
     return "127.0.0.1"
 
 
+def _default_bucket_bytes() -> int:
+    try:
+        from ray_tpu._private.api import _require_core
+
+        return _require_core().config.collective_coalesce_bytes
+    except Exception:
+        return 32 * 1024**2
+
+
+def _overlap_enabled() -> bool:
+    """The RAY_TPU_COLLECTIVE_OVERLAP knob (config-backed; default on)."""
+    try:
+        from ray_tpu._private.api import _require_core
+
+        return bool(_require_core().config.collective_overlap)
+    except Exception:
+        from ray_tpu._private.config import global_config
+
+        return bool(global_config().collective_overlap)
+
+
 # --------------------------------------------------------------------- groups
 
 
@@ -110,59 +131,79 @@ class BaseGroup:
         op: ReduceOp,
         timeout_ms: int,
         bucket_bytes: Optional[int] = None,
+        out: Optional[Sequence[Any]] = None,
     ) -> List[np.ndarray]:
         """Allreduce a LIST of tensors in same-dtype buckets: adjacent
         tensors pack into one flat vector per bucket (bounded by
         ``collective_coalesce_bytes``), so a gradient tree costs one
         collective round per bucket — not one per leaf, and not one
         monolithic ``np.concatenate`` copy of the whole tree either.
-        Returns reduced arrays with the input shapes, in input order."""
+        The bucket reduces IN PLACE over its staging vector, a MEAN
+        pre-scales into the pack copy (no post-reduce divide pass), and
+        ``out=`` (persistent arrays, input shapes/dtypes) lands results
+        without allocating. Returns reduced arrays with the input
+        shapes, in input order."""
+        from ray_tpu.util.collective.async_work import (bucket_layout,
+                                                        validate_out)
+        from ray_tpu.util.collective.types import prescale_factor
+
         arrs = [np.asarray(t) for t in tensors]
         if not arrs:
             return []
+        validate_out(arrs, op, out, self.world_size)
         if bucket_bytes is None:
-            try:
-                from ray_tpu._private.api import _require_core
-
-                bucket_bytes = _require_core().config.collective_coalesce_bytes
-            except Exception:
-                bucket_bytes = 32 * 1024**2
+            bucket_bytes = _default_bucket_bytes()
         results: List[Optional[np.ndarray]] = [None] * len(arrs)
-        bucket: List[int] = []
-        bucket_sz = 0
-
-        def flush() -> None:
-            if not bucket:
-                return
-            if len(bucket) == 1:
-                i = bucket[0]
-                results[i] = np.asarray(
-                    self.allreduce(arrs[i], op, timeout_ms))
-            else:
-                dtype = arrs[bucket[0]].dtype
-                total = sum(arrs[i].size for i in bucket)
-                vec = np.empty(total, dtype)
-                off = 0
-                for i in bucket:
-                    vec[off:off + arrs[i].size] = arrs[i].reshape(-1)
-                    off += arrs[i].size
-                red = np.asarray(self.allreduce(vec, op, timeout_ms))
-                off = 0
-                for i in bucket:
-                    results[i] = red[off:off + arrs[i].size].reshape(
-                        arrs[i].shape)
-                    off += arrs[i].size
-            bucket.clear()
-
-        for i, a in enumerate(arrs):
-            if bucket and (a.dtype != arrs[bucket[0]].dtype
-                           or bucket_sz + a.nbytes > bucket_bytes):
-                flush()
-                bucket_sz = 0
-            bucket.append(i)
-            bucket_sz += a.nbytes
-        flush()
+        for bucket in bucket_layout(arrs, bucket_bytes):
+            dtype = arrs[bucket[0]].dtype
+            total = sum(arrs[i].size for i in bucket)
+            vec = np.empty(total, dtype)
+            scale = prescale_factor(op, dtype, self.world_size)
+            off = 0
+            for i in bucket:
+                flat = np.ascontiguousarray(arrs[i]).reshape(-1)
+                seg = vec[off:off + arrs[i].size]
+                if scale is None:
+                    seg[...] = flat
+                else:
+                    np.multiply(flat, scale, out=seg)
+                off += arrs[i].size
+            round_op = ReduceOp.SUM if op is ReduceOp.MEAN else op
+            red = np.asarray(
+                self.allreduce(vec, round_op, timeout_ms, out=vec))
+            if op is ReduceOp.MEAN and scale is None:
+                red = red / self.world_size  # integer mean fallback
+            off = 0
+            for i in bucket:
+                seg = red[off:off + arrs[i].size].reshape(arrs[i].shape)
+                if out is not None:
+                    np.copyto(out[i], seg)
+                    results[i] = out[i]
+                else:
+                    results[i] = seg
+                off += arrs[i].size
         return results  # type: ignore[return-value]
+
+    def allreduce_coalesced_async(
+        self,
+        tensors: Sequence[Any],
+        op: ReduceOp,
+        timeout_ms: int,
+        bucket_bytes: Optional[int] = None,
+        out: Optional[Sequence[Any]] = None,
+        overlap: Optional[bool] = None,
+    ):
+        """Async-handle form of :meth:`allreduce_coalesced`. The base
+        implementation (xla backend, and the explicit
+        ``RAY_TPU_COLLECTIVE_OVERLAP=0`` fallback on the host backend)
+        runs synchronously and returns an already-completed handle —
+        callers write one code path and the knob decides."""
+        from ray_tpu.util.collective.async_work import _CompletedWork
+
+        return _CompletedWork(
+            self._public_name,
+            self.allreduce_coalesced(tensors, op, timeout_ms, bucket_bytes,
+                                     out=out))
 
     def _raise_if_stale(self) -> None:
         """After a timeout/peer failure on a declaratively-created group,
@@ -196,7 +237,13 @@ class _SoloGroup:
 
     algo = "solo"
 
-    def allreduce(self, arr, op, timeout_ms):
+    def allreduce(self, arr, op, timeout_ms, out=None):
+        if out is not None:
+            src = np.asarray(arr)
+            check_inplace_out(out, src)
+            if out is not src:
+                np.copyto(out.reshape(src.shape), src)
+            return out
         return np.array(arr, copy=True)
 
     def reduce(self, arr, op, root_rank, timeout_ms):
@@ -323,18 +370,24 @@ class KvGroup:
 
     # ----- ops
 
-    def allreduce(self, arr: np.ndarray, op: ReduceOp, timeout_ms: int) -> np.ndarray:
+    def allreduce(self, arr: np.ndarray, op: ReduceOp, timeout_ms: int,
+                  out: Optional[np.ndarray] = None) -> np.ndarray:
         from ray_tpu.util.collective import _metrics
 
         fn = _reduce_fn(op)
         with _metrics.round_seconds.time(labels={"algo": self.algo}):
-            out = self._round(
+            red = self._round(
                 np.asarray(arr), lambda parts: fn(np.stack(parts)), timeout_ms
             )
         _metrics.ops_total.inc(labels=_metrics.labels(self.algo))
         _metrics.bytes_total.inc(np.asarray(arr).nbytes,
                                  labels=_metrics.labels(self.algo))
-        return out
+        if out is not None:
+            red = np.asarray(red)
+            check_inplace_out(out, red)
+            np.copyto(out.reshape(red.shape), red)
+            return out
+        return red
 
     def reduce(self, arr, op: ReduceOp, root_rank: int, timeout_ms: int):
         out = self.allreduce(arr, op, timeout_ms)
@@ -410,6 +463,7 @@ class HostGroup(BaseGroup):
         self._impl = None
         self._impl_lock = threading.Lock()
         self._poisoned: Optional[str] = None
+        self._runner = None  # async overlap runner, built on first use
         # publish this rank's rendezvous record EAGERLY (best-effort): a
         # peer's send/recv must be able to reach a rank that initialized
         # the group but has not yet issued a collective of its own —
@@ -518,9 +572,60 @@ class HostGroup(BaseGroup):
         return _ring_mod.RingGroup(
             core, self.world_size, self.rank, wire, peers)
 
+    # ----- async overlap runner
+
+    def _ensure_runner(self):
+        from ray_tpu.util.collective.async_work import AsyncRunner
+
+        if self._runner is not None:
+            # fast path OUTSIDE the lock: the reducer thread holds
+            # _impl_lock for the whole first rendezvous — a submit during
+            # that round must still return immediately
+            return self._runner
+        with self._impl_lock:
+            if self._runner is None:
+                self._runner = AsyncRunner(self)
+        return self._runner
+
+    def allreduce_coalesced_async(
+        self,
+        tensors: Sequence[Any],
+        op: ReduceOp,
+        timeout_ms: int,
+        bucket_bytes: Optional[int] = None,
+        out: Optional[Sequence[Any]] = None,
+        overlap: Optional[bool] = None,
+    ):
+        """Overlapped coalesced allreduce: returns a ``CollectiveWork``
+        immediately; the group's runner pipelines per-bucket device->host
+        transfers against shm/ring reduce rounds. ``overlap=False`` (or
+        ``RAY_TPU_COLLECTIVE_OVERLAP=0``) takes the synchronous path and
+        returns an already-completed handle."""
+        if overlap is None:
+            overlap = _overlap_enabled()
+        if not overlap or self.world_size == 1:
+            return super().allreduce_coalesced_async(
+                tensors, op, timeout_ms, bucket_bytes, out=out)
+        if self._poisoned is not None:
+            # same staleness-first remedy as the sync path: a driver
+            # re-create of this declarative group drops the cached member
+            self._raise_if_stale()
+            raise CollectiveError(
+                f"collective group {self._public_name!r} is poisoned by an "
+                f"earlier failure ({self._poisoned}); destroy and re-create "
+                f"the group")
+        if bucket_bytes is None:
+            bucket_bytes = _default_bucket_bytes()
+        return self._ensure_runner().submit(
+            tensors, op, timeout_ms, bucket_bytes, out)
+
     # ----- delegated ops (stale-generation check on the failure path)
 
     def _delegate(self, timeout_ms: int, fn):
+        if self._runner is not None:
+            # sync ops order AFTER in-flight async work on every rank —
+            # the transport must see one identical op sequence everywhere
+            self._runner.flush(max(1.0, timeout_ms / 1000.0))
         if self._poisoned is not None:
             # staleness first: if the driver already destroyed and
             # re-created this declarative group (the documented remedy for
@@ -551,9 +656,10 @@ class HostGroup(BaseGroup):
             self._poisoned = f"{type(e).__name__}: {e}"
             raise
 
-    def allreduce(self, arr, op: ReduceOp, timeout_ms: int) -> np.ndarray:
+    def allreduce(self, arr, op: ReduceOp, timeout_ms: int,
+                  out: Optional[np.ndarray] = None) -> np.ndarray:
         return self._delegate(
-            timeout_ms, lambda g: g.allreduce(arr, op, timeout_ms))
+            timeout_ms, lambda g: g.allreduce(arr, op, timeout_ms, out=out))
 
     def reduce(self, arr, op: ReduceOp, root_rank: int, timeout_ms: int):
         return self._delegate(
@@ -583,6 +689,14 @@ class HostGroup(BaseGroup):
             timeout_ms, lambda g: g.recv(src_rank, timeout_ms))
 
     def destroy(self) -> None:
+        if self._runner is not None:
+            # fail in-flight handles FIRST; the transport teardown below
+            # is what unblocks a reducer parked mid-round
+            try:
+                self._runner.shutdown()
+            except Exception:
+                logger.debug("collective runner shutdown failed",
+                             exc_info=True)
         if self._impl is not None:
             try:
                 self._impl.destroy()
@@ -698,9 +812,15 @@ class XlaGroup(BaseGroup):
             [shard],
         )
 
-    def allreduce(self, arr, op: ReduceOp, timeout_ms: int) -> np.ndarray:
-        out = self._programs[op](self._global(arr))
-        return np.asarray(out.addressable_data(0))
+    def allreduce(self, arr, op: ReduceOp, timeout_ms: int,
+                  out: Optional[np.ndarray] = None) -> np.ndarray:
+        red = self._programs[op](self._global(arr))
+        host = np.asarray(red.addressable_data(0))
+        if out is not None:
+            check_inplace_out(out, host)
+            np.copyto(out.reshape(host.shape), host)
+            return out
+        return host
 
     def reduce(self, arr, op: ReduceOp, root_rank: int, timeout_ms: int):
         out = self.allreduce(arr, op, timeout_ms)
@@ -920,13 +1040,40 @@ def allreduce_coalesced(
     op: ReduceOp = ReduceOp.SUM,
     timeout_ms: int = DEFAULT_TIMEOUT_MS,
     bucket_bytes: Optional[int] = None,
+    out: Optional[Sequence[Any]] = None,
 ) -> List[np.ndarray]:
     """Allreduce a list of tensors in same-dtype buckets (one collective
     round per bucket). The bucketed twin of torch's
     ``allreduce_coalesced`` — what the RLlib learner uses for its
-    gradient tree instead of one monolithic concatenate."""
+    gradient tree instead of one monolithic concatenate. ``out=``
+    (persistent arrays matching the input shapes/dtypes) makes a
+    steady-state call allocation-free; ``op=ReduceOp.MEAN`` pre-scales
+    into the pack copy, so no per-leaf divide pass exists."""
     return _resolve_group(group_name).allreduce_coalesced(
-        tensors, op, timeout_ms, bucket_bytes)
+        tensors, op, timeout_ms, bucket_bytes, out=out)
+
+
+def allreduce_coalesced_async(
+    tensors: Sequence[Any],
+    group_name: str = "default",
+    op: ReduceOp = ReduceOp.SUM,
+    timeout_ms: int = DEFAULT_TIMEOUT_MS,
+    bucket_bytes: Optional[int] = None,
+    out: Optional[Sequence[Any]] = None,
+    overlap: Optional[bool] = None,
+):
+    """Overlapped coalesced allreduce — returns a ``CollectiveWork``
+    handle (``.wait()``/``.done()``) immediately and hides the host-side
+    gradient movement behind device compute: the group's runner
+    materializes buckets (one batched ``jax.device_get`` each, reverse-
+    backward order) and pipelines their shm/ring reduce rounds. Device
+    arrays are accepted directly — do NOT ``np.asarray`` the leaves
+    first, that would serialize the transfers this API exists to
+    overlap. ``overlap`` forces the path (None = the
+    ``RAY_TPU_COLLECTIVE_OVERLAP`` knob); the sync fallback returns an
+    already-completed handle, so call sites stay identical."""
+    return _resolve_group(group_name).allreduce_coalesced_async(
+        tensors, op, timeout_ms, bucket_bytes, out=out, overlap=overlap)
 
 
 def reduce(

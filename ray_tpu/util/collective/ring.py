@@ -33,6 +33,7 @@ import numpy as np
 
 from ray_tpu.util.collective import _metrics
 from ray_tpu.util.collective.types import (CollectiveError, ReduceOp,
+                                           check_inplace_out as _check_out,
                                            reduce_ufunc)
 
 logger = logging.getLogger(__name__)
@@ -59,12 +60,19 @@ class _Inbox:
         self._msgs: Dict[tuple, dict] = {}
         self._watermark: Dict[tuple, int] = {}
         self._dead_nodes: set = set()
+        # wire names of destroyed groups (insertion-ordered dict used as
+        # a bounded set: a process that churns thousands of groups must
+        # not grow this forever — evicting the OLDEST tombstone is safe,
+        # its straggler frames have long since stopped arriving)
+        self._closed: Dict[str, bool] = {}
 
     # runs on the core IO loop (sync RPC handler): dict updates + one
     # bounded memcpy per frame
     def deliver(self, body: dict) -> None:
         key = (body["group"], body["src"], body["seq"])
         with self._cond:
+            if body["group"] in self._closed:
+                return  # late frame for a destroyed group: drop, don't buffer
             if body["seq"] <= self._watermark.get(key[:2], -1):
                 return
             ent = self._msgs.get(key)
@@ -92,6 +100,12 @@ class _Inbox:
         key = (group, src, seq)
         with self._cond:
             while True:
+                if group in self._closed:
+                    # a destroy with work in flight must unpark blocked
+                    # waiters NOW, not after the full collective timeout
+                    raise CollectiveError(
+                        f"collective group {group!r} was destroyed while "
+                        f"waiting for message {seq} from rank {src}")
                 ent = self._msgs.get(key)
                 if ent is not None and ent["remaining"] <= 0:
                     del self._msgs[key]
@@ -116,12 +130,19 @@ class _Inbox:
             self._cond.notify_all()
 
     def forget(self, group: str) -> None:
-        """Drop this group's message state (destroy / re-create)."""
+        """Drop this group's message state and tombstone the wire name so
+        parked waiters raise instead of burning their full timeout (wire
+        names are incarnation-suffixed — a re-created group never
+        collides with its predecessor's tombstone)."""
         with self._cond:
             for key in [k for k in self._msgs if k[0] == group]:
                 del self._msgs[key]
             for key in [k for k in self._watermark if k[0] == group]:
                 del self._watermark[key]
+            self._closed[group] = True
+            while len(self._closed) > 256:
+                self._closed.pop(next(iter(self._closed)))
+            self._cond.notify_all()
 
 
 _REGISTER_LOCK = threading.Lock()
@@ -274,11 +295,21 @@ class RingGroup:
                 f"same-dtype tensors")
         return incoming
 
-    def allreduce(self, arr, op: ReduceOp, timeout_ms: int) -> np.ndarray:
+    def allreduce(self, arr, op: ReduceOp, timeout_ms: int,
+                  out: Optional[np.ndarray] = None) -> np.ndarray:
+        """``out=`` is the result buffer and MAY alias ``arr`` (the ring
+        already reduces in place over its working copy; donating the
+        input just skips that copy)."""
         arr = np.asarray(arr)
         w, r = self.world_size, self.rank
         deadline = self._deadline(timeout_ms)
-        out = np.ascontiguousarray(arr).copy()
+        src = np.ascontiguousarray(arr)
+        if out is None:
+            out = src.copy()
+        else:
+            _check_out(out, src)
+            if out is not src:
+                np.copyto(out.reshape(-1), src.reshape(-1))
         flat = out.reshape(-1)
         segs = _seg_slices(flat.size, w)
         fold = reduce_ufunc(op)
@@ -306,7 +337,10 @@ class RingGroup:
                     "allgather segment").reshape(-1)
         _metrics.ops_total.inc(labels=_metrics.labels(self.algo))
         if op is ReduceOp.MEAN:
-            return (out / w).reshape(arr.shape)
+            if np.issubdtype(out.dtype, np.inexact):
+                np.divide(out, w, out=out)
+                return out
+            return (out / w).reshape(arr.shape)  # integer mean widens
         return out
 
     def reduce(self, arr, op: ReduceOp, root_rank: int, timeout_ms: int):

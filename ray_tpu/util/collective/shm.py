@@ -41,6 +41,7 @@ from ray_tpu._private.ids import ObjectID
 from ray_tpu.util.collective import _metrics
 from ray_tpu.util.collective import ring as _ring
 from ray_tpu.util.collective.types import (CollectiveError, ReduceOp,
+                                           check_inplace_out as _check_out,
                                            reduce_ufunc)
 
 logger = logging.getLogger(__name__)
@@ -199,12 +200,23 @@ class ShmGroup:
 
     # ------------------------------------------------------------ ops
 
-    def allreduce(self, arr, op: ReduceOp, timeout_ms: int) -> np.ndarray:
+    def allreduce(self, arr, op: ReduceOp, timeout_ms: int,
+                  out: Optional[np.ndarray] = None) -> np.ndarray:
+        """``out=`` is the result buffer and MAY alias ``arr`` (the
+        donated/in-place form: each chunk is written to the channel
+        before peers fold into it, so clobbering the source is safe) —
+        a steady-state caller reusing one staging buffer pays zero
+        allocations here."""
         self._ensure_channels()
         arr = np.asarray(arr)
         deadline = time.monotonic() + timeout_ms / 1000.0
         src = np.ascontiguousarray(arr)
-        out = src.copy()
+        if out is None:
+            out = src.copy()
+        else:
+            _check_out(out, src)
+            if out is not src:
+                np.copyto(out.reshape(-1), src.reshape(-1))
         fold = reduce_ufunc(op)
         with _metrics.round_seconds.time(labels={"algo": self.algo}):
             self._post_header(src, deadline)
@@ -232,7 +244,10 @@ class ShmGroup:
                     self._ack(p)
         _metrics.ops_total.inc(labels=_metrics.labels(self.algo))
         if op is ReduceOp.MEAN:
-            return out / self.world_size
+            if np.issubdtype(out.dtype, np.inexact):
+                np.divide(out, self.world_size, out=out)
+                return out
+            return out / self.world_size  # integer mean widens to float
         return out
 
     def reduce(self, arr, op: ReduceOp, root_rank: int, timeout_ms: int):

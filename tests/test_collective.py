@@ -89,6 +89,139 @@ class Worker:
 
         return col.recv(src, group_name=name)
 
+    # ----- async overlap (allreduce_coalesced_async)
+
+    def overlap_parity(self, values, name="default", op=ReduceOp.SUM):
+        """Sync coalesced vs async overlapped on the SAME group (the
+        flush ordering contract): returns (sync, async, overlapped)."""
+        from ray_tpu.util import collective as col
+
+        arrs = [np.asarray(v) * (self.rank + 1) for v in values]
+        sync = col.allreduce_coalesced(arrs, group_name=name, op=op)
+        work = col.allreduce_coalesced_async(arrs, group_name=name, op=op,
+                                             overlap=True)
+        return ([np.asarray(s) for s in sync],
+                [np.asarray(a) for a in work.wait(60000)],
+                work.overlapped)
+
+    def overlap_out_of_order(self, name="default"):
+        from ray_tpu.util import collective as col
+
+        w1 = col.allreduce_coalesced_async(
+            [np.full(1000, 1.0, np.float32)], group_name=name, overlap=True)
+        w2 = col.allreduce_coalesced_async(
+            [np.full(10, 2.0, np.float32), np.full(5, 3.0, np.float64)],
+            group_name=name, overlap=True)
+        r2 = w2.wait(60000)
+        done1 = w1.done()  # in-order runner: w2 done implies w1 done
+        r1 = w1.wait(60000)
+        return ([np.asarray(x) for x in r1],
+                [np.asarray(x) for x in r2], done1)
+
+    def overlap_engaged_probe(self, name="default"):
+        """(async counter delta, async overlapped, fallback counter
+        delta, fallback overlapped, fallback result[0])."""
+        from ray_tpu.util import collective as col
+        from ray_tpu.util.collective import _metrics as cm
+
+        b0 = cm.overlap_rounds_total.total()
+        w = col.allreduce_coalesced_async(
+            [np.ones(100, np.float32)], group_name=name, overlap=True)
+        w.wait(60000)
+        async_delta = cm.overlap_rounds_total.total() - b0
+        b1 = cm.overlap_rounds_total.total()
+        w2 = col.allreduce_coalesced_async(
+            [np.ones(100, np.float32)], group_name=name, overlap=False)
+        r = w2.wait(60000)
+        return (async_delta, w.overlapped,
+                cm.overlap_rounds_total.total() - b1, w2.overlapped,
+                float(np.asarray(r[0])[0]))
+
+    def overlap_staging_deltas(self, name="default", warmup=2, steps=4):
+        """(allocs delta, bytes-gauge delta) across ``steps`` overlapped
+        coalesced calls AFTER ``warmup`` — both must be zero: the pool
+        serves every bucket and out= lands results in place."""
+        from ray_tpu.util import collective as col
+        from ray_tpu.util.collective import _metrics as cm
+
+        bufs = [np.full(4096, float(self.rank), np.float32),
+                np.full(1000, 1.0, np.float64),
+                np.full((32, 32), 2.0, np.float32)]
+        out = [np.empty_like(b) for b in bufs]
+        for _ in range(warmup):
+            col.allreduce_coalesced_async(
+                bufs, group_name=name, out=out, overlap=True).wait(60000)
+        a0 = cm.staging_allocs_total.total()
+        g0 = cm.staging_bytes.total()
+        for _ in range(steps):
+            col.allreduce_coalesced_async(
+                bufs, group_name=name, out=out, overlap=True).wait(60000)
+        return (cm.staging_allocs_total.total() - a0,
+                cm.staging_bytes.total() - g0,
+                float(out[0][0]))
+
+    def overlap_fail_probe(self, name, timeout_ms=4000):
+        """Submit two async works against a dead peer: both handles must
+        raise, and a LATER submit must fail fast as poisoned."""
+        from ray_tpu.util import collective as col
+
+        w1 = col.allreduce_coalesced_async(
+            [np.ones(1000, np.float32)], group_name=name,
+            timeout_ms=timeout_ms, overlap=True)
+        w2 = col.allreduce_coalesced_async(
+            [np.ones(10, np.float32)], group_name=name,
+            timeout_ms=timeout_ms, overlap=True)
+        errs = []
+        for w in (w2, w1):  # out-of-order waits on failing handles too
+            try:
+                w.wait(timeout_ms * 5)
+                errs.append("NO-ERROR")
+            except Exception as e:  # noqa: BLE001 — the expected path
+                errs.append(f"{type(e).__name__}: {e}")
+        try:
+            col.allreduce_coalesced_async(
+                [np.ones(5, np.float32)], group_name=name, overlap=True)
+            poisoned = False
+        except Exception as e:  # noqa: BLE001
+            poisoned = "poisoned" in str(e).lower()
+        return errs, poisoned
+
+    def overlap_destroy_inflight(self, name, timeout_ms=5000):
+        """Destroy the group while async work is in flight: the handle
+        must raise promptly (not after the round's full timeout)."""
+        import time as _t
+
+        from ray_tpu.util import collective as col
+
+        w = col.allreduce_coalesced_async(
+            [np.ones(1000, np.float32)], group_name=name,
+            timeout_ms=timeout_ms, overlap=True)
+        _t.sleep(0.2)  # let the reducer park in the round
+        t0 = _t.monotonic()
+        col.destroy_collective_group(name)
+        try:
+            w.wait(timeout_ms * 3)
+            return "NO-ERROR", 0.0
+        except Exception as e:  # noqa: BLE001 — the expected path
+            return f"{type(e).__name__}: {e}", _t.monotonic() - t0
+
+    def grad_average(self, name, world, value):
+        """The ray_tpu.train gradient path: GradientAverager over a
+        pytree of device arrays (explicit ranks — no session needed)."""
+        import jax.numpy as jnp
+
+        from ray_tpu.train import GradientAverager
+
+        avg = GradientAverager(group_name=name, world_size=world,
+                               rank=self.rank)
+        tree = {"w": jnp.full((8, 4), float(value)),
+                "b": [jnp.full(4, float(value) * 2),
+                      jnp.full(3, float(value) * 3)]}
+        got = avg.average(tree)
+        return (float(np.asarray(got["w"])[0, 0]),
+                float(np.asarray(got["b"][0])[0]),
+                float(np.asarray(got["b"][1])[0]))
+
     def steady_state_rpc_delta(self, name, steps):
         """Outbound-RPC counter delta across ``steps`` allreduces (the
         zero-control-plane proof, same counter the compiled-DAG suite
@@ -295,6 +428,123 @@ class TestShmWorld4:
         )
         assert deltas == [0.0, 0.0, 0.0, 0.0], (
             f"steady-state shm allreduce issued control-plane RPCs: {deltas}")
+
+
+class TestOverlapWorld4:
+    """Async overlapped coalesced allreduce (`allreduce_coalesced_async`)
+    over the same-node world-4 shm group: parity with the sync path,
+    handle semantics, the steady-state zero-allocation contract, and the
+    failure invariants (poison + prompt unwind) from PR 4."""
+
+    def test_parity_with_sync(self, quad):
+        vals = [[1.0, 2.0, 3.0], [[1.0, 2.0], [3.0, 4.0]]]
+        outs = ray_tpu.get(
+            [w.overlap_parity.remote(vals, "quad") for w in quad])
+        # ranks contribute v*(rank+1): reduced = v * (1+2+3+4)
+        for sync, async_res, overlapped in outs:
+            assert overlapped
+            for s, a, v in zip(sync, async_res, vals):
+                np.testing.assert_allclose(s, np.asarray(v) * 10.0)
+                np.testing.assert_allclose(a, np.asarray(v) * 10.0)
+
+    def test_mean_prescaled_parity(self, quad):
+        vals = [[4.0, 8.0], [2.0]]
+        outs = ray_tpu.get(
+            [w.overlap_parity.remote(vals, "quad", ReduceOp.MEAN)
+             for w in quad])
+        for sync, async_res, _ in outs:
+            for s, a, v in zip(sync, async_res, vals):
+                np.testing.assert_allclose(s, np.asarray(v) * 2.5)
+                np.testing.assert_allclose(a, np.asarray(v) * 2.5)
+
+    def test_out_of_order_wait(self, quad):
+        outs = ray_tpu.get(
+            [w.overlap_out_of_order.remote("quad") for w in quad])
+        for r1, r2, done1 in outs:
+            assert done1, "waiting a later handle must drain earlier ones"
+            np.testing.assert_allclose(r1[0], np.full(1000, 4.0))
+            np.testing.assert_allclose(r2[0], np.full(10, 8.0))
+            np.testing.assert_allclose(r2[1], np.full(5, 12.0))
+
+    def test_overlap_engaged_and_fallback(self, quad):
+        outs = ray_tpu.get(
+            [w.overlap_engaged_probe.remote("quad") for w in quad])
+        for async_d, async_ov, sync_d, sync_ov, sync_val in outs:
+            assert async_d > 0, "overlap runner recorded no rounds"
+            assert async_ov and not sync_ov
+            assert sync_d == 0, "sync fallback moved the overlap counter"
+            assert sync_val == 4.0
+
+    @pytest.mark.perf
+    def test_zero_staging_allocs_after_warmup(self, quad):
+        """THE steady-state contract: after warmup, an overlapped step
+        re-acquires pooled staging buffers and lands results in the
+        caller's persistent out= arrays — the alloc counter and the
+        bytes gauge must not move (counter-based, never wall-clock)."""
+        outs = ray_tpu.get(
+            [w.overlap_staging_deltas.remote("quad") for w in quad])
+        for allocs_d, bytes_d, _ in outs:
+            assert allocs_d == 0.0, (
+                f"steady-state overlapped step allocated staging: "
+                f"{allocs_d}")
+            assert bytes_d == 0.0
+
+    def test_train_gradient_averager(self, quad):
+        outs = ray_tpu.get(
+            [w.grad_average.remote("quad_grads", 4, i + 1)
+             for i, w in enumerate(quad)])
+        for wv, b0, b1 in outs:  # mean of (1..4)*v over 4 ranks
+            assert wv == pytest.approx(2.5)
+            assert b0 == pytest.approx(5.0)
+            assert b1 == pytest.approx(7.5)
+
+    def test_failure_mid_round_poisons_and_pending_raise(self, ray_init):
+        workers = [Worker.remote() for _ in range(2)]
+        ray_tpu.get(
+            [w.init_group.remote(2, i, "host", "ovl_dead")
+             for i, w in enumerate(workers)])
+        ray_tpu.get([w.allreduce.remote([1.0], "ovl_dead")
+                     for w in workers])  # rendezvous + channels up
+        ray_tpu.kill(workers[1])
+        time.sleep(1.0)
+        errs, poisoned = ray_tpu.get(
+            workers[0].overlap_fail_probe.remote("ovl_dead"), timeout=120)
+        assert len(errs) == 2
+        for e in errs:
+            low = e.lower()
+            assert ("closed" in low or "timed out" in low or "dead" in low
+                    or "poisoned" in low), errs
+        assert poisoned, "post-failure submit did not fail fast as poisoned"
+        ray_tpu.kill(workers[0])
+
+    def test_destroy_with_inflight_work_unwinds(self, ray_init):
+        workers = [Worker.remote() for _ in range(2)]
+        ray_tpu.get(
+            [w.init_group.remote(2, i, "host", "ovl_destroy")
+             for i, w in enumerate(workers)])
+        ray_tpu.get([w.allreduce.remote([1.0], "ovl_destroy")
+                     for w in workers])  # channels (and pins) exist
+        # rank 1 stays silent; rank 0's async round can never complete
+        err, waited = ray_tpu.get(
+            workers[0].overlap_destroy_inflight.remote("ovl_destroy"),
+            timeout=120)
+        low = err.lower()
+        assert ("destroyed" in low or "closed" in low), err
+        assert waited < 3.0, (
+            f"destroy left the handle parked for {waited:.1f}s")
+        # the unwind must leave the substrate reusable: a FRESH group
+        # under the same public name (new incarnation token, fresh
+        # channels — possible only if the old pins/keys released)
+        ray_tpu.get(workers[1].destroy.remote("ovl_destroy"))
+        ray_tpu.get(
+            [w.init_group.remote(2, i, "host", "ovl_destroy")
+             for i, w in enumerate(workers)])
+        out = ray_tpu.get([w.allreduce.remote([2.0], "ovl_destroy")
+                           for w in workers], timeout=60)
+        for o in out:
+            np.testing.assert_allclose(o, [4.0])
+        for w in workers:
+            ray_tpu.kill(w)
 
 
 class TestRingForced:
