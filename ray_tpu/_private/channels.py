@@ -10,7 +10,7 @@ mmaps (`object_store.ArenaFile`):
 
   header (128 B):
     [magic u64][closed u64][version u64][length u64][n_readers u64]
-    [reader_acks u64 x 8][pad]
+    [reader_acks u64 x 8][depth u64][pad]
   payload: up to ``buffer_bytes`` of a pack()-serialized value.
 
 Protocol (versions advance by 2 per step; step N commits version 2N):
@@ -23,6 +23,21 @@ Protocol (versions advance by 2 per step; step N commits version 2N):
     out-of-band buffers become read-only numpy views over the reader's
     own arena mmap; mutation raises), valid until the reader acks;
   * ack: reader slot <- version, releasing the writer for the next step.
+
+Depth-k slot ring (``RAY_TPU_CHANNEL_DEPTH`` / ``depth=`` at creation):
+capacity grows to k in-flight steps — what 1F1B pipeline schedules need,
+where a stage runs several microbatches ahead of its consumer. A depth-k
+channel carries a slot directory after the main header (k entries of
+[slot_version u64][slot_length u64]) followed by k payload slots; step N
+(version 2N) lands in slot (N-1) mod k. The writer of version v waits
+until every reader acked v - 2k (the slot's previous occupant is fully
+consumed — each ack frees exactly ONE slot), stamps the SLOT version odd
+while copying, then commits the slot and advances the header version to
+the highest committed version (remote push dedup keys off it). Readers
+wait on their target's slot version, so a committed step stays readable
+while the writer fills other slots. depth=1 keeps today's layout and
+protocol bit-for-bit: no slot directory, the header version doubles as
+the single slot's, and the depth field stays zero.
 
 The backing arena range is allocated once through the pin machinery
 (`NodeObjectStore.create_channel`: create + seal + pin in one store op),
@@ -67,6 +82,8 @@ HEADER_SIZE = 128
 _OFF_MAGIC, _OFF_CLOSED, _OFF_VERSION, _OFF_LENGTH, _OFF_NREADERS = (
     0, 8, 16, 24, 32)
 _OFF_ACKS = 40  # u64 x MAX_READERS
+_OFF_DEPTH = 104  # u64 in the former pad; 0 reads as depth 1 (legacy)
+SLOT_HEADER_SIZE = 16  # [slot_version u64][slot_length u64], depth > 1 only
 _U64 = struct.Struct("<Q")
 
 # the method name the driver submits to install a per-actor run loop;
@@ -87,23 +104,60 @@ _m_steps = Counter(
     "Compiled-graph steps launched (CompiledDAG.execute calls)")
 
 
-def total_size(buffer_bytes: int) -> int:
-    return HEADER_SIZE + int(buffer_bytes)
+def total_size(buffer_bytes: int, depth: int = 1) -> int:
+    """Arena bytes for a channel of ``depth`` slots of ``buffer_bytes``
+    each. depth=1 is the legacy layout (no slot directory)."""
+    depth = int(depth)
+    if depth <= 1:
+        return HEADER_SIZE + int(buffer_bytes)
+    return HEADER_SIZE + depth * SLOT_HEADER_SIZE + depth * int(buffer_bytes)
 
 
-def init_header(arena, offset: int, n_readers: int) -> None:
+def slot_capacity(size: int, depth: int) -> int:
+    """Per-slot payload capacity of a channel of ``size`` total bytes."""
+    depth = max(1, int(depth))
+    if depth == 1:
+        return int(size) - HEADER_SIZE
+    return (int(size) - HEADER_SIZE - depth * SLOT_HEADER_SIZE) // depth
+
+
+def _slot_of(version: int, depth: int) -> int:
+    """Ring slot carrying even ``version`` (= 2N -> slot (N-1) mod k)."""
+    return (version // 2 - 1) % depth
+
+
+def _slot_header_off(slot: int) -> int:
+    return HEADER_SIZE + slot * SLOT_HEADER_SIZE
+
+
+def _slot_payload_off(slot: int, depth: int, size: int) -> int:
+    return (HEADER_SIZE + depth * SLOT_HEADER_SIZE
+            + slot * slot_capacity(size, depth))
+
+
+def init_header(arena, offset: int, n_readers: int,
+                depth: int = 1) -> None:
     """Zero + stamp a fresh channel header (runs supervisor-side on the
-    store thread right after the range is allocated)."""
+    store thread right after the range is allocated). depth=1 leaves the
+    depth field zero — byte-identical to the pre-slot-ring header."""
     if not 0 <= int(n_readers) <= MAX_READERS:
         # a clamped count would silently drop flow control for the extra
         # readers (and their acks would land in the payload bytes)
         raise ValueError(
             f"channel needs {n_readers} reader slots; the header carries "
             f"at most {MAX_READERS}")
+    if int(depth) < 1:
+        raise ValueError(f"channel depth must be >= 1, got {depth}")
     view = arena.view(offset, HEADER_SIZE)
     view[:] = b"\x00" * HEADER_SIZE
     _U64.pack_into(view, _OFF_MAGIC, MAGIC)
     _U64.pack_into(view, _OFF_NREADERS, int(n_readers))
+    if int(depth) > 1:
+        _U64.pack_into(view, _OFF_DEPTH, int(depth))
+        # zero the slot directory (the payload area needs no init)
+        dir_view = arena.view(offset + HEADER_SIZE,
+                              int(depth) * SLOT_HEADER_SIZE)
+        dir_view[:] = b"\x00" * (int(depth) * SLOT_HEADER_SIZE)
 
 
 def mark_closed(arena, offset: int) -> None:
@@ -113,7 +167,8 @@ def mark_closed(arena, offset: int) -> None:
 
 
 def read_header(arena, offset: int) -> Tuple[bool, int, int]:
-    """(closed, version, length) — supervisor-side peek for push/commit."""
+    """(closed, version, length) — supervisor-side peek for push/commit.
+    ``version`` is the highest committed version at any depth."""
     view = arena.view(offset, HEADER_SIZE)
     return (
         _U64.unpack_from(view, _OFF_CLOSED)[0] != 0,
@@ -122,35 +177,58 @@ def read_header(arena, offset: int) -> Tuple[bool, int, int]:
     )
 
 
-def readers_ready(arena, offset: int, version: int) -> bool:
-    """True when every reader slot acked ``version - 2`` (the writer —
-    local or a remote push landing via the supervisor — may overwrite)."""
+def read_depth(arena, offset: int) -> int:
+    """Slot-ring depth stamped in the header (0 reads as legacy depth 1)."""
     view = arena.view(offset, HEADER_SIZE)
-    n = _U64.unpack_from(view, _OFF_NREADERS)[0]
-    for slot in range(n):
-        if _U64.unpack_from(view, _OFF_ACKS + 8 * slot)[0] < version - 2:
-            return False
-    return True
+    return max(1, _U64.unpack_from(view, _OFF_DEPTH)[0])
 
 
-def host_write_commit(arena, offset: int, payload, version: int) -> None:
+def readers_ready(arena, offset: int, version: int) -> bool:
+    """True when every reader slot acked ``version - 2*depth`` — the slot
+    ``version`` lands in is free of its previous occupant, so the writer
+    (local or a remote push landing via the supervisor) may overwrite."""
+    view = arena.view(offset, HEADER_SIZE)
+    return readers_ready_view(view, version)
+
+
+def host_write_commit(arena, offset: int, size: int, payload,
+                      version: int) -> None:
     """Supervisor-side mirror write: payload + length + commit in one shot
     (callers already waited for reader acks; chunked pushes write payload
     via host_write_chunk and commit via host_commit instead)."""
-    arena.write(offset + HEADER_SIZE, payload)
-    view = arena.view(offset, HEADER_SIZE)
-    _U64.pack_into(view, _OFF_LENGTH, len(payload))
-    _U64.pack_into(view, _OFF_VERSION, version)
+    depth = read_depth(arena, offset)
+    if depth == 1:
+        arena.write(offset + HEADER_SIZE, payload)
+    else:
+        slot = _slot_of(version, depth)
+        arena.write(offset + _slot_payload_off(slot, depth, size), payload)
+    host_commit(arena, offset, size, len(payload), version)
 
 
-def host_commit(arena, offset: int, length: int, version: int) -> None:
+def host_commit(arena, offset: int, size: int, length: int,
+                version: int) -> None:
+    depth = read_depth(arena, offset)
+    if depth > 1:
+        slot = _slot_of(version, depth)
+        sview = arena.view(offset + _slot_header_off(slot),
+                           SLOT_HEADER_SIZE)
+        _U64.pack_into(sview, 8, length)
+        _U64.pack_into(sview, 0, version)
     view = arena.view(offset, HEADER_SIZE)
     _U64.pack_into(view, _OFF_LENGTH, length)
     _U64.pack_into(view, _OFF_VERSION, version)
 
 
-def host_write_chunk(arena, offset: int, chunk_offset: int, data) -> None:
-    arena.write(offset + HEADER_SIZE + chunk_offset, data)
+def host_write_chunk(arena, offset: int, size: int, version: int,
+                     chunk_offset: int, data) -> None:
+    depth = read_depth(arena, offset)
+    if depth == 1:
+        arena.write(offset + HEADER_SIZE + chunk_offset, data)
+    else:
+        slot = _slot_of(version, depth)
+        arena.write(
+            offset + _slot_payload_off(slot, depth, size) + chunk_offset,
+            data)
 
 
 # --------------------------------------------------------------- descriptors
@@ -164,8 +242,9 @@ class ChannelSpec:
     channel_id: bytes  # ObjectID binary of the backing arena object
     node_addr: Tuple[str, int]  # supervisor owning the arena range
     offset: int
-    size: int  # total (header + payload capacity)
+    size: int  # total (header + slot directory + payload capacity)
     n_readers: int
+    depth: int = 1  # slot-ring capacity (in-flight steps)
 
     def key(self) -> bytes:
         return self.channel_id
@@ -208,7 +287,10 @@ class LocalChannel:
         if _U64.unpack_from(self._view, _OFF_MAGIC)[0] != MAGIC:
             raise ValueError(
                 f"not a channel at offset {spec.offset} (bad magic)")
-        self.capacity = spec.size - HEADER_SIZE
+        # the header is the source of truth for depth (the spec default
+        # covers pre-ring wire records)
+        self.depth = max(1, _U64.unpack_from(self._view, _OFF_DEPTH)[0])
+        self.capacity = slot_capacity(spec.size, self.depth)
 
     # -- header accessors
 
@@ -252,8 +334,12 @@ class LocalChannel:
                     f"channel {self.spec.channel_id.hex()[:12]}: {what} "
                     f"timed out after {timeout}s")
             spins += 1
-            if spins < 500:
-                time.sleep(0)  # yield the GIL; catches a busy pipeline
+            if spins < 100:
+                # yield the GIL/CPU; catches a busy pipeline. Kept SHORT:
+                # on a saturated host every yield is a sched_yield that
+                # burns a scheduler pass, and a peer that hasn't
+                # committed within ~100 yields won't within 500 either
+                time.sleep(0)
             else:
                 # escalate 50us -> 2ms: a hot pipeline wakes within one
                 # short tick; an idle loop settles at 2ms polls (the
@@ -261,11 +347,15 @@ class LocalChannel:
                 time.sleep(delay)
                 delay = min(delay * 1.5, 0.002)
 
+    def _slot_version(self, slot: int) -> int:
+        return self._u64(_slot_header_off(slot))
+
     def write(self, payload, version: int,
               timeout: Optional[float] = None) -> None:
         """Commit ``payload`` as ``version`` (even). Blocks until every
-        reader acked the previous step — channel capacity is exactly one
-        in-flight step, which is the compiled-DAG backpressure."""
+        reader acked version - 2*depth — the ring slot this version lands
+        in is free, which is the compiled-DAG backpressure (capacity is
+        ``depth`` in-flight steps; one at the legacy depth 1)."""
         n = len(payload)
         if n > self.capacity:
             raise ValueError(
@@ -275,10 +365,24 @@ class LocalChannel:
         chaos.maybe_delay("channel.write")
         self._wait(lambda: readers_ready_view(self._view, version),
                    timeout, f"write v{version}")
-        self._set_u64(_OFF_VERSION, version - 1)  # odd: write in progress
-        self._view[HEADER_SIZE:HEADER_SIZE + n] = payload
-        self._set_u64(_OFF_LENGTH, n)
-        self._set_u64(_OFF_VERSION, version)
+        if self.depth == 1:
+            self._set_u64(_OFF_VERSION, version - 1)  # odd: in progress
+            self._view[HEADER_SIZE:HEADER_SIZE + n] = payload
+            self._set_u64(_OFF_LENGTH, n)
+            self._set_u64(_OFF_VERSION, version)
+        else:
+            slot = _slot_of(version, self.depth)
+            shdr = _slot_header_off(slot)
+            base = _slot_payload_off(slot, self.depth, self.spec.size)
+            self._set_u64(shdr, version - 1)  # odd: slot write in progress
+            self._view[base:base + n] = payload
+            self._set_u64(shdr + 8, n)
+            self._set_u64(shdr, version)
+            # header version trails the newest commit (commits are
+            # sequential from the single writer): remote push dedup and
+            # read_header peeks key off it
+            self._set_u64(_OFF_LENGTH, n)
+            self._set_u64(_OFF_VERSION, version)
         _m_writes.inc()
         _m_bytes.inc(n, labels={"op": "write"})
 
@@ -288,13 +392,39 @@ class LocalChannel:
         The view aliases the shared arena: it is valid until this reader
         acks, after which the writer may overwrite it."""
         chaos.maybe_delay("channel.read")
-        self._wait(
-            lambda: self.version >= version and self.version % 2 == 0,
-            timeout, f"read v{version}")
-        length = self._u64(_OFF_LENGTH)
+        if self.depth == 1:
+            self._wait(
+                lambda: self.version >= version and self.version % 2 == 0,
+                timeout, f"read v{version}")
+            length = self._u64(_OFF_LENGTH)
+            base = HEADER_SIZE
+        else:
+            # the writer cannot lap this reader (it blocks until our ack
+            # of this slot's previous occupant), so slot_version can
+            # never exceed the version we are waiting for
+            slot = _slot_of(version, self.depth)
+            shdr = _slot_header_off(slot)
+            self._wait(
+                lambda: (self._slot_version(slot) >= version
+                         and self._slot_version(slot) % 2 == 0),
+                timeout, f"read v{version}")
+            length = self._u64(shdr + 8)
+            base = _slot_payload_off(slot, self.depth, self.spec.size)
         _m_reads.inc()
         _m_bytes.inc(length, labels={"op": "read"})
-        return self._view[HEADER_SIZE:HEADER_SIZE + length].toreadonly()
+        return self._view[base:base + length].toreadonly()
+
+    def ready(self, version: int) -> bool:
+        """Non-blocking probe: is ``version`` committed (readable now)?
+        Returns True on a closed channel so the caller's blocking read
+        observes the close and raises instead of spinning forever."""
+        if self.closed:
+            return True
+        if self.depth == 1:
+            v = self.version
+        else:
+            v = self._slot_version(_slot_of(version, self.depth))
+        return v >= version and v % 2 == 0
 
     def ack(self, slot: int, version: int) -> None:
         """Release the writer: this reader is done with ``version``."""
@@ -307,8 +437,10 @@ class LocalChannel:
 
 def readers_ready_view(view: memoryview, version: int) -> bool:
     n = _U64.unpack_from(view, _OFF_NREADERS)[0]
+    depth = max(1, _U64.unpack_from(view, _OFF_DEPTH)[0])
+    floor = version - 2 * depth
     for slot in range(n):
-        if _U64.unpack_from(view, _OFF_ACKS + 8 * slot)[0] < version - 2:
+        if _U64.unpack_from(view, _OFF_ACKS + 8 * slot)[0] < floor:
             return False
     return True
 
@@ -333,8 +465,19 @@ class MirrorWriter:
         self._chunk = core.config.object_transfer_chunk_bytes
         self._window = max(1, core.config.object_transfer_window)
         self._timeout = core.config.channel_remote_timeout_s
+        self.capacity = slot_capacity(spec.size, spec.depth)
 
     def push(self, payload, version: int) -> None:
+        if len(payload) > self.capacity:
+            # same contract as LocalChannel.write: at depth > 1 the
+            # slots are contiguous, so an unchecked oversized stream
+            # would silently overwrite the NEXT slot's committed payload
+            # on the remote side (the supervisor handlers also reject,
+            # as defense)
+            raise ValueError(
+                f"channel payload of {len(payload)} bytes exceeds the "
+                f"channel buffer ({self.capacity}); recompile with "
+                f"experimental_compile(buffer_size_bytes=...)")
         try:
             self._core._run(self._push_async(payload, version),
                             timeout=self._timeout + 10)
@@ -372,6 +515,114 @@ class MirrorWriter:
             {"channel_id": cid, "version": version,
              "length": len(payload)},
             timeout=self._timeout)
+
+
+# ------------------------------------------------- driver-side shared plumbing
+
+
+def create_channel(core, node_addr, buffer_bytes: int, depth: int,
+                   n_readers: int, participants) -> ChannelSpec:
+    """Mint + allocate one channel on ``node_addr`` (compile/build time).
+    The creation pin belongs to this driver until teardown releases it.
+    Shared by the compiled-DAG planner and the pipeline trainer so the
+    channel_create contract lives in exactly one place."""
+    from ray_tpu._private.core_worker import _m_pins
+    from ray_tpu._private.ids import ObjectID
+
+    oid = ObjectID.from_put()
+    size = total_size(buffer_bytes, depth)
+    r = core._run(core.clients.get(tuple(node_addr)).call(
+        "channel_create",
+        {"channel_id": oid.binary(), "size": size,
+         "n_readers": n_readers, "depth": depth,
+         "participants": sorted(participants),
+         "client": core._store_client_id,
+         "client_addr": core.address},
+        timeout=60))
+    _m_pins.inc()  # the creation pin is ours until teardown
+    return ChannelSpec(
+        channel_id=oid.binary(), node_addr=tuple(node_addr),
+        offset=r["offset"], size=size, n_readers=n_readers, depth=depth)
+
+
+def close_channels_nowait(core, local_channels, specs) -> None:
+    """Fire-and-forget close of a channel set: flip the local closed
+    flags immediately (unblocks any thread parked in read/write in THIS
+    process), then fan channel_close out to every hosting node without
+    blocking the caller. Shared by the compiled-DAG failure paths and
+    the pipeline trainer — the close contract lives in one place."""
+    for ch in local_channels:
+        try:
+            ch.close()
+        except Exception:
+            pass
+    for spec in specs:
+        core._run_nowait(core.clients.get(tuple(spec.node_addr)).call(
+            "channel_close", {"channel_id": spec.channel_id},
+            timeout=10))
+
+
+def resolve_actor_placement(core, actor_id, views=None) -> dict:
+    """Wait (bounded) for the actor to be ALIVE, then snapshot its
+    worker/node identity. Channel placement pins to this incarnation:
+    if the actor later restarts elsewhere, its run loop dies with the
+    old worker and the graph/pipeline closes — compiled topologies do
+    not migrate; rebuild against the restarted actor. ``views`` lets a
+    caller resolve a whole actor set against one node_views snapshot
+    (refreshed once here if the actor's node joined after it)."""
+    ctrl = core.clients.get(core.controller_addr)
+    deadline = time.monotonic() + 60
+    while True:
+        rec = core._run(ctrl.call(
+            "actor_get", {"actor_id_hex": actor_id.hex()}))
+        if rec is None or rec["state"] == "DEAD":
+            raise RuntimeError(
+                f"cannot place channels: actor {actor_id.hex()[:12]} is "
+                f"{'unknown' if rec is None else 'dead'}")
+        if rec["state"] == "ALIVE" and rec.get("address") \
+                and rec.get("node_id_hex"):
+            break
+        if time.monotonic() > deadline:
+            raise RuntimeError(
+                f"cannot place channels: actor {actor_id.hex()[:12]} "
+                f"not alive within 60s")
+        time.sleep(0.05)
+    caller_views = views is not None
+    if views is None:
+        views = core._run(ctrl.call("node_views"))
+
+    def find(vs):
+        for v in vs:
+            if v["node_id_hex"] == rec["node_id_hex"]:
+                return tuple(v["address"])
+        return None
+
+    node_addr = find(views)
+    if node_addr is None and caller_views:
+        node_addr = find(core._run(ctrl.call("node_views")))
+    if node_addr is None:
+        raise RuntimeError(
+            f"actor {actor_id.hex()[:12]}'s node "
+            f"{rec['node_id_hex'][:12]} not in the cluster view")
+    return {"actor_id": actor_id, "node_addr": node_addr,
+            "node_id_hex": rec["node_id_hex"],
+            "worker_id_hex": rec["worker_id_hex"]}
+
+
+def surface_loop_failure(core, loop_refs, closed: "ChannelClosedError"):
+    """A closed channel usually has a root cause parked in a run-loop
+    task's error report (user method raised, actor died) — raise that
+    instead of the bare close when one is available."""
+    from ray_tpu._private.exceptions import ActorDiedError, TaskError
+
+    for ref in loop_refs:
+        try:
+            core.get([ref], timeout=1.0)
+        except (TaskError, ActorDiedError) as e:
+            raise e from closed
+        except Exception:
+            continue
+    raise closed
 
 
 # ----------------------------------------------------- worker-side run loop
@@ -504,7 +755,19 @@ def run_actor_loop(core, instance, plan: ActorLoopPlan) -> dict:
                     ch.ack(slot, version)
             steps += 1
     except ChannelClosedError:
-        # normal exit: teardown (or a peer's death) closed the channels
+        # normal exit: teardown (or a peer's death) closed the channels.
+        # Re-fan the close over OUR channels before leaving: a peer that
+        # poisoned only its own edges (user exception on a still-alive
+        # actor — no supervisor death fan-out) relies on each loop
+        # propagating the close, or a driver parked on an untouched
+        # output channel would hang forever. Safe on the teardown path:
+        # our pins (released in the finally below) keep the ranges
+        # alive, and the driver frees them only after collecting this
+        # loop's result.
+        try:
+            close_everything()
+        except Exception:
+            logger.exception("channel close-on-exit failed")
         return {"steps": steps}
     except BaseException:
         # user method raised (or this worker is wedged): poison the graph

@@ -97,6 +97,12 @@ class Config:
     # packed value exceeds it raises (override per-graph via
     # experimental_compile(buffer_size_bytes=...))
     channel_buffer_bytes: int = 4 * 1024**2
+    # slot-ring depth: how many committed-but-unacked steps a channel
+    # holds before its writer blocks. 1 (default) is the original
+    # one-in-flight-step seqlock protocol bit-for-bit; pipeline-parallel
+    # training (train.PipelineTrainer) needs > 1 so a stage can run
+    # microbatches ahead of its consumer (1F1B)
+    channel_depth: int = 1
     # total budget for one cross-node per-step push (chunk window +
     # commit); the commit side also waits for remote reader acks under it
     channel_remote_timeout_s: float = 120.0

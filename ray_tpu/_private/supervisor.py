@@ -1420,7 +1420,7 @@ class Supervisor:
             self.store.create_channel, oid, body["size"], client)
         await self._store_op(
             channels.init_header, self.store.arena, offset,
-            body["n_readers"])
+            body["n_readers"], body.get("depth", 1))
         self._channels[oid.binary()] = {
             "oid": oid,
             "offset": offset,
@@ -1491,16 +1491,30 @@ class Supervisor:
                 f"unknown on this node")
         return ent
 
+    def _check_channel_capacity(self, ent: dict, end: int) -> None:
+        """Reject a push frame reaching past the slot payload area: at
+        depth > 1 the slots are contiguous, so an unchecked write would
+        corrupt the NEXT slot's committed (possibly unread) payload —
+        silent wrong data instead of a clean error."""
+        cap = channels.slot_capacity(
+            ent["size"], channels.read_depth(self.store.arena,
+                                             ent["offset"]))
+        if end > cap:
+            raise ValueError(
+                f"channel push of {end} bytes exceeds the slot "
+                f"capacity ({cap})")
+
     @idempotent  # absolute version: duplicated/retried pushes converge
     async def rpc_channel_push(self, body) -> None:
         """One-frame per-step push into a mirror channel (payload fits a
         single chunk): wait for reader acks, write payload, commit."""
         ent = self._channel_entry(body)
+        self._check_channel_capacity(ent, len(body["payload"]))
         if not await self._channel_wait_writable(ent, body["version"]):
             return  # duplicate delivery of an already-committed version
         await self._store_op(
             channels.host_write_commit, self.store.arena, ent["offset"],
-            body["payload"], body["version"])
+            ent["size"], body["payload"], body["version"])
         self._m_transfer_bytes.inc(len(body["payload"]))
 
     @idempotent  # same-offset same-version rewrites converge
@@ -1515,13 +1529,15 @@ class Supervisor:
                                                ent["offset"])
         if committed >= version:
             return
+        self._check_channel_capacity(
+            ent, body["offset"] + len(body["data"]))
         if ent["staging"] != version:
             if not await self._channel_wait_writable(ent, version):
                 return
             ent["staging"] = version
         await self._store_op(
             channels.host_write_chunk, self.store.arena, ent["offset"],
-            body["offset"], body["data"])
+            ent["size"], version, body["offset"], body["data"])
         self._m_transfer_chunks.inc()
         self._m_transfer_bytes.inc(len(body["data"]))
 
@@ -1529,13 +1545,14 @@ class Supervisor:
     async def rpc_channel_commit(self, body) -> None:
         """Seal a chunked push: stamp length + version (readers wake)."""
         ent = self._channel_entry(body)
+        self._check_channel_capacity(ent, body["length"])
         _, committed, _ = channels.read_header(self.store.arena,
                                                ent["offset"])
         if committed >= body["version"]:
             return
         await self._store_op(
             channels.host_commit, self.store.arena, ent["offset"],
-            body["length"], body["version"])
+            ent["size"], body["length"], body["version"])
 
     @idempotent  # contains-check + in-flight dedupe make re-pulls converge
     async def rpc_pull_object(self, body) -> dict:

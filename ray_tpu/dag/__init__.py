@@ -62,15 +62,20 @@ class DAGNode:
         return _resolve(self, list(input_values), cache)
 
     def experimental_compile(
-            self, buffer_size_bytes: Optional[int] = None) -> "CompiledDAG":
+            self, buffer_size_bytes: Optional[int] = None,
+            depth: Optional[int] = None) -> "CompiledDAG":
         """≈ `ray.dag.DAGNode.experimental_compile` (compiled_dag_node.py:279).
 
         All-actor-method graphs compile to mutable shared-memory channels
         plus per-actor run loops (see module docstring); ``buffer_size_bytes``
         overrides the per-channel payload capacity
-        (``Config.channel_buffer_bytes``). Graphs with plain function
+        (``Config.channel_buffer_bytes``) and ``depth`` the slot-ring
+        capacity (``Config.channel_depth`` / ``RAY_TPU_CHANNEL_DEPTH``;
+        at depth k the driver may run k ``execute()`` calls ahead of the
+        matching ``get()``s before blocking). Graphs with plain function
         nodes freeze/validate the topology and execute dynamically."""
-        return CompiledDAG(self, buffer_size_bytes=buffer_size_bytes)
+        return CompiledDAG(self, buffer_size_bytes=buffer_size_bytes,
+                           depth=depth)
 
 
 class CompiledDAG:
@@ -79,7 +84,13 @@ class CompiledDAG:
     ``teardown()`` to release channels and stop the actor loops."""
 
     def __init__(self, root: DAGNode,
-                 buffer_size_bytes: Optional[int] = None):
+                 buffer_size_bytes: Optional[int] = None,
+                 depth: Optional[int] = None):
+        # validate the EXPLICIT knob here, before the channel-compile
+        # try/except: inside it, a bad value would demote to the dynamic
+        # path with only a warning instead of telling the caller
+        if depth is not None and int(depth) < 1:
+            raise ValueError(f"channel depth must be >= 1 (got {depth})")
         self._root = root
         # walk once: compute input arity AND reject unsupported node types
         # now, not at the first execute()
@@ -105,7 +116,7 @@ class CompiledDAG:
         if self._n_inputs > 0 and _channel_eligible(root, nodes):
             try:
                 self._graph = _ChannelGraph(
-                    root, self._n_inputs, buffer_size_bytes)
+                    root, self._n_inputs, buffer_size_bytes, depth)
             except ChannelClosedError:
                 raise
             except Exception as e:  # noqa: BLE001 — degrade, don't break
@@ -117,6 +128,11 @@ class CompiledDAG:
     @property
     def is_channel_backed(self) -> bool:
         return self._graph is not None
+
+    @property
+    def channel_depth(self) -> int:
+        """Slot-ring depth of the compiled channels (0 when dynamic)."""
+        return self._graph._depth if self._graph is not None else 0
 
     def execute(self, *input_values) -> Any:
         if self._n_inputs and len(input_values) != self._n_inputs:
@@ -294,16 +310,21 @@ class _ChannelGraph:
     channels, the per-actor loop tasks, and the step cursors."""
 
     def __init__(self, root: DAGNode, n_inputs: int,
-                 buffer_size_bytes: Optional[int]):
+                 buffer_size_bytes: Optional[int],
+                 depth: Optional[int] = None):
         from ray_tpu._private import api
         from ray_tpu._private.core_worker import _m_pins
-        from ray_tpu._private.ids import ObjectID
 
         core = api._require_core()
         self._core = core
         self._m_pins = _m_pins
         self._buffer = int(buffer_size_bytes
                            or core.config.channel_buffer_bytes)
+        self._depth = int(depth if depth is not None
+                          else (core.config.channel_depth or 1))
+        if self._depth < 1:
+            raise ValueError(f"channel depth must be >= 1 "
+                             f"(got {self._depth})")
         self._n_inputs = n_inputs
         self._multi_output = isinstance(root, MultiOutputNode)
         self._outputs = root._outputs if self._multi_output else [root]
@@ -410,7 +431,6 @@ class _ChannelGraph:
 
     def _build(self, core, consumers, stages, stage_node, pkey) -> None:
         from ray_tpu._private import api
-        from ray_tpu._private.ids import ObjectID
 
         n_inputs = self._n_inputs
         # ---- allocate channels: one per (producer, node-with-readers),
@@ -449,8 +469,7 @@ class _ChannelGraph:
                             c._method._handle._actor_id.hex()
                         ]["worker_id_hex"])
                 spec = self._create_channel(
-                    ObjectID.from_put(), node, len(readers),
-                    sorted(participants))
+                    node, len(readers), participants)
                 self._all_specs.append(spec)
                 for slot, c in enumerate(readers):
                     ident = _DRIVER if c is _DRIVER else id(c)
@@ -528,59 +547,13 @@ class _ChannelGraph:
     # -- compile-time helpers
 
     def _resolve_actor(self, actor_id) -> dict:
-        """Wait (bounded) for the actor to be ALIVE, then snapshot its
-        worker/node identity. Channel placement is pinned to this
-        incarnation: if the actor later restarts elsewhere, its loop dies
-        with the old worker and the graph closes (compiled graphs do not
-        migrate — recompile against the restarted actor)."""
-        core = self._core
-        ctrl = core.clients.get(core.controller_addr)
-        deadline = time.monotonic() + 60
-        while True:
-            rec = core._run(ctrl.call(
-                "actor_get", {"actor_id_hex": actor_id.hex()}))
-            if rec is None or rec["state"] == "DEAD":
-                raise RuntimeError(
-                    f"cannot compile: actor {actor_id.hex()[:12]} is "
-                    f"{'unknown' if rec is None else 'dead'}")
-            if rec["state"] == "ALIVE" and rec.get("address") \
-                    and rec.get("node_id_hex"):
-                break
-            if time.monotonic() > deadline:
-                raise RuntimeError(
-                    f"cannot compile: actor {actor_id.hex()[:12]} not "
-                    f"alive within 60s")
-            time.sleep(0.05)
-        views = core._run(ctrl.call("node_views"))
-        node_addr = None
-        for v in views:
-            if v["node_id_hex"] == rec["node_id_hex"]:
-                node_addr = tuple(v["address"])
-        if node_addr is None:
-            raise RuntimeError(
-                f"actor {actor_id.hex()[:12]}'s node "
-                f"{rec['node_id_hex'][:12]} not in the cluster view")
-        return {
-            "actor_id": actor_id,
-            "node_addr": node_addr,
-            "node_id_hex": rec["node_id_hex"],
-            "worker_id_hex": rec["worker_id_hex"],
-        }
+        return _channels.resolve_actor_placement(self._core, actor_id)
 
-    def _create_channel(self, oid, node_addr, n_readers,
+    def _create_channel(self, node_addr, n_readers,
                         participants) -> _channels.ChannelSpec:
-        size = _channels.total_size(self._buffer)
-        r = self._core._run(self._core.clients.get(tuple(node_addr)).call(
-            "channel_create",
-            {"channel_id": oid.binary(), "size": size,
-             "n_readers": n_readers, "participants": list(participants),
-             "client": self._core._store_client_id,
-             "client_addr": self._core.address},
-            timeout=60))
-        self._m_pins.inc()  # the creation pin is ours until teardown
-        return _channels.ChannelSpec(
-            channel_id=oid.binary(), node_addr=tuple(node_addr),
-            offset=r["offset"], size=size, n_readers=n_readers)
+        return _channels.create_channel(
+            self._core, node_addr, self._buffer, self._depth, n_readers,
+            participants)
 
     # -- failure fan-out
 
@@ -591,13 +564,9 @@ class _ChannelGraph:
             # runs on the core IO loop: flip local flags immediately
             # (unblocks any thread parked in read/write), fan the close
             # out to every hosting node without blocking the handler
-            for ch in self._local_channels.values():
-                ch.close()
-            for spec in self._all_specs:
-                self._core._run_nowait(
-                    self._core.clients.get(tuple(spec.node_addr)).call(
-                        "channel_close", {"channel_id": spec.channel_id},
-                        timeout=10))
+            _channels.close_channels_nowait(
+                self._core, self._local_channels.values(),
+                self._all_specs)
 
     def _close_for_failure(self) -> None:
         """A step failed partway through its input writes: some peers
@@ -606,31 +575,11 @@ class _ChannelGraph:
         cannot be retried. Close the whole graph (same lightweight
         fan-out as actor death); pins still release via teardown()."""
         self._dead = True
-        for ch in self._local_channels.values():
-            try:
-                ch.close()
-            except Exception:
-                pass
-        for spec in self._all_specs:
-            self._core._run_nowait(
-                self._core.clients.get(tuple(spec.node_addr)).call(
-                    "channel_close", {"channel_id": spec.channel_id},
-                    timeout=10))
+        _channels.close_channels_nowait(
+            self._core, self._local_channels.values(), self._all_specs)
 
     def _surface_failure(self, closed: ChannelClosedError):
-        """A closed channel usually has a root cause parked in a loop
-        task's error report (user method raised, actor died) — surface
-        that instead of the bare close when it is available."""
-        from ray_tpu._private.exceptions import ActorDiedError, TaskError
-
-        for ref in self._loop_refs:
-            try:
-                self._core.get([ref], timeout=1.0)
-            except (TaskError, ActorDiedError) as e:
-                raise e from closed
-            except Exception:
-                continue
-        raise closed
+        _channels.surface_loop_failure(self._core, self._loop_refs, closed)
 
     # -- the steady-state step path (no control-plane RPCs)
 

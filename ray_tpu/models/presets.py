@@ -79,3 +79,208 @@ def moe_debug(**overrides) -> TransformerConfig:
     )
     kw.update(overrides)
     return TransformerConfig(**kw)
+
+
+# ---------------------------------------------------------------------------
+# pipeline stage partition (MPMD train.PipelineTrainer shards)
+#
+# Splits a transformer's blocks into S uniform stages: stage 0 owns the
+# embedding (+ learned positions), the last stage owns the final norm +
+# lm_head + loss, and the blocks spread as evenly as possible (the
+# remainder lands on the EARLIEST stages, which also carry the lighter
+# embed/no-head ends). Every callable here is a module-level function
+# under functools.partial, so stage specs pickle cleanly into the stage
+# actors.
+
+
+def pipeline_splits(num_layers: int, num_stages: int):
+    """[(lo, hi)) block ranges for S uniform stages."""
+    if num_stages < 2:
+        raise ValueError("a pipeline needs >= 2 stages")
+    if num_layers < num_stages:
+        raise ValueError(
+            f"cannot split {num_layers} blocks into {num_stages} stages")
+    base, rem = divmod(num_layers, num_stages)
+    splits, lo = [], 0
+    for s in range(num_stages):
+        hi = lo + base + (1 if s < rem else 0)
+        splits.append((lo, hi))
+        lo = hi
+    return splits
+
+
+def _check_pipeline_cfg(cfg) -> None:
+    if cfg.tie_embeddings:
+        raise ValueError(
+            "pipeline stages need tie_embeddings=False: the embedding "
+            "table lives on stage 0 and the lm_head on the last stage — "
+            "a tied table would need its gradient summed across both "
+            "ends every flush")
+    if cfg.mlp == "moe":
+        raise ValueError(
+            "pipeline stages do not support mlp='moe' yet (the routing "
+            "aux loss would need summing across stages)")
+
+
+def partition_pipeline_params(cfg, params, num_stages: int):
+    """Slice a full init_params() tree into per-stage shards (parity
+    tests init once and compare the assembled pipeline to the
+    single-process model bit-for-bit)."""
+    import jax
+
+    _check_pipeline_cfg(cfg)
+    splits = pipeline_splits(cfg.num_layers, num_stages)
+    shards = []
+    for s, (lo, hi) in enumerate(splits):
+        shard = {}
+        if cfg.scan_layers:
+            shard["blocks"] = jax.tree.map(
+                lambda a: a[lo:hi], params["blocks"])
+        else:
+            shard["blocks"] = {
+                str(i - lo): params["blocks"][str(i)]
+                for i in range(lo, hi)}
+        if s == 0:
+            shard["embed"] = params["embed"]
+            if cfg.pos == "learned":
+                shard["pos_embed"] = params["pos_embed"]
+        if s == num_stages - 1:
+            shard["final_norm"] = params["final_norm"]
+            shard["lm_head"] = params["lm_head"]
+        shards.append(shard)
+    return shards
+
+
+def _stage_init(cfg, seed: int, num_stages: int, stage: int):
+    """Stage shard init, bit-identical to slicing ``init_params(cfg,
+    PRNGKey(seed))`` WITHOUT materializing the full model on every stage
+    actor (that spike would defeat the memory motive of pipelining a
+    model that doesn't fit one host): init_params consumes one split key
+    per parameter group (embed=keys[0], pos=keys[1], lm_head=keys[2],
+    block i=keys[3+i]), so building only this stage's groups from the
+    same key layout reproduces the exact tensors."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.transformer import _block_params, _norm_params
+
+    _check_pipeline_cfg(cfg)
+    lo, hi = pipeline_splits(cfg.num_layers, num_stages)[stage]
+    keys = jax.random.split(jax.random.PRNGKey(seed), cfg.num_layers + 3)
+    init = jax.nn.initializers.normal(0.02, cfg.param_dtype)
+    blocks = [_block_params(cfg, keys[3 + i]) for i in range(lo, hi)]
+    shard = {}
+    if cfg.scan_layers:
+        shard["blocks"] = jax.tree.map(
+            lambda *xs: jnp.stack(xs, axis=0), *blocks)
+    else:
+        shard["blocks"] = {str(i): b for i, b in enumerate(blocks)}
+    if stage == 0:
+        shard["embed"] = {
+            "table": init(keys[0], (cfg.vocab_size, cfg.embed_dim))}
+        if cfg.pos == "learned":
+            shard["pos_embed"] = {
+                "table": init(keys[1], (cfg.max_seq_len, cfg.embed_dim))}
+    if stage == num_stages - 1:
+        shard["final_norm"] = _norm_params(cfg, cfg.embed_dim)
+        shard["lm_head"] = {
+            "kernel": init(keys[2], (cfg.embed_dim, cfg.vocab_size))}
+    return shard
+
+
+def _apply_blocks(cfg, blocks, h, n_local: int):
+    """Run one stage's block slice — the same remat/scan structure as
+    transformer.forward, so a split pipeline matches the fused model."""
+    import jax
+    from jax import lax
+
+    from ray_tpu.models.transformer import _block
+    from ray_tpu.ops.rotary import rope_frequencies
+
+    rope = None if cfg.pos == "learned" else rope_frequencies(
+        cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    block_fn = _block
+    if cfg.remat:
+        policies = {
+            "full": jax.checkpoint_policies.nothing_saveable,
+            "dots": jax.checkpoint_policies.checkpoint_dots,
+        }
+        block_fn = jax.checkpoint(
+            _block, static_argnums=(0, 5),
+            policy=policies[cfg.remat_policy])
+    if cfg.scan_layers:
+        def body(carry, layer_params):
+            hh, _, _ = block_fn(cfg, layer_params, carry, rope, None, None)
+            return hh, None
+        h, _ = lax.scan(body, h, blocks)
+    else:
+        for i in range(n_local):
+            h, _, _ = block_fn(cfg, blocks[str(i)], h, rope, None, None)
+    return h
+
+
+def _stage_fwd(cfg, lo: int, hi: int, first: bool, params, x):
+    """Non-last stage forward: tokens -> hidden (stage 0) or
+    hidden -> hidden."""
+    import jax.numpy as jnp
+
+    if first:
+        h = params["embed"]["table"].astype(cfg.dtype)[x]
+        if cfg.pos == "learned":
+            h = h + params["pos_embed"]["table"].astype(
+                cfg.dtype)[jnp.arange(x.shape[1])]
+    else:
+        h = jnp.asarray(x).astype(cfg.dtype)
+    return _apply_blocks(cfg, params["blocks"], h, hi - lo)
+
+
+def _stage_loss(cfg, lo: int, hi: int, params, x, tokens):
+    """Last stage: hidden -> blocks -> final norm -> causal-LM loss
+    (identical math to transformer.loss_fn on the fused model)."""
+    import jax.numpy as jnp
+
+    from ray_tpu.models.transformer import _norm
+
+    h = _apply_blocks(cfg, params["blocks"],
+                      jnp.asarray(x).astype(cfg.dtype), hi - lo)
+    h = _norm(cfg, params["final_norm"], h)
+    targets = tokens[:, 1:]
+    if cfg.fused_ce:
+        from ray_tpu.ops.losses import fused_softmax_cross_entropy
+
+        loss, _ = fused_softmax_cross_entropy(
+            h[:, :-1], params["lm_head"]["kernel"], targets, None,
+            chunk=cfg.ce_chunk, compute_dtype=cfg.dtype,
+            transpose_table=True)
+    else:
+        from ray_tpu.ops.losses import softmax_cross_entropy
+
+        logits = jnp.einsum(
+            "bsd,dv->bsv", h,
+            params["lm_head"]["kernel"].astype(cfg.dtype))
+        loss, _ = softmax_cross_entropy(logits[:, :-1], targets, None)
+    return loss
+
+
+def pipeline_stage_defs(cfg, num_stages: int, *, seed: int = 0):
+    """Partition ``cfg`` into ``num_stages`` stage specs for
+    ``ray_tpu.train.PipelineTrainer``: uniform block split, embedding on
+    stage 0, final-norm + lm_head + loss on the last stage. Each spec is
+    a dict of picklable callables ({"init", "fwd"} / {"init", "loss"});
+    init runs ON the stage actor and re-derives the full model's
+    deterministic init before slicing, so an assembled pipeline matches
+    ``init_params(cfg, PRNGKey(seed))`` exactly."""
+    import functools
+
+    _check_pipeline_cfg(cfg)
+    splits = pipeline_splits(cfg.num_layers, num_stages)
+    defs = []
+    for s, (lo, hi) in enumerate(splits):
+        d = {"init": functools.partial(
+            _stage_init, cfg, seed, num_stages, s)}
+        if s == num_stages - 1:
+            d["loss"] = functools.partial(_stage_loss, cfg, lo, hi)
+        else:
+            d["fwd"] = functools.partial(_stage_fwd, cfg, lo, hi, s == 0)
+        defs.append(d)
+    return defs

@@ -567,7 +567,196 @@ def run_collective_overlap_chaos(
         chaos.reset()
 
 
+def run_pipeline_chaos(
+    seed: int,
+    *,
+    drop_prob: float = 0.02,
+    dup_prob: float = 0.05,
+    delay_prob: float = 0.05,
+    delay_max_ms: int = 20,
+    kills: bool = True,
+) -> None:
+    """One seeded chaos run against the MPMD pipeline trainer.
+
+    Builds a 2-node cluster with the two pipeline stages split across it
+    (every activation/gradient hop is a cross-node mirror push, chunked
+    small so each streams several attacked ``channel_write_chunk`` +
+    ``channel_commit`` frames), then trains a tiny transformer for three
+    steps: every step's loss must MATCH a single-process reference to
+    fp32 tolerance — chaos may cost retries, never a wrong loss (absolute
+    slot-ring versions make dropped/duplicated push frames converge).
+    With ``kills``, a stage actor is then hard-killed mid-flush: the
+    in-flight step must surface a clean ChannelClosedError/ActorDiedError
+    (never a hang, never a silently wrong loss), teardown must unwind,
+    and the driver's channel pins must return to baseline.
+    """
+    import threading
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private import chaos
+    from ray_tpu._private.chaos import FaultController
+    from ray_tpu._private.config import Config
+    from ray_tpu.cluster_utils import Cluster
+
+    # single-process reference trajectory FIRST (pure jax, no cluster)
+    import jax
+    import optax
+
+    from ray_tpu.models import presets
+    from ray_tpu.models.transformer import init_params, loss_fn
+
+    mcfg = presets.llama_debug(
+        num_layers=2, vocab_size=128, max_seq_len=32, embed_dim=32,
+        num_heads=2, num_kv_heads=1, mlp_dim=64)
+    batch = np.random.default_rng(0).integers(
+        0, 128, (16, 16)).astype(np.int32)
+    M = 4
+
+    params = init_params(mcfg, jax.random.PRNGKey(0))
+    opt = optax.sgd(0.05)
+    ost = opt.init(params)
+
+    def mb_loss(p, toks):
+        loss, _ = loss_fn(mcfg, p, {"tokens": toks})
+        return loss
+
+    gfn = jax.jit(jax.value_and_grad(mb_loss))
+    ref_losses = []
+    for _ in range(4):
+        acc, losses = None, []
+        for m in range(M):
+            loss, g = gfn(params, batch[m * 4:(m + 1) * 4])
+            losses.append(float(loss))
+            acc = g if acc is None else jax.tree.map(
+                lambda a, b: a + b, acc, g)
+        grads = jax.tree.map(lambda g: g / M, acc)
+        upd, ost = opt.update(grads, ost, params)
+        params = optax.apply_updates(params, upd)
+        ref_losses.append(float(np.mean(losses)))
+
+    cfg = Config.from_env()
+    cfg.chaos_seed = seed
+    cfg.chaos_drop_prob = drop_prob
+    cfg.chaos_dup_prob = dup_prob
+    cfg.chaos_delay_prob = delay_prob
+    cfg.chaos_delay_max_ms = delay_max_ms
+    cfg.chaos_methods = CHAOS_METHODS
+    # ~8 KB activations stream as several chunk frames per push
+    cfg.object_transfer_chunk_bytes = 2048
+
+    cluster = Cluster(config=cfg)
+    try:
+        cluster.add_node(num_cpus=4, resources={"left": 100})
+        cluster.add_node(num_cpus=4, resources={"right": 100})
+        cluster.wait_for_nodes(2)
+        ray_tpu.init(address=cluster.address)
+        chaos.set_fault_controller(FaultController(
+            seed=seed, drop_prob=drop_prob, dup_prob=dup_prob,
+            delay_prob=delay_prob, delay_max_ms=delay_max_ms,
+            methods=CHAOS_METHODS))
+
+        from ray_tpu._private import api as _api
+        from ray_tpu.train import PipelineTrainer
+
+        def store_pins():
+            core = _api._core
+            stats = core._run(core.clients.get(core.supervisor_addr).call(
+                "store_stats", timeout=60))
+            return stats["pins_total"]
+
+        pins_before = store_pins()
+        trainer = PipelineTrainer(
+            presets.pipeline_stage_defs(mcfg, 2, seed=0),
+            num_microbatches=M, optimizer=("sgd", 0.05),
+            stage_options=[{"resources": {"left": 1}},
+                           {"resources": {"right": 1}}])
+        assert trainer.is_channel_backed and trainer.channel_depth > 1, (
+            "pipeline chaos run is not on the slot-ring channel substrate")
+        for step in range(3):
+            out = trainer.step(batch)
+            assert abs(out["loss"] - ref_losses[step]) < 1e-4, (
+                f"step {step}: pipeline loss {out['loss']} != reference "
+                f"{ref_losses[step]} — chaos corrupted training")
+
+        if kills:
+            # stage kill MID-FLUSH: the in-flight step must fail clean
+            box = {}
+
+            def stepper():
+                try:
+                    box["out"] = trainer.step(batch)
+                except Exception as e:  # noqa: BLE001 — the expected path
+                    box["err"] = e
+
+            t = threading.Thread(target=stepper)
+            t.start()
+            time.sleep(0.05)
+            ray_tpu.kill(trainer._actors[0][1])
+            t.join(timeout=180)
+            assert not t.is_alive(), "step hung after a stage-actor kill"
+            if "err" in box:
+                msg = str(box["err"]).lower()
+                assert ("closed" in msg or "dead" in msg
+                        or "died" in msg), (
+                    f"unclean error after stage kill: {box['err']!r}")
+            else:
+                # the kill landed after the flush completed: the loss
+                # must still be exact, and the NEXT step must fail clean
+                assert abs(box["out"]["loss"] - ref_losses[3]) < 1e-4, (
+                    "post-kill completed step returned a wrong loss")
+                try:
+                    trainer.step(batch)
+                    raise AssertionError(
+                        "step with a dead stage returned instead of "
+                        "raising")
+                except AssertionError:
+                    raise
+                except Exception as e:  # noqa: BLE001 — expected
+                    msg = str(e).lower()
+                    assert ("closed" in msg or "dead" in msg
+                            or "died" in msg), (
+                        f"unclean error after stage kill: {e!r}")
+        trainer.shutdown()
+
+        # pins back to baseline. The release RPCs run under the same
+        # fault schedule, so a dropped unpin falls back to the bulk
+        # release path a departing driver uses (one RPC per node).
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and store_pins() != pins_before:
+            time.sleep(0.3)
+        if store_pins() != pins_before:
+            core = _api._core
+            for _ in range(3):
+                try:
+                    core._run(core.clients.get(core.supervisor_addr).call(
+                        "store_release_client",
+                        {"client": core._store_client_id}, timeout=10))
+                    break
+                except Exception:
+                    continue
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline \
+                    and store_pins() != pins_before:
+                time.sleep(0.3)
+        assert store_pins() == pins_before, (
+            "pipeline channel pins did not return to baseline")
+    finally:
+        chaos.set_fault_controller(None)  # calm teardown
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        cluster.shutdown()
+        chaos.reset()
+
+
 def _run_one(seed: int, args) -> None:
+    if args.pipeline:
+        run_pipeline_chaos(
+            seed,
+            drop_prob=args.drop, dup_prob=args.dup, delay_prob=args.delay,
+            delay_max_ms=args.delay_max_ms, kills=not args.no_kills)
+        return
     if args.collective_overlap:
         run_collective_overlap_chaos(
             seed,
@@ -609,6 +798,12 @@ def main() -> int:
                              "in-flight allreduce_coalesced_async handles "
                              "with out-of-order waits under drop/dup/delay "
                              "+ a participant kill mid-flight")
+    parser.add_argument("--pipeline", action="store_true",
+                        help="attack the MPMD pipeline trainer: cross-node "
+                             "1F1B microbatch pushes (chunked channel "
+                             "frames) under drop/dup/delay must train to "
+                             "EXACT reference losses; a mid-flush stage "
+                             "kill must fail clean and unwind")
     args = parser.parse_args()
 
     if args.one is not None:
@@ -631,6 +826,8 @@ def main() -> int:
             child.append("--collective")
         if args.collective_overlap:
             child.append("--collective-overlap")
+        if args.pipeline:
+            child.append("--pipeline")
         proc = subprocess.run(child)
         took = time.monotonic() - t0
         if proc.returncode != 0:

@@ -20,6 +20,10 @@ from ray_tpu.train._internal.session import (  # noqa: F401
     report,
 )
 from ray_tpu.train._internal.gradients import GradientAverager  # noqa: F401
+from ray_tpu.train._internal.pipeline import (  # noqa: F401
+    PipelineTrainer,
+    StageSpec,
+)
 from ray_tpu.train.backend import Backend, BackendConfig, JaxConfig  # noqa: F401
 from ray_tpu.train.trainer import (  # noqa: F401
     BaseTrainer,

@@ -378,3 +378,182 @@ class TestFailureUnwinding:
         assert _store_pins(core) == pins_before, (
             "pins leaked after participant death + teardown")
         ray_tpu.kill(a)
+
+
+class TestMultiSlotChannels:
+    """Depth-k slot-ring protocol (PR 8): capacity becomes k in-flight
+    steps — the 1F1B pipeline requirement — while depth=1 stays the
+    original one-step seqlock bit-for-bit."""
+
+    def _make(self, depth, n_readers=1, buf=64):
+        from ray_tpu._private import channels
+
+        size = channels.total_size(buf, depth)
+        arena = _FakeArena(size)
+        channels.init_header(arena, 0, n_readers, depth=depth)
+        spec = channels.ChannelSpec(
+            channel_id=b"\x07" * 16, node_addr=("h", 1), offset=0,
+            size=size, n_readers=n_readers, depth=depth)
+        return arena, spec, channels.LocalChannel(arena, spec)
+
+    def test_depth1_header_is_byte_identical(self):
+        """init_header(depth=1) must leave the exact legacy layout: the
+        depth word stays ZERO (a pre-ring reader treats the range as the
+        one-slot protocol) and a write puts payload/length/version in
+        the legacy offsets."""
+        import struct
+
+        from ray_tpu._private import channels
+
+        arena, spec, ch = self._make(1)
+        hdr = bytes(arena.view(0, channels.HEADER_SIZE))
+        assert struct.unpack_from("<Q", hdr, 104)[0] == 0  # depth word
+        assert ch.depth == 1 and ch.capacity == 64
+        ch.write(b"abc", 2, timeout=1)
+        hdr = bytes(arena.view(0, channels.HEADER_SIZE))
+        assert struct.unpack_from("<Q", hdr, 16)[0] == 2   # version
+        assert struct.unpack_from("<Q", hdr, 24)[0] == 3   # length
+        # payload directly after the header — no slot directory
+        assert bytes(arena.view(channels.HEADER_SIZE, 3)) == b"abc"
+
+    def test_writer_blocks_only_when_all_slots_unacked(self):
+        """A depth-k writer commits k versions ack-free; the k+1-th
+        blocks; ONE ack frees exactly ONE slot."""
+        _, _, ch = self._make(3)
+        for n in (2, 4, 6):
+            ch.write(b"x%d" % n, n, timeout=1)
+        with pytest.raises(TimeoutError):
+            ch.write(b"x8", 8, timeout=0.1)
+        ch.ack(0, 2)  # frees v2's slot only
+        ch.write(b"x8", 8, timeout=1)
+        with pytest.raises(TimeoutError):
+            ch.write(b"x10", 10, timeout=0.1)
+
+    def test_committed_slots_stay_readable_while_writer_runs_ahead(self):
+        """Per-slot versions: step N stays readable after the writer
+        committed N+1 .. N+k-1 (the depth-1 protocol overwrote the one
+        payload area, forcing lockstep)."""
+        _, _, ch = self._make(4)
+        for n in range(1, 5):
+            ch.write(f"v{n}".encode(), 2 * n, timeout=1)
+        for n in range(1, 5):  # read back in order, ack as we go
+            assert bytes(ch.read(2 * n, timeout=1)) == f"v{n}".encode()
+            ch.ack(0, 2 * n)
+        ch.write(b"v5", 10, timeout=1)
+        assert bytes(ch.read(10, timeout=1)) == b"v5"
+
+    @pytest.mark.parametrize("depth", [1, 2, 4])
+    def test_close_mid_wait_raises_at_every_depth(self, depth):
+        import threading
+
+        from ray_tpu._private.exceptions import ChannelClosedError as CCE
+
+        _, _, ch = self._make(depth)
+        # fill the ring so the next write blocks
+        for n in range(1, depth + 1):
+            ch.write(b"p", 2 * n, timeout=1)
+        errs = []
+
+        def blocked_writer():
+            try:
+                ch.write(b"q", 2 * (depth + 1), timeout=10)
+            except CCE:
+                errs.append("writer")
+
+        def blocked_reader():
+            try:
+                ch.read(2 * (depth + 5), timeout=10)
+            except CCE:
+                errs.append("reader")
+
+        ts = [threading.Thread(target=blocked_writer),
+              threading.Thread(target=blocked_reader)]
+        for t in ts:
+            t.start()
+        time.sleep(0.1)
+        ch.close()
+        for t in ts:
+            t.join(timeout=5)
+        assert sorted(errs) == ["reader", "writer"]
+
+    def test_mirror_push_dup_converges_per_slot(self):
+        """The supervisor-side push path at depth > 1: absolute versions
+        land in their own slots, a duplicated/retried frame of an older
+        version is dropped by the committed-version dedup (the slot
+        still holding exactly its own payload), and a chunked push
+        stages into the right slot."""
+        from ray_tpu._private import channels
+
+        depth, buf = 2, 16
+        size = channels.total_size(buf, depth)
+        arena = _FakeArena(size)
+        channels.init_header(arena, 0, 1, depth=depth)
+        spec = channels.ChannelSpec(
+            channel_id=b"\x08" * 16, node_addr=("h", 1), offset=0,
+            size=size, n_readers=1, depth=depth)
+        reader = channels.LocalChannel(arena, spec)
+
+        assert channels.readers_ready(arena, 0, 2)
+        channels.host_write_commit(arena, 0, size, b"push2", 2)
+        assert channels.readers_ready(arena, 0, 4)  # second slot free
+        channels.host_write_commit(arena, 0, size, b"push4", 4)
+        # v6 must WAIT: its slot is v2's, unacked
+        assert not channels.readers_ready(arena, 0, 6)
+        # duplicate delivery of v2 after v4 committed: the rpc handler's
+        # dedup (committed >= version) drops it before any write
+        _, committed, _ = channels.read_header(arena, 0)
+        assert committed == 4 >= 2
+        assert bytes(reader.read(2, timeout=1)) == b"push2"
+        reader.ack(0, 2)
+        assert bytes(reader.read(4, timeout=1)) == b"push4"
+        reader.ack(0, 4)
+        # chunked push of v6 reuses v2's slot
+        assert channels.readers_ready(arena, 0, 6)
+        channels.host_write_chunk(arena, 0, size, 6, 0, b"chu")
+        channels.host_write_chunk(arena, 0, size, 6, 3, b"nk6")
+        channels.host_commit(arena, 0, size, 6, 6)
+        assert bytes(reader.read(6, timeout=1)) == b"chunk6"
+
+    def test_mirror_push_rejects_oversized_payload(self):
+        """The cross-node push path must enforce per-slot capacity like
+        LocalChannel.write: slots are contiguous, so an unchecked
+        oversized stream would overwrite the NEXT slot's committed
+        payload on the remote side (silent wrong data)."""
+        import types
+
+        from ray_tpu._private import channels
+
+        size = channels.total_size(16, 2)
+        spec = channels.ChannelSpec(
+            channel_id=b"\x09" * 16, node_addr=("far", 1), offset=0,
+            size=size, n_readers=1, depth=2)
+        core = types.SimpleNamespace(config=types.SimpleNamespace(
+            object_transfer_chunk_bytes=4, object_transfer_window=2,
+            channel_remote_timeout_s=1.0))
+        mw = channels.MirrorWriter(core, spec)
+        assert mw.capacity == 16
+        with pytest.raises(ValueError, match="exceeds"):
+            mw.push(b"x" * 17, 2)  # raises before touching transport
+
+    def test_compiled_dag_pipelines_at_depth(self, ray_init):
+        """experimental_compile(depth=k) lets the driver run k steps
+        ahead of the matching get()s; results stay ordered and
+        per-step correct, and depth=1 graphs are untouched."""
+        a, b = Stage.remote(2), Stage.remote(3)
+        _alive(a, b)
+        with InputNode() as inp:
+            dag = b.mul.bind(a.mul.bind(inp))
+        compiled = dag.experimental_compile(depth=3)
+        try:
+            assert compiled.is_channel_backed
+            assert compiled.channel_depth == 3
+            # submit 3 executes BEFORE any get: with depth 1 the third
+            # write would deadlock against the unconsumed outputs
+            refs = [compiled.execute(i) for i in range(3)]
+            assert [r.get(timeout=30) for r in refs] == [0, 6, 12]
+            refs = [compiled.execute(i) for i in range(10, 13)]
+            assert ray_tpu.get(refs, timeout=30) == [60, 66, 72]
+        finally:
+            compiled.teardown()
+        ray_tpu.kill(a)
+        ray_tpu.kill(b)

@@ -1,0 +1,324 @@
+"""MPMD pipeline-parallel trainer: 1F1B microbatches over channels.
+
+The contracts under test (ISSUE 8 / ROADMAP item 1):
+  * parity — the S-stage pipeline's per-step loss matches a
+    single-process forward/backward + SGD to fp32 tolerance (and the
+    task-per-stage baseline matches both);
+  * the steady-state microbatch step is ZERO control-plane RPCs per
+    stage rank, proven by the ray_tpu_rpc_client_calls_total deltas
+    each stage's flush report carries (not wall-clock);
+  * channels are slot-ring backed at depth > 1 (1F1B would serialize at
+    depth 1), and teardown returns every pin;
+  * a stage-actor death mid-training surfaces as a clean
+    ChannelClosedError/ActorDiedError — never a wrong loss.
+
+Stage actors are DEDICATED while the run loop lives, so each test builds
+a fresh trainer and shuts it down.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import ChannelClosedError
+
+
+def _tiny_cfg(num_layers=2):
+    from ray_tpu.models import presets
+
+    return presets.llama_debug(
+        num_layers=num_layers, vocab_size=128, max_seq_len=32,
+        embed_dim=32, num_heads=2, num_kv_heads=1, mlp_dim=64)
+
+
+def _batch(n=8, seq=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 128, (n, seq)).astype(np.int32)
+
+
+def _local_losses(cfg, batch, num_microbatches, steps, lr=0.05):
+    """Single-process reference: per-microbatch value_and_grad, grads
+    averaged over the SAME microbatch split, optax SGD."""
+    import jax
+    import optax
+
+    from ray_tpu.models.transformer import init_params, loss_fn
+
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    opt = optax.sgd(lr)
+    ost = opt.init(params)
+
+    def mb_loss(p, toks):
+        loss, _ = loss_fn(cfg, p, {"tokens": toks})
+        return loss
+
+    gfn = jax.jit(jax.value_and_grad(mb_loss))
+    mb = batch.shape[0] // num_microbatches
+    out = []
+    for _ in range(steps):
+        acc, losses = None, []
+        for m in range(num_microbatches):
+            loss, g = gfn(params, batch[m * mb:(m + 1) * mb])
+            losses.append(float(loss))
+            acc = g if acc is None else jax.tree.map(
+                lambda a, b: a + b, acc, g)
+        grads = jax.tree.map(lambda g: g / num_microbatches, acc)
+        upd, ost = opt.update(grads, ost, params)
+        params = optax.apply_updates(params, upd)
+        out.append(float(np.mean(losses)))
+    return out
+
+
+def _store_pins(core):
+    stats = core._run(core.clients.get(core.supervisor_addr).call(
+        "store_stats"))
+    return stats["pins_total"]
+
+
+class TestPipelineParity:
+    def test_two_stage_matches_local_training(self, ray_init):
+        """S=2 1F1B pipeline vs the fused single-process model: same
+        init, same microbatch split, same SGD — losses must match to
+        fp32 tolerance every step, and training must make progress."""
+        from ray_tpu.models import presets
+        from ray_tpu.train import PipelineTrainer
+
+        cfg = _tiny_cfg()
+        batch = _batch()
+        ref = _local_losses(cfg, batch, num_microbatches=4, steps=3)
+        trainer = PipelineTrainer(
+            presets.pipeline_stage_defs(cfg, 2, seed=0),
+            num_microbatches=4, optimizer=("sgd", 0.05))
+        try:
+            assert trainer.is_channel_backed
+            assert trainer.channel_depth > 1, (
+                "1F1B must compile slot-ring channels, not the "
+                "one-step protocol")
+            got = [trainer.step(batch)["loss"] for _ in range(3)]
+        finally:
+            trainer.shutdown()
+        assert np.allclose(got, ref, atol=1e-5), (got, ref)
+        assert got[-1] < got[0], "no training progress on a fixed batch"
+
+    def test_task_per_stage_baseline_matches(self, ray_init):
+        """mode='tasks' routes the same stage math through dynamic actor
+        calls + the object store — the microbenchmark baseline must be
+        numerically identical, not merely similar."""
+        from ray_tpu.models import presets
+        from ray_tpu.train import PipelineTrainer
+
+        cfg = _tiny_cfg()
+        batch = _batch()
+        ref = _local_losses(cfg, batch, num_microbatches=2, steps=2)
+        trainer = PipelineTrainer(
+            presets.pipeline_stage_defs(cfg, 2, seed=0),
+            num_microbatches=2, mode="tasks", optimizer=("sgd", 0.05))
+        try:
+            assert not trainer.is_channel_backed
+            assert trainer.channel_depth == 0
+            got = [trainer.step(batch)["loss"] for _ in range(2)]
+        finally:
+            trainer.shutdown()
+        assert np.allclose(got, ref, atol=1e-5), (got, ref)
+
+    @pytest.mark.slow
+    def test_dp2_replicas_match_local(self, ray_init):
+        """dp=2 with both replicas fed the same data: the flush-time
+        coalesced-mean allreduce over the p2p collective layer must
+        reproduce the single-replica trajectory exactly."""
+        from ray_tpu.models import presets
+        from ray_tpu.train import PipelineTrainer
+
+        cfg = _tiny_cfg()
+        batch = _batch()
+        ref = _local_losses(cfg, batch, num_microbatches=2, steps=2)
+        trainer = PipelineTrainer(
+            presets.pipeline_stage_defs(cfg, 2, seed=0),
+            num_microbatches=2, dp=2, optimizer=("sgd", 0.05))
+        try:
+            both = np.concatenate([batch, batch])
+            got = [trainer.step(both)["loss"] for _ in range(2)]
+        finally:
+            trainer.shutdown()
+        assert np.allclose(got, ref, atol=1e-5), (got, ref)
+
+
+class TestPipelineContracts:
+    @pytest.mark.perf
+    def test_steady_flush_is_zero_control_rpcs_per_stage(self, ray_init):
+        """THE contract: after warmup, a whole flush (M microbatches of
+        fwd+bwd + the optimizer step) costs channel ops and local
+        compute only. Each stage rank measures its OWN outbound-RPC
+        counter around the flush and ships the delta in its report."""
+        from ray_tpu._private.rpc import _m_client_calls
+        from ray_tpu.models import presets
+        from ray_tpu.train import PipelineTrainer
+        from ray_tpu.train._internal import pipeline as pl
+
+        cfg = _tiny_cfg(num_layers=3)
+        batch = _batch()
+        trainer = PipelineTrainer(
+            presets.pipeline_stage_defs(cfg, 3, seed=0),
+            num_microbatches=4, optimizer=("sgd", 0.05))
+        try:
+            trainer.step(batch)  # warm: jits compiled, pins taken
+            driver_before = _m_client_calls.total()
+            out = None
+            for _ in range(3):
+                out = trainer.step(batch)
+                for rep in out["reports"]:
+                    assert rep["rpc_calls"] == 0, (
+                        f"stage {rep['stage']} issued "
+                        f"{rep['rpc_calls']} control-plane RPCs in a "
+                        f"steady flush")
+            # driver side too: 2M input writes + S report reads, no RPCs
+            assert _m_client_calls.total() == driver_before
+            # satellite metrics moved in each STAGE's registry (the
+            # report carries that rank's values: counters are
+            # per-process, so the driver's registry can't see them)
+            for rep in out["reports"]:
+                m = rep["metrics"]
+                assert m["microbatches_total"] == 4 * 4  # 4 flushes x M
+                assert m["flushes_total"] == 4
+                assert m["stage_seconds_count"] >= 4
+                assert 0.0 <= rep["bubble_fraction"] <= 1.0
+        finally:
+            trainer.shutdown()
+
+    def test_teardown_releases_pins_and_channels(self, ray_init):
+        import gc
+
+        from ray_tpu._private import api
+        from ray_tpu.models import presets
+        from ray_tpu.train import PipelineTrainer
+
+        core = api._core
+        gc.collect()
+        time.sleep(0.3)
+        pins_before = _store_pins(core)
+        cfg = _tiny_cfg()
+        trainer = PipelineTrainer(
+            presets.pipeline_stage_defs(cfg, 2, seed=0),
+            num_microbatches=2, optimizer=("sgd", 0.05))
+        trainer.step(_batch())
+        assert _store_pins(core) > pins_before  # channels are pinned
+        trainer.shutdown()
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if _store_pins(core) == pins_before:
+                break
+            time.sleep(0.2)
+        assert _store_pins(core) == pins_before, "pipeline leaked pins"
+        with pytest.raises(ChannelClosedError):
+            trainer.step(_batch())
+
+    def test_stage_death_surfaces_cleanly(self, ray_init):
+        """Killing a stage actor mid-training must yield a clean
+        ChannelClosedError/ActorDiedError at the driver (and close every
+        channel) — never a hang, never a wrong loss."""
+        from ray_tpu._private.exceptions import ActorDiedError
+        from ray_tpu.models import presets
+        from ray_tpu.train import PipelineTrainer
+
+        cfg = _tiny_cfg()
+        trainer = PipelineTrainer(
+            presets.pipeline_stage_defs(cfg, 2, seed=0),
+            num_microbatches=2, optimizer=("sgd", 0.05))
+        batch = _batch()
+        trainer.step(batch)
+        ray_tpu.kill(trainer._actors[0][1])
+        with pytest.raises((ChannelClosedError, ActorDiedError)):
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                trainer.step(batch)
+        trainer.shutdown()
+
+    def test_stage_exception_propagates_instead_of_hanging(self, ray_init):
+        """A stage raising with its ACTOR STILL ALIVE (no supervisor
+        death fan-out) must still unwind the whole pipeline: each loop
+        re-fans the close out on exit, so the driver's untimed report
+        read raises instead of parking forever. Trigger: activations
+        exceed the per-slot channel buffer, so stage 0's write raises
+        mid-flush."""
+        from ray_tpu.models import presets
+        from ray_tpu.train import PipelineTrainer
+
+        cfg = _tiny_cfg()
+        trainer = PipelineTrainer(
+            presets.pipeline_stage_defs(cfg, 2, seed=0),
+            num_microbatches=2, optimizer=("sgd", 0.05),
+            buffer_bytes=1024)  # tokens fit; [mb,16,32] f32 acts do not
+        t0 = time.monotonic()
+        try:
+            with pytest.raises(Exception, match="exceeds|closed|dead"):
+                trainer.step(_batch())
+            assert time.monotonic() - t0 < 60, "step hung on stage error"
+        finally:
+            trainer.shutdown()
+
+    def test_batch_not_divisible_raises(self, ray_init):
+        from ray_tpu.models import presets
+        from ray_tpu.train import PipelineTrainer
+
+        cfg = _tiny_cfg()
+        trainer = PipelineTrainer(
+            presets.pipeline_stage_defs(cfg, 2, seed=0),
+            num_microbatches=3, optimizer=("sgd", 0.05))
+        try:
+            with pytest.raises(ValueError, match="divisible"):
+                trainer.step(_batch(n=8))
+        finally:
+            trainer.shutdown()
+
+
+class TestStagePartition:
+    def test_splits_are_uniform_and_cover(self):
+        from ray_tpu.models.presets import pipeline_splits
+
+        splits = pipeline_splits(13, 4)
+        assert splits[0][0] == 0 and splits[-1][1] == 13
+        sizes = [hi - lo for lo, hi in splits]
+        assert sum(sizes) == 13
+        assert max(sizes) - min(sizes) <= 1
+        for (_, a), (b, _) in zip(splits, splits[1:]):
+            assert a == b
+        with pytest.raises(ValueError, match="stages"):
+            pipeline_splits(3, 1)
+        with pytest.raises(ValueError, match="split"):
+            pipeline_splits(2, 3)
+
+    def test_partition_rejects_tied_embeddings_and_moe(self):
+        from ray_tpu.models import presets
+
+        tied = presets.llama_debug(num_layers=2, tie_embeddings=True)
+        with pytest.raises(ValueError, match="tie_embeddings"):
+            presets.pipeline_stage_defs(tied, 2)
+        moe = presets.moe_debug()
+        with pytest.raises(ValueError, match="moe"):
+            presets.pipeline_stage_defs(moe, 2)
+
+    def test_stage_composition_matches_fused_model(self):
+        """Pure-jax parity (no cluster): composing the S stage fns
+        reproduces the fused forward loss exactly, and the assembled
+        shards cover the full param tree."""
+        import jax
+
+        from ray_tpu.models import presets
+        from ray_tpu.models.transformer import (count_params, init_params,
+                                                loss_fn)
+
+        cfg = _tiny_cfg()
+        defs = presets.pipeline_stage_defs(cfg, 2, seed=0)
+        shards = [d["init"]() for d in defs]
+        tokens = _batch(4, 16)
+        x = tokens
+        for d, p in zip(defs[:-1], shards[:-1]):
+            x = d["fwd"](p, x)
+        loss = defs[-1]["loss"](shards[-1], x, tokens)
+        ref, _ = loss_fn(cfg, init_params(cfg, jax.random.PRNGKey(0)),
+                         {"tokens": tokens})
+        assert abs(float(loss) - float(ref)) < 1e-5
+        full = count_params(init_params(cfg, jax.random.PRNGKey(0)))
+        assert sum(count_params(s) for s in shards) == full
