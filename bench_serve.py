@@ -1,25 +1,40 @@
 """Serve-decode throughput harness: batched autoregressive decode on the
 local chip (the BASELINE "Serve-equivalent LLM deployment ... batched
-replica throughput" row).
+replica throughput" row) plus the ISSUE-9 open-loop load generator.
 
-Measures the jitted prefill + per-token decode loop from
-`ray_tpu.models.decode` — the exact program a Serve LLM replica runs per
-`@serve.batch` flush (serve/llm.py) — across batch sizes, and prints ONE
-JSON line with the peak batched decode rate:
+Modes:
 
-    python bench_serve.py [--preset gpt2_small] [--prompt-len 128]
-                          [--new-tokens 64]
+  * default — the jitted prefill + per-token decode loop from
+    `ray_tpu.models.decode` across batch sizes (raw device decode
+    capacity);
+  * --serve — end-to-end through a live Serve deployment (router ->
+    replica -> continuous scheduler);
+  * --loadgen — OPEN-LOOP load generator against the replica serve path:
+    Poisson arrivals, mixed prompt lengths, heavy-tailed per-request
+    `max_new_tokens`; drives BOTH the continuous (iteration-level)
+    scheduler and the request-level `@serve.batch` baseline at the same
+    offered load and reports p50/p99 TTFT, p50/p99 inter-token latency,
+    and useful tokens/s for each, plus the continuous/baseline ratios.
+    Records carry the PR-6 TPU-probe provenance fields (`tpu_lost`,
+    `tpu_probe_ok`, `tpu_probe_attempts`, `device`) so CPU-smoke numbers
+    are distinguishable from regressions.
 
-vs_baseline is decode tokens/s at the best batch divided by 1000 (a
-single-GPU 7B-class continuous-batching serving rate is O(1000) tok/s;
-the debug-size model here is smaller, so treat it as a scale probe, not
-a model-for-model comparison).
+    python bench_serve.py --loadgen [--rate 20] [--requests 60]
+                          [--seed 0] [--json-out SERVE_BENCH.json]
+
+vs_baseline of the default mode is decode tokens/s at the best batch
+divided by 1000 (a single-GPU 7B-class continuous-batching serving rate
+is O(1000) tok/s; the debug-size model here is smaller, so treat it as a
+scale probe, not a model-for-model comparison).
 """
 
 from __future__ import annotations
 
 import argparse
+import asyncio
 import json
+import os
+import sys
 import time
 
 
@@ -124,6 +139,190 @@ def bench_serve_path(preset: str, new_tokens: int, concurrency: int,
         ray_tpu.shutdown()
 
 
+# ---------------------------------------------------------------- loadgen
+
+
+def _probe_provenance(log) -> dict:
+    """The PR-6 acquisition-provenance fields. When JAX is pinned to CPU
+    the run is a deliberate CPU smoke (`tpu_lost: false`, no probe burned);
+    otherwise run bench.py's hardened acquire_tpu (sweep + retries)."""
+    prov = {"tpu_probe_ok": False, "tpu_probe_attempts": 0,
+            "tpu_lost": False}
+    forced_cpu = "cpu" in os.environ.get("JAX_PLATFORMS", "").lower()
+    prov["forced_cpu"] = forced_cpu
+    if not forced_cpu:
+        try:
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from bench import acquire_tpu
+
+            ok, attempts = acquire_tpu(log)
+            prov.update(tpu_probe_ok=bool(ok),
+                        tpu_probe_attempts=int(attempts),
+                        tpu_lost=not bool(ok))
+        except Exception as e:  # probe machinery missing ≠ a valid TPU run
+            log(f"tpu probe unavailable ({e!r}); treating as lost")
+            prov["tpu_lost"] = True
+    import jax
+
+    d = jax.devices()[0]
+    prov["device"] = str(getattr(d, "platform", "cpu"))
+    prov["device_kind"] = str(getattr(d, "device_kind", "cpu"))
+    return prov
+
+
+def _percentiles(xs, unit_scale=1e3):
+    import numpy as np
+
+    if not xs:
+        return {"p50": None, "p99": None}
+    a = np.asarray(xs, float) * unit_scale
+    return {"p50": round(float(np.percentile(a, 50)), 2),
+            "p99": round(float(np.percentile(a, 99)), 2)}
+
+
+def _make_load(seed: int, n: int, rate_rps: float, new_tokens_cap: int):
+    """The offered load: Poisson arrivals, mixed prompt lengths, heavy-
+    tailed (Pareto) per-request generation budgets — the shape that makes
+    flush-and-drain batching pathological (one long request pins its whole
+    flush; queued requests wait a full generation)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_rps, size=n))
+    lens = rng.choice([4, 12, 24, 40], size=n, p=[0.35, 0.35, 0.2, 0.1])
+    letters = "abcdefghijklmnopqrstuvwxyz"
+    prompts = ["".join(rng.choice(list(letters), size=int(L)))
+               for L in lens]
+    budgets = [int(min(new_tokens_cap, 1 + round(4 * rng.pareto(1.5))))
+               for _ in range(n)]
+    return list(zip(arrivals.tolist(), prompts, budgets))
+
+
+async def _drive_open_loop(server, load, streaming: bool):
+    """Replay the arrival schedule against one replica callable. Streaming
+    consumption measures true TTFT/inter-token latency; non-streaming
+    (the flush-and-drain baseline delivers every token at completion)
+    records completion time as the first-token time — which IS that
+    path's honest TTFT."""
+    results = []
+    loop = asyncio.get_running_loop()
+    t_start = loop.time()
+
+    async def one(at, prompt, budget):
+        await asyncio.sleep(max(0.0, t_start + at - loop.time()))
+        t0 = time.perf_counter()
+        times = []
+        if streaming:
+            gen = await server({"prompt": prompt, "stream": True,
+                                "max_new_tokens": budget})
+            async for _chunk in gen:
+                times.append(time.perf_counter())
+        else:
+            out = await server({"prompt": prompt,
+                                "max_new_tokens": budget})
+            times = [time.perf_counter()] * out["num_tokens"]
+        results.append({"t0": t0, "times": times})
+
+    await asyncio.gather(*[one(*req) for req in load])
+    wall = max(r["times"][-1] for r in results) - min(
+        r["t0"] for r in results)
+    ttfts = [r["times"][0] - r["t0"] for r in results]
+    itls = [b - a for r in results if streaming
+            for a, b in zip(r["times"], r["times"][1:])]
+    tokens = sum(len(r["times"]) for r in results)
+    return {"wall_s": round(wall, 3), "tokens": tokens,
+            "tokens_per_sec": round(tokens / wall, 1),
+            "requests": len(results),
+            "ttft_ms": _percentiles(ttfts),
+            "inter_token_ms": _percentiles(itls)}
+
+
+def run_loadgen(mode: str, preset: str, rate_rps: float, n: int, seed: int,
+                *, slots: int = 8, prefill_chunk: int = 16,
+                new_tokens_cap: int = 48) -> dict:
+    """One open-loop run against a directly-instantiated replica callable
+    (the serve path minus transport: scheduler + jitted programs — what
+    the ISSUE-9 comparison is about). mode: "continuous" | "batch"."""
+    from ray_tpu.serve.llm import LLMServerImpl
+
+    server = LLMServerImpl(
+        preset=preset, max_new_tokens=new_tokens_cap, scheduler=mode,
+        slots=slots, prefill_chunk=prefill_chunk, share_weights=False,
+        max_batch_size=slots)
+    try:
+        load = _make_load(seed, n, rate_rps, new_tokens_cap)
+        # warmup = a full replay of the SAME load, off the clock: the
+        # request-level baseline compiles one program per (batch, length,
+        # steps) shape its flushes happen to form — measuring its shape-
+        # churn compiles would flatter the continuous path (which compiles
+        # exactly two programs) for the wrong reason on CPU
+        asyncio.run(_drive_open_loop(
+            server, load, streaming=(mode == "continuous")))
+        out = asyncio.run(_drive_open_loop(
+            server, load, streaming=(mode == "continuous")))
+        out["scheduler"] = server.scheduler_stats()
+        if mode == "continuous":
+            st = out["scheduler"]
+            # fallback guard: the ITERATION-LEVEL path must have engaged —
+            # a silent fall-back to flush-and-drain cannot vacuously pass
+            assert st["mode"] == "continuous", st
+            assert st["admitted_mid_flight"] > 0, (
+                "no request was admitted mid-generation; the open-loop "
+                f"load never exercised continuous batching: {st}")
+        return out
+    finally:
+        server.shutdown()
+
+
+def loadgen_main(args) -> None:
+    log = lambda m: print(f"bench_serve: {m}", file=sys.stderr)  # noqa: E731
+    prov = _probe_provenance(log)
+    cont = run_loadgen("continuous", args.preset, args.rate, args.requests,
+                       args.seed, slots=args.slots,
+                       new_tokens_cap=args.new_tokens_cap)
+    base = run_loadgen("batch", args.preset, args.rate, args.requests,
+                       args.seed, slots=args.slots,
+                       new_tokens_cap=args.new_tokens_cap)
+    speedup = cont["tokens_per_sec"] / max(base["tokens_per_sec"], 1e-9)
+    ttft_ratio = (base["ttft_ms"]["p99"] or 0.0) / max(
+        cont["ttft_ms"]["p99"] or 1e-9, 1e-9)
+    load_detail = {"rate_rps": args.rate, "requests": args.requests,
+                   "seed": args.seed, "slots": args.slots,
+                   "preset": args.preset,
+                   "new_tokens_cap": args.new_tokens_cap,
+                   "arrivals": "poisson",
+                   "new_tokens_dist": "1+4*pareto(1.5), capped"}
+    records = [
+        {"metric": "serve_loadgen_continuous_tokens_per_sec",
+         "value": cont["tokens_per_sec"], "unit": "tokens/s",
+         "detail": {**cont, **load_detail, **prov}},
+        {"metric": "serve_loadgen_request_batch_tokens_per_sec",
+         "value": base["tokens_per_sec"], "unit": "tokens/s",
+         "detail": {**base, **load_detail, **prov}},
+        {"metric": "serve_continuous_speedup",
+         "value": round(speedup, 2), "unit": "x",
+         "detail": {"p99_ttft_improvement_x": round(ttft_ratio, 2),
+                    "continuous_p99_ttft_ms": cont["ttft_ms"]["p99"],
+                    "baseline_p99_ttft_ms": base["ttft_ms"]["p99"],
+                    "continuous_p50_ttft_ms": cont["ttft_ms"]["p50"],
+                    "baseline_p50_ttft_ms": base["ttft_ms"]["p50"],
+                    **load_detail, **prov}},
+    ]
+    for rec in records:
+        print(json.dumps(rec))
+    if args.json_out:
+        doc = {
+            "suite": "serve_llm_continuous_batching",
+            "captured": time.strftime("%Y-%m-%d %H:%M:%S"),
+            "host": __import__("platform").platform(),
+            "provenance": prov,
+            "records": records,
+        }
+        with open(args.json_out, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--preset", default="gpt2_small")
@@ -132,9 +331,30 @@ def main(argv=None) -> None:
     ap.add_argument("--serve", action="store_true",
                     help="drive the full Serve deployment (continuous "
                          "batching) instead of the raw decode program")
+    ap.add_argument("--loadgen", action="store_true",
+                    help="open-loop load generator: continuous vs "
+                         "request-level batching at the same offered load")
+    ap.add_argument("--rate", type=float, default=75.0,
+                    help="loadgen Poisson arrival rate (req/s); the "
+                         "default saturates the request-level baseline "
+                         "on a CPU host so the capacity gap is visible")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--new-tokens-cap", type=int, default=48)
+    ap.add_argument("--json-out", default="",
+                    help="also write the full loadgen suite to this file")
     ap.add_argument("--concurrency", type=int, default=16)
-    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--requests", type=int, default=None,
+                    help="total requests (default: 150 loadgen, 64 serve)")
     args = ap.parse_args(argv)
+    if args.requests is None:
+        args.requests = 150 if args.loadgen else 64
+
+    if args.loadgen:
+        if args.preset == "gpt2_small":
+            args.preset = "llama_debug"  # loadgen default: runnable anywhere
+        loadgen_main(args)
+        return
 
     if args.serve:
         detail = bench_serve_path(args.preset, args.new_tokens,

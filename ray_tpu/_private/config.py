@@ -103,6 +103,15 @@ class Config:
     # training (train.PipelineTrainer) needs > 1 so a stage can run
     # microbatches ahead of its consumer (1F1B)
     channel_depth: int = 1
+    # ---- serve: continuous (iteration-level) batching ----
+    # KV-arena sequence slots per LLM replica: the fixed batch width of the
+    # jitted decode step (serve/_private/continuous.py). More slots = more
+    # in-flight sequences per program at the cost of arena memory
+    serve_slots: int = 8
+    # prefill chunk width: prompts prefill into their slot at most this
+    # many tokens between decode iterations, so a long prompt cannot stall
+    # the in-flight decodes of other slots
+    serve_prefill_chunk: int = 32
     # total budget for one cross-node per-step push (chunk window +
     # commit); the commit side also waits for remote reader acks under it
     channel_remote_timeout_s: float = 120.0

@@ -94,6 +94,114 @@ def sample_token(logits, key, temperature: float = 0.0, top_k: int = 0):
     return jax.random.categorical(key, logits, axis=-1)
 
 
+# ---------------------------------------------------------------------------
+# slotted KV arena — the continuous-batching substrate (serve/_private/
+# continuous.py). One fixed-shape decode program steps EVERY slot each
+# iteration; sequences are admitted into free slots (chunked prefill) and
+# retire their slot the moment they finish, so the program shape never
+# changes while the active set churns.
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class SlotKVCache:
+    """Per-layer slot arena. k/v: [slots, max_len, Hkv, D]; lengths: [slots]
+    int32 — each slot is an independent sequence with its own write cursor."""
+
+    k: Any
+    v: Any
+    lengths: Any
+
+    @classmethod
+    def zeros(cls, slots: int, max_len: int, kv_heads: int, head_dim: int,
+              dtype=jnp.bfloat16) -> "SlotKVCache":
+        return cls(
+            k=jnp.zeros((slots, max_len, kv_heads, head_dim), dtype),
+            v=jnp.zeros((slots, max_len, kv_heads, head_dim), dtype),
+            lengths=jnp.zeros((slots,), jnp.int32),
+        )
+
+
+def init_slot_caches(cfg: TransformerConfig, slots: int, max_len: int,
+                     dtype=None) -> List[SlotKVCache]:
+    if max_len > cfg.max_seq_len:
+        # rope/learned position tables are sized cfg.max_seq_len; a longer
+        # arena would gather clamped positions and decode silently wrong
+        raise ValueError(
+            f"slot arena max_len ({max_len}) exceeds cfg.max_seq_len "
+            f"({cfg.max_seq_len})")
+    dtype = dtype or cfg.dtype
+    return [SlotKVCache.zeros(slots, max_len, cfg.kv_heads, cfg.head_dim,
+                              dtype) for _ in range(cfg.num_layers)]
+
+
+def reset_slot(caches: List[SlotKVCache], slot: int) -> List[SlotKVCache]:
+    """Recycle a retired slot: just rewind its write cursor. Stale k/v need
+    no scrub — writes are contiguous-from-0 and forward() updates the cache
+    *before* attending, so every position a new sequence attends to has been
+    freshly written by that sequence."""
+    return [dataclasses.replace(c, lengths=c.lengths.at[slot].set(0))
+            for c in caches]
+
+
+def prefill_into_slot(cfg: TransformerConfig, params, tokens, real_len,
+                      slot, caches):
+    """One prefill chunk into ONE slot. tokens: [1, C] — the next C prompt
+    tokens, zero-padded past ``real_len`` (so every chunk size compiles to
+    the same program). Writes k/v at [cursor, cursor+C) and advances the
+    slot's cursor by ``real_len`` only: pad positions are overwritten by the
+    next chunk/decode write before anything can attend to them (update runs
+    before attention, and the causal mask keeps real queries at or below
+    their own position). Returns (logits [vocab] at the last REAL token,
+    caches) — only the final chunk's logits are meaningful.
+
+    Caller contract: cursor + C must fit in the arena (dynamic_update_slice
+    clamps out-of-range starts, which would silently shift the write onto
+    earlier real positions) — the scheduler enforces it at admission.
+    """
+    rows = [LayerKVCache(
+        k=lax.dynamic_slice_in_dim(c.k, slot, 1, axis=0),
+        v=lax.dynamic_slice_in_dim(c.v, slot, 1, axis=0),
+        length=lax.dynamic_slice(c.lengths, (slot,), (1,))[0])
+        for c in caches]
+    positions = jnp.arange(tokens.shape[1])[None, :] + rows[0].length
+    logits, new_rows = forward(cfg, params, tokens, positions=positions,
+                               kv_caches=rows)
+    last = lax.dynamic_index_in_dim(logits[0], real_len - 1, keepdims=False)
+    new_caches = [
+        SlotKVCache(
+            k=lax.dynamic_update_slice_in_dim(c.k, r.k, slot, axis=0),
+            v=lax.dynamic_update_slice_in_dim(c.v, r.v, slot, axis=0),
+            lengths=c.lengths.at[slot].add(real_len))
+        for c, r in zip(caches, new_rows)]
+    return last, new_caches
+
+
+def slot_decode_step(cfg: TransformerConfig, params, tokens, active, caches):
+    """One fixed-shape decode step over the WHOLE slot arena.
+
+    tokens: [slots] int32 — each decoding slot's next input token.
+    active: [slots] int32 — 1 for slots mid-decode, 0 for free/prefilling
+    slots. Inactive slots run the same compute on garbage: their logits are
+    never consumed, their cursor does not advance, and their stale-position
+    write is overwritten before any sequence attends to it (same contiguous-
+    write/update-before-attend invariant as prefill_into_slot).
+
+    Returns (logits [slots, vocab], caches).
+    """
+    def one(tok, act, row):
+        rows = [LayerKVCache(k=c.k[None], v=c.v[None], length=c.lengths)
+                for c in row]
+        positions = rows[0].length + jnp.zeros((1, 1), jnp.int32)
+        logits, new_rows = forward(cfg, params, tok[None, None],
+                                   positions=positions, kv_caches=rows)
+        out = [SlotKVCache(k=r.k[0], v=r.v[0], lengths=c.lengths + act)
+               for c, r in zip(row, new_rows)]
+        return logits[0, -1], out
+
+    return jax.vmap(one, in_axes=(0, 0, 0))(tokens, active, caches)
+
+
 @partial(jax.jit, static_argnums=(0, 4, 5, 6))
 def generate(cfg: TransformerConfig, params, prompt, key,
              max_new_tokens: int, temperature: float = 0.0, top_k: int = 0):

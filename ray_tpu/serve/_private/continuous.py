@@ -1,0 +1,451 @@
+"""Continuous (iteration-level) batching scheduler for LLM serve replicas.
+
+Replaces the flush-and-drain loop of ``@serve.batch`` for the LLM path
+(ISSUE 9, ROADMAP item 4): instead of admitting a request batch, running
+prefill plus the ENTIRE ``max_new_tokens`` decode loop, and only then
+looking at the queue again, the scheduler owns a slotted KV-cache arena of
+``slots`` sequence slots (``models.decode.SlotKVCache``) and drives ONE
+fixed-shape jitted decode step over the whole arena per iteration:
+
+  * new requests are admitted into free slots *between* decode iterations
+    and prefilled in ``prefill_chunk``-token chunks (one chunk per
+    iteration), so a long prompt can never stall in-flight decodes;
+  * finished / EOS / cancelled sequences retire their slot immediately —
+    the freed slot is re-admitted on the very next iteration;
+  * every sampled token streams out to its request's asyncio queue the
+    iteration it is produced, so streaming and non-streaming consumers ride
+    the same batched program (no per-stream single-sequence decode loops).
+
+This is the serving analog of PR 8's 1F1B pipeline loop: the device-side
+program shape is compiled once and the host-side loop only decides *which*
+sequences occupy which slots. All jax work runs on the scheduler's own
+thread — the replica's asyncio event loop only ever touches queues.
+
+Knobs: ``RAY_TPU_SERVE_SLOTS`` (arena width), ``RAY_TPU_SERVE_PREFILL_CHUNK``
+(prefill chunk tokens); both overridable per-deployment via LLMServer init.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from functools import partial
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private.metrics import Counter, Gauge
+
+_m_steps = Counter(
+    "ray_tpu_serve_decode_steps_total",
+    "Batched slot-arena decode iterations executed")
+_m_prefill_chunks = Counter(
+    "ray_tpu_serve_prefill_chunks_total",
+    "Chunked prefill programs executed")
+_m_tokens = Counter(
+    "ray_tpu_serve_tokens_generated_total",
+    "Tokens sampled and streamed out of the slot arena")
+_m_admitted = Counter(
+    "ray_tpu_serve_seqs_admitted_total",
+    "Sequences admitted into a KV arena slot")
+_m_retired = Counter(
+    "ray_tpu_serve_seqs_retired_total",
+    "Sequences retired from their slot (finished/EOS/cancelled/error)")
+_m_active = Gauge(
+    "ray_tpu_serve_slots_active",
+    "KV arena slots currently holding a live sequence")
+_m_queue_depth = Gauge(
+    "ray_tpu_serve_queue_depth",
+    "Requests waiting for a free KV arena slot")
+
+# sequence states
+_QUEUED = "queued"
+_PREFILL = "prefill"
+_DECODE = "decode"
+_DONE = "done"
+
+
+class SchedulerClosedError(RuntimeError):
+    pass
+
+
+class _Seq:
+    """One in-flight generation request and its consumer-side queue."""
+
+    __slots__ = ("prompt", "remaining_prompt", "max_new", "temperature",
+                 "seed", "slot", "state", "n_generated", "next_token",
+                 "queue", "loop", "cancelled", "t_submit", "t_first_token",
+                 "rng")
+
+    def __init__(self, prompt: List[int], max_new: int, temperature: float,
+                 seed: int, loop, queue):
+        self.prompt = prompt
+        self.remaining_prompt = list(prompt)
+        self.max_new = max_new
+        self.temperature = temperature
+        self.seed = seed
+        self.slot: Optional[int] = None
+        self.state = _QUEUED
+        self.n_generated = 0
+        self.next_token: Optional[int] = None
+        self.queue = queue
+        self.loop = loop
+        self.cancelled = False
+        self.t_submit = time.monotonic()
+        self.t_first_token: Optional[float] = None
+        self.rng = None  # lazily created numpy Generator for temperature > 0
+
+
+class ContinuousScheduler:
+    """Slotted-arena continuous-batching decode scheduler.
+
+    ``params`` are the (device-resident) model parameters shared by every
+    program; the scheduler owns the KV arena and two jitted programs —
+    ``prefill_into_slot`` (one compiled shape: [1, prefill_chunk]) and
+    ``slot_decode_step`` ([slots]) — both with donated caches so the arena
+    updates in place instead of being copied per iteration.
+    """
+
+    def __init__(self, cfg, params, *, slots: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 arena_len: Optional[int] = None,
+                 eos_id: Optional[int] = None,
+                 cache_dtype=None):
+        import jax
+
+        from ray_tpu._private.config import global_config
+        from ray_tpu.models.decode import (init_slot_caches,
+                                           prefill_into_slot,
+                                           slot_decode_step)
+
+        conf = global_config()
+        self.cfg = cfg
+        self.params = params
+        # `is None` (not `or`): an explicit 0 must hit the validation
+        # below, not silently take the config default (the PR-8 depth=0
+        # lesson)
+        self.slots = int(conf.serve_slots if slots is None else slots)
+        self.prefill_chunk = int(conf.serve_prefill_chunk
+                                 if prefill_chunk is None else prefill_chunk)
+        self.arena_len = int(cfg.max_seq_len if arena_len is None
+                             else arena_len)
+        self.eos_id = eos_id
+        if self.slots < 1:
+            raise ValueError(f"slots must be >= 1, got {self.slots}")
+        if self.prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {self.prefill_chunk}")
+        if self.prefill_chunk > self.arena_len:
+            raise ValueError(
+                f"prefill_chunk ({self.prefill_chunk}) exceeds the arena "
+                f"length ({self.arena_len})")
+        self._jax = jax
+        # donated caches: the arena mutates in place across iterations
+        self._prefill = jax.jit(partial(prefill_into_slot, cfg),
+                                donate_argnums=(4,))
+        self._step = jax.jit(partial(slot_decode_step, cfg),
+                             donate_argnums=(3,))
+        self._caches = init_slot_caches(cfg, self.slots, self.arena_len,
+                                        cache_dtype)
+        self._slot_seqs: List[Optional[_Seq]] = [None] * self.slots
+        self._prefill_rr = 0  # round-robin cursor over prefilling slots
+        self._pending: deque = deque()
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._closed = False
+        self._error: Optional[BaseException] = None
+        # stats (host-side; mirrored into the process metric registry)
+        self._n_steps = 0
+        self._n_prefill_chunks = 0
+        self._n_admitted = 0
+        self._n_retired = 0
+        self._n_tokens = 0
+        self._admitted_mid_flight = 0
+        self._max_active_slots = 0
+        self._peak_queue_depth = 0
+        self._thread = threading.Thread(
+            target=self._run, name="serve-continuous-scheduler", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------- submit
+
+    def max_prompt_len(self, max_new: int) -> int:
+        """Longest admissible prompt for a given generation budget: the
+        padded prefill chunks AND prompt+new tokens must fit the arena."""
+        c = self.prefill_chunk
+        by_pad = (self.arena_len // c) * c
+        return min(by_pad, self.arena_len - max_new)
+
+    def submit(self, prompt_ids: List[int], *, max_new_tokens: int,
+               temperature: float = 0.0, seed: int = 0,
+               loop=None, queue=None) -> _Seq:
+        """Enqueue a generation. Tokens/end/error events arrive on ``queue``
+        via ``loop.call_soon_threadsafe`` as ``("tok", id)``, ``("end",
+        reason)`` or ``("err", message)`` tuples. Thread/loop-safe."""
+        if not prompt_ids:
+            raise ValueError("prompt must be non-empty")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(prompt_ids) > self.max_prompt_len(max_new_tokens):
+            raise ValueError(
+                f"prompt of {len(prompt_ids)} tokens + {max_new_tokens} new "
+                f"tokens does not fit a {self.arena_len}-token arena slot "
+                f"(prefill pads prompts to {self.prefill_chunk}-token "
+                f"chunks)")
+        seq = _Seq(list(prompt_ids), max_new_tokens, temperature, seed,
+                   loop, queue)
+        with self._lock:
+            if self._closed:
+                raise SchedulerClosedError(
+                    "scheduler is shut down" if self._error is None
+                    else f"scheduler failed: {self._error!r}")
+            self._pending.append(seq)
+            self._peak_queue_depth = max(self._peak_queue_depth,
+                                         len(self._pending))
+            _m_queue_depth.set(float(len(self._pending)))
+        self._wake.set()
+        return seq
+
+    def cancel(self, seq: _Seq) -> None:
+        """Mark a sequence cancelled; its slot retires on the next
+        iteration (pending sequences are dropped at admission)."""
+        seq.cancelled = True
+        self._wake.set()
+
+    # -------------------------------------------------------------- loop
+
+    def _emit(self, seq: _Seq, item) -> None:
+        if seq.loop is None or seq.queue is None:
+            return
+        try:
+            seq.loop.call_soon_threadsafe(seq.queue.put_nowait, item)
+        except RuntimeError:
+            # consumer's loop is gone — nobody is listening; retire quietly
+            seq.cancelled = True
+
+    def _retire(self, seq: _Seq, reason: str) -> None:
+        if seq.slot is not None:
+            self._slot_seqs[seq.slot] = None
+            seq.slot = None
+        seq.state = _DONE
+        self._n_retired += 1
+        _m_retired.inc()
+        self._emit(seq, ("end", reason))
+
+    def _fail(self, seq: _Seq, msg: str) -> None:
+        if seq.slot is not None:
+            self._slot_seqs[seq.slot] = None
+            seq.slot = None
+        seq.state = _DONE
+        self._n_retired += 1
+        _m_retired.inc()
+        self._emit(seq, ("err", msg))
+
+    def _sample(self, seq: _Seq, logits_row) -> int:
+        import numpy as np
+
+        if seq.temperature <= 0.0:
+            return int(np.asarray(logits_row).argmax())
+        if seq.rng is None:
+            seq.rng = np.random.default_rng(seq.seed)
+        x = np.asarray(logits_row, np.float64) / seq.temperature
+        x -= x.max()
+        p = np.exp(x)
+        p /= p.sum()
+        return int(seq.rng.choice(len(p), p=p))
+
+    def _emit_token(self, seq: _Seq, tok: int) -> bool:
+        """Record + stream one sampled token; returns True if the sequence
+        is finished (budget exhausted or EOS)."""
+        seq.n_generated += 1
+        self._n_tokens += 1
+        _m_tokens.inc()
+        if seq.t_first_token is None:
+            seq.t_first_token = time.monotonic()
+        self._emit(seq, ("tok", tok))
+        if self.eos_id is not None and tok == self.eos_id:
+            return True
+        return seq.n_generated >= seq.max_new
+
+    def _admit(self) -> None:
+        from ray_tpu.models.decode import reset_slot
+
+        while True:
+            with self._lock:
+                if not self._pending:
+                    break
+                free = next((i for i, s in enumerate(self._slot_seqs)
+                             if s is None), None)
+                if free is None:
+                    break
+                seq = self._pending.popleft()
+                _m_queue_depth.set(float(len(self._pending)))
+            if seq.cancelled:
+                self._retire(seq, "cancelled")
+                continue
+            in_flight = any(s is not None for s in self._slot_seqs)
+            seq.slot = free
+            seq.state = _PREFILL
+            self._slot_seqs[free] = seq
+            self._caches = reset_slot(self._caches, free)
+            self._n_admitted += 1
+            _m_admitted.inc()
+            if in_flight:
+                # the signal request-level flush-and-drain cannot produce:
+                # an admission while other sequences are mid-generation
+                self._admitted_mid_flight += 1
+
+    def _prefill_one(self) -> bool:
+        """Advance ONE prefilling sequence by one chunk, round-robin over
+        slots — concurrent prompts interleave their chunks, so one long
+        prompt cannot monopolize prefill (and decode never waits more than
+        one chunk). Returns True if a chunk ran."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        start = self._prefill_rr
+        for off in range(self.slots):
+            i = (start + off) % self.slots
+            seq = self._slot_seqs[i]
+            if seq is None or seq.state != _PREFILL:
+                continue
+            self._prefill_rr = (i + 1) % self.slots
+            if seq.cancelled:
+                self._retire(seq, "cancelled")
+                continue
+            chunk = seq.remaining_prompt[:self.prefill_chunk]
+            seq.remaining_prompt = seq.remaining_prompt[self.prefill_chunk:]
+            real = len(chunk)
+            padded = chunk + [0] * (self.prefill_chunk - real)
+            tokens = jnp.asarray([padded], jnp.int32)
+            logits, self._caches = self._prefill(
+                self.params, tokens, np.int32(real), np.int32(seq.slot),
+                self._caches)
+            self._n_prefill_chunks += 1
+            _m_prefill_chunks.inc()
+            if not seq.remaining_prompt:
+                # prompt fully resident: sample the first token NOW — this
+                # is the time-to-first-token moment
+                tok = self._sample(seq, logits)
+                seq.state = _DECODE
+                if self._emit_token(seq, tok):
+                    self._retire(seq, "length" if self.eos_id is None
+                                 or tok != self.eos_id else "eos")
+                else:
+                    seq.next_token = tok
+            return True
+        return False
+
+    def _decode_once(self) -> bool:
+        """One batched decode iteration over every DECODE slot."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        toks = np.zeros(self.slots, np.int32)
+        active = np.zeros(self.slots, np.int32)
+        live: List[_Seq] = []
+        for i, seq in enumerate(self._slot_seqs):
+            if seq is None or seq.state != _DECODE:
+                continue
+            if seq.cancelled:
+                self._retire(seq, "cancelled")
+                continue
+            toks[i] = seq.next_token
+            active[i] = 1
+            live.append(seq)
+        if not live:
+            return False
+        logits, self._caches = self._step(
+            self.params, jnp.asarray(toks), jnp.asarray(active),
+            self._caches)
+        la = np.asarray(logits)
+        self._n_steps += 1
+        _m_steps.inc()
+        self._max_active_slots = max(self._max_active_slots, len(live))
+        for seq in live:
+            tok = self._sample(seq, la[seq.slot])
+            if self._emit_token(seq, tok):
+                self._retire(seq, "eos" if self.eos_id is not None
+                             and tok == self.eos_id else "length")
+            else:
+                seq.next_token = tok
+        return True
+
+    def _run(self) -> None:
+        try:
+            while True:
+                with self._lock:
+                    if self._closed:
+                        break
+                self._admit()
+                did = self._prefill_one()
+                did = self._decode_once() or did
+                _m_active.set(float(sum(
+                    1 for s in self._slot_seqs if s is not None)))
+                if not did:
+                    with self._lock:
+                        idle = not self._pending and all(
+                            s is None or s.cancelled
+                            for s in self._slot_seqs)
+                        if idle:
+                            self._wake.clear()
+                    self._wake.wait(timeout=1.0)
+        except BaseException as e:  # noqa: BLE001 — crosses to consumers
+            self._error = e
+            with self._lock:
+                self._closed = True
+            for seq in list(self._slot_seqs):
+                if seq is not None:
+                    self._fail(seq, f"{type(e).__name__}: {e}")
+            with self._lock:
+                pending = list(self._pending)
+                self._pending.clear()
+            for seq in pending:
+                self._fail(seq, f"{type(e).__name__}: {e}")
+        finally:
+            with self._lock:
+                self._closed = True
+            _m_active.set(0.0)
+
+    # --------------------------------------------------------- lifecycle
+
+    def shutdown(self, timeout_s: float = 5.0) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            pending = list(self._pending)
+            self._pending.clear()
+        self._wake.set()
+        self._thread.join(timeout=timeout_s)
+        for seq in pending:
+            self._fail(seq, "scheduler shut down")
+        for seq in list(self._slot_seqs):
+            if seq is not None:
+                self._fail(seq, "scheduler shut down")
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            q = len(self._pending)
+        return {
+            "mode": "continuous",
+            "slots": self.slots,
+            "prefill_chunk": self.prefill_chunk,
+            "arena_len": self.arena_len,
+            "decode_steps": self._n_steps,
+            "prefill_chunks": self._n_prefill_chunks,
+            "admitted": self._n_admitted,
+            "retired": self._n_retired,
+            "tokens_generated": self._n_tokens,
+            # iteration-level proof signals: > 0 means a request was
+            # admitted while others were mid-generation, which a
+            # flush-and-drain batcher can never do
+            "admitted_mid_flight": self._admitted_mid_flight,
+            "max_active_slots": self._max_active_slots,
+            "peak_queue_depth": self._peak_queue_depth,
+            "queue_depth": q,
+            "active_slots": sum(1 for s in self._slot_seqs if s is not None),
+        }

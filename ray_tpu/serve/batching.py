@@ -4,8 +4,19 @@ Analog of `ray.serve.batching.batch` (`python/ray/serve/batching.py`):
 decorate an async method taking a LIST of items; concurrent callers (the
 replica runs requests concurrently on one asyncio loop) are coalesced
 into batches of up to `max_batch_size`, flushed when full or after
-`batch_wait_timeout_s`. This is the continuous-batching building block
-for TPU decode replicas: the jitted decode step runs once per batch.
+`batch_wait_timeout_s`.
+
+This is the GENERIC (request-level) batcher: one flush runs its whole
+batch to completion before results resolve. The LLM decode path no
+longer rides it — `serve/_private/continuous.py` admits and retires
+sequences at decode-iteration granularity — but it remains the right
+tool for stateless batchable work (embedding lookups, rerankers, vision
+encoders) where per-item latency ≈ batch latency.
+
+Error semantics: if the batch fn raises, every waiter in that flush gets
+the exception; if it returns normally, any `Exception` INSTANCE in the
+output list is routed to just its own waiter (per-item error isolation —
+one poisoned input no longer fails its batchmates).
 """
 
 from __future__ import annotations
@@ -38,13 +49,27 @@ class _BatchQueue:
         return await fut
 
     async def _flush_later(self):
-        await asyncio.sleep(self._timeout)
+        try:
+            await asyncio.sleep(self._timeout)
+        except asyncio.CancelledError:
+            # a full-batch flush consumed our batch between scheduling and
+            # expiry — nothing left to do
+            return
+        if self._flush_task is not asyncio.current_task():
+            # stale timer: a full-batch flush raced our wakeup (its
+            # cancel() landed after our sleep completed but before we ran)
+            # and a NEW batch may already own a new timer — flushing here
+            # would flush the new batch early, or double-flush
+            return
         self._flush_now()
 
     def _flush_now(self):
-        if self._flush_task is not None:
-            self._flush_task.cancel()
-            self._flush_task = None
+        # clear the timer handle BEFORE flushing, so a submit() landing
+        # while _run_batch is in flight arms a fresh timer for the next
+        # batch instead of seeing a dead task
+        task, self._flush_task = self._flush_task, None
+        if task is not None and task is not asyncio.current_task():
+            task.cancel()
         items, futures = self._items, self._futures
         self._items, self._futures = [], []
         if not items:
@@ -57,12 +82,18 @@ class _BatchQueue:
                 outs = await self._fn(self._self_obj, items)
             else:
                 outs = await self._fn(items)
-            if len(outs) != len(items):
+            if outs is None or len(outs) != len(items):
                 raise ValueError(
-                    f"batch fn returned {len(outs)} results for "
+                    f"batch fn returned "
+                    f"{'None' if outs is None else len(outs)} results for "
                     f"{len(items)} inputs")
             for f, o in zip(futures, outs):
-                if not f.done():
+                if f.done():
+                    continue
+                if isinstance(o, Exception):
+                    # per-item failure: only this waiter sees it
+                    f.set_exception(o)
+                else:
                     f.set_result(o)
         except BaseException as e:
             for f in futures:

@@ -1,16 +1,27 @@
 """LLM decode deployment — the Serve flagship (BASELINE.md row 5).
 
 The reference leaves model serving to torch/vLLM inside replicas (its
-`ray.serve.llm` wraps vLLM engines); here the decode loop is TPU-native:
-jitted prefill + per-token jitted decode steps over the functional KV
-caches in `ray_tpu.models.decode`, with
+`ray.serve.llm` wraps vLLM engines); here the decode loop is TPU-native
+and the batching is CONTINUOUS (iteration-level, ISSUE 9):
 
-  * continuous batching: concurrent HTTP/handle requests coalesce via
-    `@serve.batch` into one batched `generate` program per flush
-    (≈ vLLM's batched engine step inside a Serve replica);
-  * token streaming: `{"prompt": ..., "stream": true}` returns a
-    generator — the replica pumps a jitted decode step per token and the
-    proxy/handle stream chunks as they are produced;
+  * a slotted KV-cache arena (`models.decode.SlotKVCache`) plus ONE
+    fixed-shape jitted decode step over all slots per iteration; new
+    requests are admitted into free slots between iterations (chunked
+    prefill), finished/EOS/cancelled sequences retire their slot
+    immediately — ≈ vLLM's iteration-level scheduler, not a
+    flush-and-drain `@serve.batch` window (kept as `scheduler="batch"`,
+    the measured baseline);
+  * token streaming: `{"prompt": ..., "stream": true}` returns an async
+    generator consuming the scheduler's per-slot token queue — the stream
+    rides the same batched program as everything else (no per-stream
+    single-sequence decode loop, nothing jitted ever runs on the
+    replica's asyncio event loop);
+  * one-copy-per-node weights: the first replica on a node publishes the
+    params into the shared-memory object arena; later same-node replicas
+    attach pinned read-only views (serve/_private/weights.py), and new
+    nodes can receive the tree over `collective.broadcast`
+    (`push_weights`) so scale-up is seconds, not checkpoint-staging
+    minutes;
   * replica autoscaling/health from the regular serve control plane.
 
 The default preset is `llama_debug` (random weights) so the deployment
@@ -20,6 +31,7 @@ loader for the real thing.
 
 from __future__ import annotations
 
+import asyncio
 from functools import partial
 from typing import Any, Dict, List, Optional
 
@@ -39,33 +51,82 @@ def _byte_detokenize(ids: List[int]) -> str:
     return bytes(int(i) % 256 for i in ids).decode("utf-8", errors="replace")
 
 
-@serve.deployment(name="llm", max_ongoing_requests=32)
-class LLMServer:
-    """One model replica: owns params + the jitted prefill/decode programs."""
+class LLMServerImpl:
+    """One model replica: owns the jitted decode programs and (in
+    continuous mode) the slot-arena scheduler. Weights are shared per node
+    through the object arena unless ``share_weights=False``."""
 
     def __init__(self, preset: str = "llama_debug",
                  max_new_tokens: int = 16,
                  temperature: float = 0.0,
                  max_batch_size: int = 8,
                  params_loader=None,
-                 tokenize=None, detokenize=None):
+                 tokenize=None, detokenize=None,
+                 scheduler: str = "continuous",
+                 slots: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 arena_len: Optional[int] = None,
+                 share_weights: bool = True,
+                 weights_key: Optional[str] = None,
+                 weights_bcast: Optional[Dict[str, Any]] = None,
+                 eos_id: Optional[int] = None):
         import jax
         import jax.numpy as jnp
 
         from ray_tpu.models.transformer import init_params
 
+        if scheduler not in ("continuous", "batch"):
+            raise ValueError(
+                f"scheduler must be 'continuous' or 'batch', got "
+                f"{scheduler!r}")
         self._jnp = jnp
         self._jax = jax
         self.cfg = getattr(presets, preset)()
         self.max_new_tokens = max_new_tokens
         self.temperature = temperature
         self._max_batch = max_batch_size
-        self.params = (params_loader(self.cfg) if params_loader is not None
-                       else init_params(self.cfg, jax.random.PRNGKey(0)))
+        self._scheduler_mode = scheduler
+        self._eos_id = eos_id
+        self._seq_counter = 0
+
+        # ---- weights: one arena copy per node (ISSUE 9 tentpole) ----
+        from ray_tpu.serve._private import weights as _weights
+
+        def load():
+            if weights_bcast is not None and weights_bcast.get("rank", 0) != \
+                    weights_bcast.get("root", 0):
+                # fresh node: receive the tree from an existing replica
+                # instead of staging a checkpoint
+                return _weights.broadcast_params(
+                    None, weights_bcast["group"],
+                    int(weights_bcast["world_size"]),
+                    int(weights_bcast["rank"]),
+                    root=int(weights_bcast.get("root", 0)))
+            if params_loader is not None:
+                return params_loader(self.cfg)
+            return init_params(self.cfg, jax.random.PRNGKey(0))
+
+        # a custom loader has no stable identity to share under; require an
+        # explicit weights_key to opt in
+        can_share = share_weights and (params_loader is None
+                                       or weights_key is not None)
+        if can_share:
+            key = weights_key or f"llm:{preset}:seed0"
+            host, self._weights_info = _weights.get_or_publish(key, load)
+        else:
+            host, self._weights_info = load(), {"mode": "local",
+                                                "shared": False}
+        # one device copy per replica (HBM on TPU); the HOST copy stays
+        # shared in the node arena — self._host_params keeps the read-only
+        # views (and their pins) alive for this replica's lifetime
+        self._host_params = host
+        self.params = jax.device_put(host)
+        del host
+
         self._tokenize = tokenize or partial(
             _byte_tokenize, vocab_size=self.cfg.vocab_size)
         self._detokenize = detokenize or _byte_detokenize
-        # jitted programs, shared by the batched and streaming paths
+        # jitted programs for the request-level baseline + legacy streaming
         self._prefill = jax.jit(partial(prefill, self.cfg))
         self._decode_step = jax.jit(partial(decode_step, self.cfg))
         self._key = jax.random.PRNGKey(0)
@@ -75,44 +136,105 @@ class LLMServer:
         # deploy-time batch size overrides the @serve.batch default
         setattr(self, "__serve_batch_size__generate_batch", max_batch_size)
 
-    # ------------------------------------------------------------ batched
+        self._sched = None
+        if scheduler == "continuous":
+            from ray_tpu.serve._private.continuous import ContinuousScheduler
+
+            self._sched = ContinuousScheduler(
+                self.cfg, self.params, slots=slots,
+                prefill_chunk=prefill_chunk, arena_len=arena_len,
+                eos_id=eos_id)
+
+    # ------------------------------------------------------- continuous
+
+    def _submit(self, ids: List[int], max_new: int, temperature: float):
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+        self._seq_counter += 1
+        seq = self._sched.submit(
+            ids, max_new_tokens=max_new, temperature=temperature,
+            seed=self._seq_counter, loop=loop, queue=q)
+        return seq, q
+
+    async def _run_continuous(self, ids: List[int], max_new: int,
+                              temperature: float) -> List[int]:
+        seq, q = self._submit(ids, max_new, temperature)
+        toks: List[int] = []
+        try:
+            while True:
+                kind, val = await q.get()
+                if kind == "tok":
+                    toks.append(val)
+                elif kind == "end":
+                    return toks
+                else:
+                    raise RuntimeError(f"generation failed: {val}")
+        except asyncio.CancelledError:
+            self._sched.cancel(seq)
+            raise
+
+    async def _stream_continuous(self, ids: List[int], max_new: int,
+                                 temperature: float):
+        """Streaming = a consumer of the scheduler's per-slot token queue.
+        Abandoning the generator (consumer gone) cancels the sequence,
+        which retires its slot on the scheduler's next iteration."""
+        seq, q = self._submit(ids, max_new, temperature)
+        try:
+            while True:
+                kind, val = await q.get()
+                if kind == "tok":
+                    yield self._detokenize([val])
+                elif kind == "end":
+                    return
+                else:
+                    raise RuntimeError(f"generation failed: {val}")
+        finally:
+            self._sched.cancel(seq)
+
+    # ------------------------------------------------ request-level path
+    # (the measured flush-and-drain baseline: one @serve.batch window runs
+    # prefill + the FULL decode loop before any newly arrived request is
+    # admitted — scheduler="batch" keeps it selectable, exactly like the
+    # collective layer's algo="kv")
 
     @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.02)
-    async def _generate_batch(self, prompts: List[List[int]]) -> List[List[int]]:
-        """Continuous batching: concurrent requests run one decode program.
-        The jax work runs on an executor thread — blocking the replica's
-        event loop would stall health checks and stream pulls."""
-        import asyncio
-
+    async def _generate_batch(self, items) -> List[List[int]]:
+        """Request-level batching: the flush runs every request in it to
+        completion. The jax work runs on an executor thread — blocking the
+        replica's event loop would stall health checks and stream pulls."""
         return await asyncio.get_running_loop().run_in_executor(
-            None, self._generate_batch_sync, prompts)
+            None, self._generate_batch_sync, items)
 
-    def _generate_batch_sync(self, prompts: List[List[int]]) -> List[List[int]]:
+    def _generate_batch_sync(self, items) -> List[List[int]]:
         """Group prompts by exact length and run one decode program per
         group. Padding mixed lengths into one program would let real tokens
         attend to pad positions (the causal cache mask has no pad masking),
         silently degrading shorter prompts; grouping keeps every program
         exact while still batching the common same-shape case."""
         by_len: Dict[int, List[int]] = {}
-        for i, p in enumerate(prompts):
+        for i, (p, _new) in enumerate(items):
             by_len.setdefault(len(p), []).append(i)
-        outs: List[List[int]] = [[] for _ in prompts]
+        outs: List[List[int]] = [[] for _ in items]
         for _length, indices in by_len.items():
-            group = [prompts[i] for i in indices]
-            for i, out in zip(indices, self._generate_group(group)):
-                outs[i] = out
+            group = [items[i][0] for i in indices]
+            # flush-and-drain: the whole group decodes until its LONGEST
+            # request is done; shorter requests are truncated after
+            steps = max(items[i][1] for i in indices)
+            for i, out in zip(indices, self._generate_group(group, steps)):
+                outs[i] = out[: items[i][1]]
         return outs
 
-    def _generate_group(self, prompts: List[List[int]]) -> List[List[int]]:
+    def _generate_group(self, prompts: List[List[int]],
+                        new_tokens: int) -> List[List[int]]:
         """One batched decode program over same-length prompts."""
         jnp = self._jnp
         batch = len(prompts)
         length = len(prompts[0])
         tokens = jnp.asarray(prompts, dtype=jnp.int32)
-        caches = init_caches(self.cfg, batch, length + self.max_new_tokens)
+        caches = init_caches(self.cfg, batch, length + new_tokens)
         logits, caches = self._prefill(self.params, tokens, caches)
         outs: List[List[int]] = [[] for _ in range(batch)]
-        for _ in range(self.max_new_tokens):
+        for _ in range(new_tokens):
             with self._key_lock:
                 self._key, sub = self._jax.random.split(self._key)
             tok = sample_token(logits, sub, self.temperature)
@@ -122,17 +244,18 @@ class LLMServer:
                 self.params, tok[:, None].astype(jnp.int32), caches)
         return outs
 
-    # ---------------------------------------------------------- streaming
-
-    def _generate_stream(self, prompt_ids: List[int]):
-        """Yield decoded text one token at a time (single-sequence decode:
-        a stream holds its own KV cache for its whole lifetime)."""
+    def _generate_stream(self, prompt_ids: List[int], new_tokens: int):
+        """Legacy streaming (scheduler="batch" only): a single-sequence
+        decode loop owning its own KV cache. The replica pumps it on an
+        executor thread, never the event loop — but each live stream still
+        monopolizes one whole decode program; the continuous path replaces
+        this with a queue consumer over the shared slot arena."""
         jnp = self._jnp
         tokens = jnp.asarray([prompt_ids], dtype=jnp.int32)
-        caches = init_caches(self.cfg, 1, len(prompt_ids) + self.max_new_tokens)
+        caches = init_caches(self.cfg, 1, len(prompt_ids) + new_tokens)
         logits, caches = self._prefill(self.params, tokens, caches)
         key = self._jax.random.PRNGKey(len(prompt_ids))
-        for _ in range(self.max_new_tokens):
+        for _ in range(new_tokens):
             key, sub = self._jax.random.split(key)
             tok = sample_token(logits, sub, self.temperature)
             yield self._detokenize([int(tok[0])])
@@ -149,14 +272,73 @@ class LLMServer:
         ids = self._tokenize(prompt)
         if not ids:
             raise ValueError("prompt must be non-empty")
-        if request.get("stream"):
-            return self._generate_stream(ids)
-        out_ids = await self._generate_batch(ids)
+        max_new = int(request.get("max_new_tokens", self.max_new_tokens))
+        temperature = float(request.get("temperature", self.temperature))
+        if self._sched is not None:
+            if request.get("stream"):
+                return self._stream_continuous(ids, max_new, temperature)
+            out_ids = await self._run_continuous(ids, max_new, temperature)
+        else:
+            # the request-level path has no per-sequence cache bound of its
+            # own (the continuous scheduler validates at submit): guard the
+            # user-controlled budget before it sizes a KV cache, and refuse
+            # (rather than silently ignore) per-request temperatures its
+            # whole-batch sampler cannot honor
+            if max_new < 1:
+                raise ValueError("max_new_tokens must be >= 1")
+            if len(ids) + max_new > self.cfg.max_seq_len:
+                raise ValueError(
+                    f"prompt of {len(ids)} tokens + {max_new} new tokens "
+                    f"exceeds cfg.max_seq_len ({self.cfg.max_seq_len})")
+            if temperature != self.temperature:
+                raise ValueError(
+                    "per-request temperature requires the continuous "
+                    "scheduler (this replica runs scheduler='batch')")
+            if request.get("stream"):
+                return self._generate_stream(ids, max_new)
+            out_ids = await self._generate_batch((ids, max_new))
         return {"prompt": prompt, "text": self._detokenize(out_ids),
                 "num_tokens": len(out_ids)}
 
+    # ------------------------------------------------------ introspection
+
+    def scheduler_stats(self) -> Dict[str, Any]:
+        if self._sched is not None:
+            return self._sched.stats()
+        return {"mode": "batch", "max_batch_size": self._max_batch}
+
+    def weights_info(self) -> Dict[str, Any]:
+        return dict(self._weights_info)
+
+    def push_weights(self, group: str, world_size: int,
+                     rank: int = 0) -> bool:
+        """Root side of seconds-scale scale-up: broadcast this replica's
+        weights to `world_size - 1` receivers (replicas starting on new
+        nodes with ``weights_bcast={"group", "world_size", "rank"}``)."""
+        from ray_tpu.serve._private import weights as _weights
+
+        _weights.broadcast_params(self._host_params, group, world_size,
+                                  rank, root=rank)
+        return True
+
     def check_health(self) -> bool:
+        if self._sched is not None and self._sched.closed:
+            return False
         return self.params is not None
+
+    def shutdown(self) -> None:
+        if self._sched is not None:
+            self._sched.shutdown()
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass
+
+
+LLMServer = serve.deployment(name="llm", max_ongoing_requests=32)(
+    LLMServerImpl)
 
 
 def build_app(preset: str = "llama_debug", num_replicas: int = 1,

@@ -358,7 +358,8 @@ class TestLLMDecode:
 
         from ray_tpu.serve.llm import build_app
 
-        h = serve.run(build_app(max_new_tokens=6), name="llm",
+        h = serve.run(build_app(max_new_tokens=6, slots=4,
+                                prefill_chunk=8), name="llm",
                       route_prefix="/llm")
 
         # continuous batching: concurrent same-shape requests coalesce into
@@ -407,7 +408,8 @@ class TestLLMDecode:
 
         from ray_tpu.serve.llm import build_app
 
-        h = serve.run(build_app(max_new_tokens=4), name="llmmix",
+        h = serve.run(build_app(max_new_tokens=4, slots=4,
+                                prefill_chunk=8), name="llmmix",
                       route_prefix="/llmmix")
         solo_a = h.remote({"prompt": "abcd"}).result(timeout=120)
         solo_b = h.remote({"prompt": "a much longer prompt!"}).result(
