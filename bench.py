@@ -220,6 +220,34 @@ def acquire_tpu(log) -> tuple:
     return False, PROBE_ATTEMPTS
 
 
+def probe_provenance(log) -> dict:
+    """The acquisition-provenance fields every bench record stamps
+    (`tpu_lost`/`tpu_probe_ok`/`tpu_probe_attempts`/`device`), shared by
+    bench_serve.py and bench_rllib.py so the field set can never drift
+    between harnesses. When JAX is pinned to CPU the run is a deliberate
+    CPU smoke (`tpu_lost: false`, no probe burned); otherwise run the
+    hardened acquire_tpu (sweep + retries)."""
+    prov = {"tpu_probe_ok": False, "tpu_probe_attempts": 0,
+            "tpu_lost": False}
+    forced_cpu = "cpu" in os.environ.get("JAX_PLATFORMS", "").lower()
+    prov["forced_cpu"] = forced_cpu
+    if not forced_cpu:
+        try:
+            ok, attempts = acquire_tpu(log)
+            prov.update(tpu_probe_ok=bool(ok),
+                        tpu_probe_attempts=int(attempts),
+                        tpu_lost=not bool(ok))
+        except Exception as e:  # probe machinery broken ≠ a valid TPU run
+            log(f"tpu probe unavailable ({e!r}); treating as lost")
+            prov["tpu_lost"] = True
+    import jax
+
+    d = jax.devices()[0]
+    prov["device"] = str(getattr(d, "platform", "cpu"))
+    prov["device_kind"] = str(getattr(d, "device_kind", "cpu"))
+    return prov
+
+
 def main() -> None:
     """Parent orchestrator: reap, run child with timeout, retry, fall back."""
     repo = os.path.dirname(os.path.abspath(__file__))

@@ -143,31 +143,18 @@ def bench_serve_path(preset: str, new_tokens: int, concurrency: int,
 
 
 def _probe_provenance(log) -> dict:
-    """The PR-6 acquisition-provenance fields. When JAX is pinned to CPU
-    the run is a deliberate CPU smoke (`tpu_lost: false`, no probe burned);
-    otherwise run bench.py's hardened acquire_tpu (sweep + retries)."""
-    prov = {"tpu_probe_ok": False, "tpu_probe_attempts": 0,
-            "tpu_lost": False}
-    forced_cpu = "cpu" in os.environ.get("JAX_PLATFORMS", "").lower()
-    prov["forced_cpu"] = forced_cpu
-    if not forced_cpu:
-        try:
-            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-            from bench import acquire_tpu
+    """bench.py's shared provenance helper (one definition for every
+    harness; a missing bench.py still yields an honest tpu_lost record)."""
+    try:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from bench import probe_provenance
 
-            ok, attempts = acquire_tpu(log)
-            prov.update(tpu_probe_ok=bool(ok),
-                        tpu_probe_attempts=int(attempts),
-                        tpu_lost=not bool(ok))
-        except Exception as e:  # probe machinery missing ≠ a valid TPU run
-            log(f"tpu probe unavailable ({e!r}); treating as lost")
-            prov["tpu_lost"] = True
-    import jax
-
-    d = jax.devices()[0]
-    prov["device"] = str(getattr(d, "platform", "cpu"))
-    prov["device_kind"] = str(getattr(d, "device_kind", "cpu"))
-    return prov
+        return probe_provenance(log)
+    except Exception as e:
+        log(f"provenance helper unavailable ({e!r}); treating as lost")
+        return {"tpu_probe_ok": False, "tpu_probe_attempts": 0,
+                "tpu_lost": True, "forced_cpu": False,
+                "device": "unknown", "device_kind": "unknown"}
 
 
 def _percentiles(xs, unit_scale=1e3):

@@ -517,6 +517,33 @@ class MirrorWriter:
             timeout=self._timeout)
 
 
+class VersionedWriter:
+    """Version-addressed writer over one channel: a LocalChannel when the
+    channel lives in this node's arena, a MirrorWriter push otherwise.
+    Shared by the pipeline trainer's stage loops and the podracer RL
+    topology so the local-vs-mirror dispatch lives in one place."""
+
+    def __init__(self, core, spec: ChannelSpec,
+                 open_local: Callable[[ChannelSpec], "LocalChannel"]):
+        self.spec = spec
+        if tuple(spec.node_addr) == tuple(core.supervisor_addr):
+            self._local: Optional[LocalChannel] = open_local(spec)
+            self._mirror = None
+        else:
+            self._local = None
+            self._mirror = MirrorWriter(core, spec)
+
+    @property
+    def is_local(self) -> bool:
+        return self._local is not None
+
+    def write(self, payload, version: int) -> None:
+        if self._local is not None:
+            self._local.write(payload, version)
+        else:
+            self._mirror.push(payload, version)
+
+
 # ------------------------------------------------- driver-side shared plumbing
 
 

@@ -115,6 +115,17 @@ class Config:
     # total budget for one cross-node per-step push (chunk window +
     # commit); the commit side also waits for remote reader acks under it
     channel_remote_timeout_s: float = 120.0
+    # ---- Podracer RL topologies (rllib/podracer.py) ----
+    # slot-ring depth of each runner->learner trajectory channel: how many
+    # rollout batches a runner may stream ahead of its learner consuming
+    # them. This IS the off-policy lag bound of the Sebulba topology
+    # (writer backpressure); with broadcast_interval=1 the param sync
+    # serializes the loop regardless, so depth only matters at interval>1.
+    # Explicit zeros are REJECTED at build (never silently defaulted)
+    podracer_channel_depth: int = 4
+    # budget for one device-to-device parameter broadcast round over the
+    # learner+runners collective group (shm on one node, ring across)
+    podracer_bcast_timeout_s: float = 120.0
     # ---- OOM defense (≈ memory_monitor.h:52) ----
     # kill the newest leased worker when host memory use crosses this
     # fraction; <= 0 disables the monitor

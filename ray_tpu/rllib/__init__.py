@@ -22,6 +22,7 @@ from ray_tpu.rllib.env.multi_agent_env import (MultiAgentEnv,
                                                MultiAgentEnvRunnerGroup)
 from ray_tpu.rllib.env.single_agent_env_runner import (EnvRunnerGroup,
                                                        SingleAgentEnvRunner)
+from ray_tpu.rllib.podracer import AnakinTrainer, SebulbaTopology
 
 __all__ = [
     "Algorithm",
@@ -55,6 +56,8 @@ __all__ = [
     "MultiAgentEnv",
     "MultiAgentEnvRunner",
     "MultiAgentEnvRunnerGroup",
+    "AnakinTrainer",
+    "SebulbaTopology",
 ]
 
 from ray_tpu._private.usage import record_library_usage as _rlu

@@ -33,15 +33,51 @@ from ray_tpu.rllib.env.single_agent_env_runner import EnvRunnerGroup
 from ray_tpu.train._checkpoint import Checkpoint
 
 
+def merge_time_major(samples: List[Dict[str, np.ndarray]]
+                     ) -> Dict[str, np.ndarray]:
+    """Concatenate per-runner [T, B, ...] batches along B. Module-level so
+    the Sebulba learner actors merge exactly like the dynamic loop."""
+    out: Dict[str, np.ndarray] = {}
+    for k in samples[0]:
+        axis = 0 if samples[0][k].ndim == 1 else 1  # bootstrap_value: [B]
+        out[k] = (np.concatenate([s[k] for s in samples], axis=axis)
+                  if len(samples) > 1 else samples[0][k])
+    return out
+
+
 class Algorithm:
     """Base driver; subclasses define `loss_fn` + `training_step`."""
+
+    # class-level default: algorithms with bespoke __init__ (SAC, CQL,
+    # DreamerV3) never touch the podracer path but still run the shared
+    # train()/stop() which checks it
+    _podracer = None
 
     def __init__(self, config: AlgorithmConfig):
         self.config = config
         self.iteration = 0
         self._total_env_steps = 0
         self._start = time.time()
+        self._podracer = None
         opt_cfg = {"lr": config.lr, "grad_clip": config.grad_clip}
+        if getattr(config, "topology", "dynamic") == "sebulba":
+            # Podracer split actor/learner pods: rollouts stream through
+            # compiled slot-ring channels, params broadcast back
+            # device-to-device — no EnvRunnerGroup/LearnerGroup, no
+            # per-iteration object-store traffic (rllib/podracer.py)
+            if config.is_multi_agent:
+                raise ValueError(
+                    "topology='sebulba' supports single-agent configs")
+            from ray_tpu.rllib.podracer import SebulbaTopology
+
+            self.spec = config.rl_module_spec()
+            self.specs = None
+            self.env_runner_group = None
+            self.learner_group = None
+            self.learner_groups = None
+            self._podracer = SebulbaTopology(
+                config, self._podracer_program())
+            return
         if config.is_multi_agent:
             if (config.env_to_module_connector is not None
                     or config.learner_connector is not None):
@@ -93,10 +129,17 @@ class Algorithm:
     def training_step(self) -> Dict[str, Any]:
         raise NotImplementedError
 
+    def _podracer_program(self):  # pragma: no cover - abstract-ish
+        raise NotImplementedError(
+            f"{type(self).__name__} is not wired onto the Sebulba "
+            f"topology; topology='sebulba' supports PPO and IMPALA")
+
     # ------------------------------------------------------------- train()
 
     def train(self) -> Dict[str, Any]:
         """One iteration: run `training_step`, fold in sampler metrics."""
+        if self._podracer is not None:
+            return self._train_podracer()
         result = self.training_step()
         self.iteration += 1
         metrics = self.env_runner_group.get_metrics()
@@ -117,6 +160,22 @@ class Algorithm:
             result["evaluation"] = self.evaluate()["evaluation"]
         return result
 
+    def _train_podracer(self) -> Dict[str, Any]:
+        """One Sebulba iteration: read every learner rank's report off its
+        channel (the steady-state driver cost — shared-memory reads, zero
+        control-plane RPCs) and fold the relayed sampler metrics in."""
+        out = self._podracer.step()
+        self.iteration += 1
+        self._total_env_steps += out.pop("env_steps", 0)
+        result = dict(out.pop("metrics", {}))
+        result.update(out)
+        result.update({
+            "training_iteration": self.iteration,
+            "num_env_steps_sampled_lifetime": self._total_env_steps,
+            "time_total_s": time.time() - self._start,
+        })
+        return result
+
     # ------------------------------------------------------------ evaluation
 
     def _make_eval_runner_group(self):
@@ -128,6 +187,10 @@ class Algorithm:
             raise NotImplementedError(
                 "evaluate() supports single-agent configs; sample the "
                 "multi-agent runner group directly for eval")
+        if self._podracer is not None:
+            raise NotImplementedError(
+                "evaluate() is not wired for topology='sebulba' (the "
+                "learner ranks are dedicated by their run loops)")
         import copy as _copy
 
         return EnvRunnerGroup(
@@ -178,6 +241,9 @@ class Algorithm:
         }}
 
     def stop(self) -> None:
+        if self._podracer is not None:
+            self._podracer.shutdown()
+            return
         self.env_runner_group.stop()
         eval_group = getattr(self, "_eval_runner_group", None)
         if eval_group is not None:
@@ -210,6 +276,13 @@ class Algorithm:
         pass
 
     def get_state(self) -> Dict[str, Any]:
+        if self._podracer is not None:
+            # the learner ranks are dedicated by their run loops; weights
+            # live device-side in the topology, not in a driver-reachable
+            # LearnerGroup. Checkpoint from the dynamic topology instead.
+            raise RuntimeError(
+                "checkpointing is not supported under topology='sebulba'; "
+                "train with topology='dynamic' to checkpoint")
         learner = (
             {pid: lg.get_state() for pid, lg in self.learner_groups.items()}
             if self.learner_groups is not None
@@ -275,9 +348,4 @@ class Algorithm:
             self, samples: List[Dict[str, np.ndarray]]
     ) -> Dict[str, np.ndarray]:
         """Concatenate per-runner [T, B, ...] batches along B."""
-        out: Dict[str, np.ndarray] = {}
-        for k in samples[0]:
-            axis = 0 if samples[0][k].ndim == 1 else 1  # bootstrap_value: [B]
-            out[k] = (np.concatenate([s[k] for s in samples], axis=axis)
-                      if len(samples) > 1 else samples[0][k])
-        return out
+        return merge_time_major(samples)

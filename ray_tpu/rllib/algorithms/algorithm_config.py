@@ -29,6 +29,19 @@ class AlgorithmConfig:
         self.rollout_fragment_length: int = 64
         # learners
         self.num_learners: int = 0
+        # execution topology (rllib/podracer.py):
+        #   "dynamic" — the classic actor-learner loop (object-store
+        #   rollout transfer, per-iteration weight puts); the measured
+        #   baseline.
+        #   "sebulba" — Podracer split actor/learner pods: runners stream
+        #   fixed-shape trajectory batches into learner ranks through
+        #   depth-k slot-ring channels; fresh params broadcast back
+        #   device-to-device over a learner+runners collective group.
+        self.topology: str = "dynamic"
+        # trajectory-channel slot-ring depth (= the off-policy lag bound,
+        # in rollout batches); None reads RAY_TPU_PODRACER_CHANNEL_DEPTH.
+        # Explicit zeros are rejected, never silently defaulted.
+        self.podracer_channel_depth: Optional[int] = None
         # training
         self.gamma: float = 0.99
         self.lr: float = 5e-4
@@ -87,9 +100,24 @@ class AlgorithmConfig:
             rollout_fragment_length=rollout_fragment_length,
             env_to_module_connector=env_to_module_connector))
 
-    def learners(self, *, num_learners: Optional[int] = None
+    def learners(self, *, num_learners: Optional[int] = None,
+                 topology: Optional[str] = None,
+                 podracer_channel_depth: Optional[int] = None
                  ) -> "AlgorithmConfig":
-        return self._apply(dict(num_learners=num_learners))
+        if topology not in (None, "dynamic", "sebulba"):
+            raise ValueError(
+                f"topology must be 'dynamic' or 'sebulba', got {topology!r}")
+        if podracer_channel_depth is not None \
+                and int(podracer_channel_depth) < 1:
+            # the PR-8 depth=0 lesson: an explicit zero must raise here,
+            # not fall through a falsy-`or` chain to the env default
+            raise ValueError(
+                f"podracer_channel_depth must be >= 1, got "
+                f"{podracer_channel_depth!r} (explicit zeros are rejected,"
+                f" never silently defaulted)")
+        return self._apply(dict(
+            num_learners=num_learners, topology=topology,
+            podracer_channel_depth=podracer_channel_depth))
 
     def training(self, **kwargs) -> "AlgorithmConfig":
         return self._apply(kwargs)

@@ -21,6 +21,23 @@ from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.utils.advantages import vtrace_returns
 
 
+def to_column_major(s: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """[T, B, ...] rollout -> [B, T, ...] learner batch. Module-level so
+    the Sebulba learner actors (rllib/podracer.py) run byte-identical
+    batch prep to the dynamic loop — the learner-parity contract."""
+    obs = np.swapaxes(s["obs"], 0, 1)
+    return {
+        "obs": np.ascontiguousarray(
+            obs if obs.dtype == np.uint8 else obs.astype(np.float32)),
+        "actions": np.swapaxes(s["actions"], 0, 1).copy(),
+        "logp": np.swapaxes(s["logp"], 0, 1).copy(),
+        "rewards": np.swapaxes(s["rewards"], 0, 1).copy(),
+        "terminateds": np.swapaxes(s["terminateds"], 0, 1).copy(),
+        "truncateds": np.swapaxes(s["truncateds"], 0, 1).copy(),
+        "bootstrap_obs": np.asarray(s["next_obs"][-1]),
+    }
+
+
 class IMPALAConfig(AlgorithmConfig):
     def __init__(self):
         super().__init__()
@@ -51,10 +68,12 @@ class IMPALA(Algorithm):
         import jax
         import jax.numpy as jnp
 
-        obs = batch["obs"]                      # [B, T, D]
+        obs = batch["obs"]                      # [B, T, D] or [B, T, H, W, C]
         B, T = obs.shape[0], obs.shape[1]
+        # keep trailing obs dims: image observations must reach the conv
+        # torso as [N, H, W, C], not flattened rows
         logits, values = module.forward_train(
-            params, obs.reshape(B * T, -1))
+            params, obs.reshape((B * T,) + obs.shape[2:]))
         logp_all = jax.nn.log_softmax(logits)
         actions = batch["actions"].reshape(B * T)
         logp = jnp.take_along_axis(
@@ -90,17 +109,7 @@ class IMPALA(Algorithm):
 
     def _to_column_major(self, s: Dict[str, np.ndarray]
                          ) -> Dict[str, np.ndarray]:
-        """[T, B, ...] rollout -> [B, T, ...] learner batch."""
-        obs = np.swapaxes(s["obs"], 0, 1)
-        return {
-            "obs": np.ascontiguousarray(obs, np.float32),
-            "actions": np.swapaxes(s["actions"], 0, 1).copy(),
-            "logp": np.swapaxes(s["logp"], 0, 1).copy(),
-            "rewards": np.swapaxes(s["rewards"], 0, 1).copy(),
-            "terminateds": np.swapaxes(s["terminateds"], 0, 1).copy(),
-            "truncateds": np.swapaxes(s["truncateds"], 0, 1).copy(),
-            "bootstrap_obs": np.asarray(s["next_obs"][-1], np.float32),
-        }
+        return to_column_major(s)
 
     def _loss_cfg(self) -> Dict[str, float]:
         cfg: IMPALAConfig = self.config
@@ -111,6 +120,24 @@ class IMPALA(Algorithm):
             "vf_loss_coeff": cfg.vf_loss_coeff,
             "entropy_coeff": cfg.entropy_coeff,
         }
+
+    def _podracer_program(self):
+        """The Sebulba learner program for IMPALA: one V-trace update per
+        consumed runner batch, params broadcast every
+        ``broadcast_interval`` updates (converted to the topology's
+        iteration granularity of R/L updates), one train() consuming
+        ``num_batches_per_iteration`` batches like the dynamic loop —
+        the async off-policy shape; channel depth bounds how far runners
+        sample ahead."""
+        from ray_tpu.rllib.podracer import ImpalaSebulbaProgram
+
+        cfg: IMPALAConfig = self.config
+        return ImpalaSebulbaProgram(
+            spec=self.spec, loss_fn=type(self).loss_fn,
+            loss_cfg=self._loss_cfg(),
+            opt_cfg={"lr": cfg.lr, "grad_clip": cfg.grad_clip},
+            broadcast_interval=cfg.broadcast_interval,
+            num_batches_per_iteration=cfg.num_batches_per_iteration)
 
     def _maybe_broadcast(self) -> None:
         cfg: IMPALAConfig = self.config

@@ -18,6 +18,28 @@ from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.utils.advantages import compute_gae
 
 
+def prepare_train_batch(batch_tm: Dict[str, np.ndarray], *, gamma: float,
+                        lam: float) -> Dict[str, np.ndarray]:
+    """GAE over the merged [T, B] rollout, flattened to row-major train
+    columns. Module-level so the Sebulba learner actors
+    (rllib/podracer.py) run byte-identical batch prep to the dynamic
+    loop — the learner-parity contract."""
+    T, B = batch_tm["rewards"].shape
+    adv, targets = compute_gae(
+        batch_tm["rewards"], batch_tm["values"],
+        batch_tm["bootstrap_value"], batch_tm["terminateds"],
+        batch_tm["truncateds"], gamma=gamma, lam=lam)
+    return {
+        "obs": batch_tm["obs"].reshape(
+            (T * B,) + batch_tm["obs"].shape[2:]),
+        "actions": batch_tm["actions"].reshape(T * B),
+        "logp": batch_tm["logp"].reshape(T * B),
+        "values": batch_tm["values"].reshape(T * B),
+        "advantages": np.asarray(adv).reshape(T * B),
+        "value_targets": np.asarray(targets).reshape(T * B),
+    }
+
+
 class PPOConfig(AlgorithmConfig):
     def __init__(self):
         super().__init__()
@@ -82,6 +104,28 @@ class PPO(Algorithm):
         return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
                        "entropy": entropy, "mean_kl": kl}
 
+    def _podracer_program(self):
+        """The Sebulba learner program for PPO: merge the iteration's
+        runner batches, GAE, minibatch epochs with the dynamic loop's
+        exact RNG stream, adaptive-KL state on the learner side. PPO is
+        on-policy, so the topology pins broadcast_interval=1 — the param
+        broadcast is the iteration barrier."""
+        from ray_tpu.rllib.podracer import PPOSebulbaProgram
+
+        cfg: PPOConfig = self.config
+        return PPOSebulbaProgram(
+            spec=self.spec, loss_fn=type(self).loss_fn,
+            loss_cfg={
+                "clip_param": cfg.clip_param,
+                "vf_clip_param": cfg.vf_clip_param,
+                "vf_loss_coeff": cfg.vf_loss_coeff,
+                "entropy_coeff": cfg.entropy_coeff,
+            },
+            opt_cfg={"lr": cfg.lr, "grad_clip": cfg.grad_clip},
+            gamma=cfg.gamma, lam=cfg.lam, seed=cfg.seed,
+            num_epochs=cfg.num_epochs, minibatch_size=cfg.minibatch_size,
+            kl_coeff=cfg.kl_coeff, kl_target=cfg.kl_target)
+
     def training_step(self) -> Dict[str, Any]:
         if self.config.is_multi_agent:
             return self._multi_agent_training_step()
@@ -91,20 +135,7 @@ class PPO(Algorithm):
         T, B = batch_tm["rewards"].shape
         self._total_env_steps += T * B
 
-        adv, targets = compute_gae(
-            batch_tm["rewards"], batch_tm["values"],
-            batch_tm["bootstrap_value"], batch_tm["terminateds"],
-            batch_tm["truncateds"], gamma=cfg.gamma, lam=cfg.lam)
-
-        flat = {
-            "obs": batch_tm["obs"].reshape(
-                (T * B,) + batch_tm["obs"].shape[2:]),
-            "actions": batch_tm["actions"].reshape(T * B),
-            "logp": batch_tm["logp"].reshape(T * B),
-            "values": batch_tm["values"].reshape(T * B),
-            "advantages": np.asarray(adv).reshape(T * B),
-            "value_targets": np.asarray(targets).reshape(T * B),
-        }
+        flat = prepare_train_batch(batch_tm, gamma=cfg.gamma, lam=cfg.lam)
         loss_cfg = {
             "clip_param": cfg.clip_param,
             "vf_clip_param": cfg.vf_clip_param,

@@ -30,7 +30,9 @@ class Learner:
     def __init__(self, spec: RLModuleSpec, loss_fn: Callable,
                  optimizer_config: Optional[Dict[str, Any]] = None,
                  seed: int = 0, collective_rank: Optional[int] = None,
-                 collective_world: int = 1):
+                 collective_world: int = 1,
+                 collective_group: str = "learners",
+                 collective_init: bool = False):
         import jax
         import optax
 
@@ -48,6 +50,12 @@ class Learner:
         self.opt_state = self._optimizer.init(self.params)
         self._rank = collective_rank
         self._world = collective_world
+        # which collective group the grad allreduce rides: the default
+        # "learners" group is declared by the LearnerGroup driver; the
+        # podracer topology passes its own token-unique group name and
+        # collective_init=True (imperative, idempotent member-side init)
+        self._collective_group = collective_group
+        self._collective_init = collective_init
         self._jitted: Dict[Any, Callable] = {}
         # overlapped grad-allreduce driver (persistent landing buffers,
         # signature-keyed reallocation, copy-on-wait) — built lazily so
@@ -74,22 +82,69 @@ class Learner:
             self._jitted[cfg_key] = jax.jit(step)
         return self._jitted[cfg_key]
 
+    def _fused_step(self, cfg_key, loss_cfg):
+        """loss + grads + optimizer in ONE jitted program (world==1
+        only): the old eager optax update/apply pass cost more host time
+        per step than the jitted grads themselves on small models, and
+        on TPU it was a host round-trip between two device programs."""
+        import jax
+        import optax
+
+        key = ("fused",) + cfg_key
+        if key not in self._jitted:
+            def step(params, opt_state, batch):
+                (loss, metrics), grads = jax.value_and_grad(
+                    lambda p: self.loss_fn(self.module, p, batch, loss_cfg),
+                    has_aux=True)(params)
+                updates, new_opt = self._optimizer.update(
+                    grads, opt_state, params)
+                return (loss, metrics, optax.apply_updates(params, updates),
+                        new_opt)
+
+            self._jitted[key] = jax.jit(step)
+        return self._jitted[key]
+
+    def _apply_grads(self, grads):
+        """Jitted optimizer apply for the world>1 path (grads arrive from
+        the allreduce as host buffers; the update itself stays one
+        program)."""
+        import jax
+        import optax
+
+        if "apply" not in self._jitted:
+            def apply(params, opt_state, grads):
+                updates, new_opt = self._optimizer.update(
+                    grads, opt_state, params)
+                return optax.apply_updates(params, updates), new_opt
+
+            self._jitted["apply"] = jax.jit(apply)
+        self.params, self.opt_state = self._jitted["apply"](
+            self.params, self.opt_state, grads)
+
     def update_from_batch(self, batch: Dict[str, np.ndarray],
                           loss_cfg: Dict[str, Any]) -> Dict[str, float]:
-        import jax
         import jax.numpy as jnp
 
         cfg_key = tuple(sorted(loss_cfg.items()))
-        step = self._grad_step(cfg_key, loss_cfg)
         jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
-        loss, metrics, grads = step(self.params, self.opt_state, jbatch)
         if self._world > 1:
+            # the allreduce must run between grads and apply, so the
+            # update stays split into two programs here
+            step = self._grad_step(cfg_key, loss_cfg)
+            loss, metrics, grads = step(self.params, self.opt_state,
+                                        jbatch)
             grads = self._allreduce_grads(grads)
-        updates, self.opt_state = self._optimizer.update(
-            grads, self.opt_state, self.params)
-        import optax
+            self._apply_grads(grads)
+        else:
+            step = self._fused_step(cfg_key, loss_cfg)
+            loss, metrics, self.params, self.opt_state = step(
+                self.params, self.opt_state, jbatch)
+        import jax
 
-        self.params = optax.apply_updates(self.params, updates)
+        # ONE device sync for all metric scalars — a float() per entry
+        # costs a blocking transfer each, which rivals the update itself
+        # on small models
+        loss, metrics = jax.device_get((loss, metrics))
         out = {k: float(v) for k, v in metrics.items()}
         out["total_loss"] = float(loss)
         return out
@@ -110,9 +165,9 @@ class Learner:
             from ray_tpu.train._internal.gradients import GradientAverager
 
             self._grad_avg = GradientAverager(
-                group_name="learners", world_size=self._world,
+                group_name=self._collective_group, world_size=self._world,
                 rank=self._rank if self._rank is not None else 0,
-                init_group=False)
+                init_group=self._collective_init)
         return self._grad_avg.average(grads)
 
     # --------------------------------------------------------------- state

@@ -40,6 +40,9 @@ class SingleAgentEnvRunner:
         self.params = self.module.init_params(jax.random.PRNGKey(seed))
         self._explore_fn = jax.jit(self.module.forward_exploration)
         self._infer_fn = jax.jit(self.module.forward_inference)
+        # bootstrap-value forward at the fragment boundary: jitted, or
+        # every sample() pays an eager op-by-op dispatch pass
+        self._train_fn = jax.jit(self.module.forward_train)
         self._episode_returns = np.zeros(num_envs)
         self._episode_lens = np.zeros(num_envs, dtype=np.int64)
         self._finished_returns: List[float] = []
@@ -65,6 +68,32 @@ class SingleAgentEnvRunner:
         if len(self._spec.obs_shape) == 3 and obs.dtype == np.uint8:
             return obs
         return obs.astype(np.float32)
+
+    def zero_batch(self, num_steps: int) -> Dict[str, np.ndarray]:
+        """A zero-filled batch with exactly ``sample(num_steps)``'s shapes
+        and dtypes, WITHOUT stepping the env or advancing the RNG — the
+        podracer topology packs it once at build time to size the
+        fixed-shape trajectory channels (pickle-5 out-of-band buffer size
+        is content-independent, so the zeros measure the real payload).
+        The boundary obs is prepped once and cached, exactly like
+        sample()'s own path, so a stateful obs connector sees it once."""
+        cur = getattr(self, "_boundary_prepped", None)
+        if cur is None:
+            cur = self._prep_obs(self._obs)
+            self._boundary_prepped = cur
+        T, B = num_steps, self.num_envs
+        obs_shape = tuple(cur.shape[1:])
+        return {
+            "obs": np.zeros((T, B) + obs_shape, cur.dtype),
+            "actions": np.zeros((T, B), np.int64),
+            "logp": np.zeros((T, B), np.float32),
+            "values": np.zeros((T, B), np.float32),
+            "rewards": np.zeros((T, B), np.float32),
+            "terminateds": np.zeros((T, B), np.bool_),
+            "truncateds": np.zeros((T, B), np.bool_),
+            "next_obs": np.zeros((T, B) + obs_shape, cur.dtype),
+            "bootstrap_value": np.zeros(B, np.float32),
+        }
 
     def sample(self, num_steps: int,
                epsilon: Optional[float] = None,
@@ -138,8 +167,7 @@ class SingleAgentEnvRunner:
         # bootstrap value for the final observation of every column
         import jax.numpy as jnp
 
-        _, last_val = self.module.forward_train(
-            self.params, jnp.asarray(cur_prepped))
+        _, last_val = self._train_fn(self.params, jnp.asarray(cur_prepped))
         return {
             "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
             "values": val_buf, "rewards": rew_buf,

@@ -21,6 +21,49 @@ except ImportError:  # pragma: no cover
     gym = None
 
 
+def frame_bank(seed: int = 0, size: int = 32,
+               shape: Tuple[int, ...] = (84, 84, 4)) -> np.ndarray:
+    """The env's pre-generated frame bank (stepping = one index into it).
+    Module-level so the pure-JAX dynamics below and the gym env share
+    bit-identical frames for a given seed."""
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, size=(size,) + tuple(shape), dtype=np.uint8)
+
+
+# --------------------------------------------------- pure-JAX dynamics
+#
+# The gym env above is deliberately pure arithmetic (index + modulo), so
+# it admits an exact jittable mirror. This is what makes the Anakin
+# topology (rllib/podracer.py) possible: env.step fuses INTO the policy
+# rollout + gradient step as one XLA program — no host<->device ping-pong
+# per env step, the Podracer co-located shape.
+
+
+def jax_step(frames, episode_len: int, t, action):
+    """Vectorized jittable mirror of ``SyntheticAtariEnv.step``:
+    ``t`` [B] int32 step counters, ``action`` [B] int32 actions ->
+    (t_next, obs [B, H, W, C] uint8, reward [B] f32, truncated [B] bool).
+    Exactness vs the gym env is locked by a parity test."""
+    import jax.numpy as jnp
+
+    t1 = t + 1
+    obs = frames[(t1 * 7 + action) % frames.shape[0]]
+    reward = ((t1 + action) % 5 == 0).astype(jnp.float32)
+    truncated = t1 >= episode_len
+    return t1, obs, reward, truncated
+
+
+def jax_reset(frames, t, obs, truncated):
+    """Vectorized auto-reset (gym.vector semantics): truncated sub-envs
+    restart at step 0 observing frame 0."""
+    import jax.numpy as jnp
+
+    t = jnp.where(truncated, 0, t)
+    pad = (1,) * (obs.ndim - 1)
+    obs = jnp.where(truncated.reshape((-1,) + pad), frames[0][None], obs)
+    return t, obs
+
+
 if gym is not None:
 
     class SyntheticAtariEnv(gym.Env):
@@ -36,8 +79,9 @@ if gym is not None:
             self._rng = np.random.default_rng(seed)
             # a small bank of pre-generated frames: stepping costs one
             # index + one reward draw, like a cheap emulator frame
-            self._frames = self._rng.integers(
-                0, 256, size=(32, 84, 84, 4), dtype=np.uint8)
+            # (frame_bank consumes the same first rng draw, so frames are
+            # bit-identical to the pre-refactor env for a given seed)
+            self._frames = frame_bank(seed)
 
         def reset(self, *, seed: Optional[int] = None,
                   options=None) -> Tuple[np.ndarray, Dict]:

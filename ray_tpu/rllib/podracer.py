@@ -1,0 +1,1131 @@
+"""Podracer RL topologies: Anakin and Sebulba (arXiv:2104.06272).
+
+The dynamic actor-learner loop (`Algorithm.training_step`) moves every
+rollout batch through the object store and every weight sync through the
+control plane — per-iteration puts, gets and RPCs that scale with the
+runner count. Podracer describes the TPU-native alternatives; this module
+builds both on the fast-path substrate the previous PRs proved out:
+
+**Sebulba** (split actor/learner pods, `SebulbaTopology`): R env-runner
+actors stream fixed-shape trajectory batches into L learner ranks through
+depth-k slot-ring channels (`_private/channels.py`, the PR-8 protocol) —
+the writer backpressure IS the off-policy bound: a runner can sample at
+most ``podracer_channel_depth`` batches ahead of its learner consuming
+them. Learner ranks grad-sync with the async coalesced-mean allreduce
+(PR 6) and fresh params flow back to the runners device-to-device via
+``collective.broadcast`` over one learner+runners group (PR 4) — never
+an object-store put, never a per-runner ``set_weights`` RPC. A steady
+iteration is channel reads/writes + collective rounds only: ZERO
+control-plane RPCs per rank, counter-proven by the
+``ray_tpu_rpc_client_calls_total`` delta each report carries (the PR-3
+idiom). The driver's whole steady-state job is one shared-memory report
+read per learner per iteration.
+
+Schedule (iteration n, 1-based): every runner samples batch n and
+commits it at channel version 2n; its learner reads its runners' batches
+n, runs the algorithm's update program, and every
+``broadcast_interval``-th iteration all learners + all runners meet in a
+parameter broadcast (learner rank 0 is the root). With
+``broadcast_interval=1`` the broadcast is the iteration barrier and
+training is exactly the dynamic loop's on-policy math — the
+learner-parity tests pin this. At ``interval > 1`` (IMPALA's async
+shape) runners free-run ahead, bounded by min(depth, interval) batches
+of lag.
+
+**Anakin** (co-located, `AnakinTrainer`): a single process where the
+vectorized env step FUSES into the policy rollout and the gradient step
+as ONE jitted XLA program — possible because `SyntheticAtariEnv` is pure
+arithmetic with an exact jittable mirror
+(`synthetic_atari.jax_step`/`jax_reset`). No host<->device ping-pong per
+env step, no framework overhead at all: the co-located baseline-beater
+and the roofline for what Sebulba's split pods should approach.
+
+Algorithms wire on via ``AlgorithmConfig.learners(topology="sebulba")``
+— PPO and IMPALA implement ``_podracer_program()``; the dynamic loop
+stays the measured baseline (`bench_rllib.py` reports both).
+
+Failure semantics match the pipeline trainer: teardown or any
+participant's death closes every channel, blocked peers raise
+``ChannelClosedError`` instead of hanging, and a broken topology can
+produce an error, never a wrong update.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu._private import channels as _channels
+from ray_tpu._private import chaos, serialization
+from ray_tpu._private.exceptions import ChannelClosedError
+from ray_tpu._private.metrics import Counter
+
+logger = logging.getLogger(__name__)
+
+_m_iterations = Counter(
+    "ray_tpu_podracer_iterations_total",
+    "Sebulba learner iterations completed (per learner process)")
+_m_batches = Counter(
+    "ray_tpu_podracer_rollout_batches_total",
+    "Sebulba rollout batches streamed through trajectory channels")
+_m_broadcasts = Counter(
+    "ray_tpu_podracer_broadcasts_total",
+    "Device-to-device parameter broadcast rounds joined, by role")
+_m_env_steps = Counter(
+    "ray_tpu_podracer_env_steps_total",
+    "Env steps consumed by Sebulba learners (driver-side tally)")
+
+
+def require_positive(name: str, value, kind=int):
+    """Validate a topology knob: explicit zeros (and negatives) RAISE
+    instead of falling through a falsy-``or`` chain to some default —
+    the PR-8 ``depth=0`` / PR-9 ``slots=0`` lesson, enforced for every
+    ``RAY_TPU_PODRACER_*`` / topology knob."""
+    if value is None:
+        raise ValueError(f"{name} must be set")
+    v = kind(value)
+    if v <= 0:
+        raise ValueError(
+            f"{name} must be a positive {kind.__name__}, got {value!r} "
+            f"(explicit zeros are rejected, never silently replaced "
+            f"with a default)")
+    return v
+
+
+# ------------------------------------------------------------------- plans
+
+
+@dataclasses.dataclass
+class _RunnerPlan:
+    """Everything one env-runner actor needs for its streaming loop."""
+
+    out_spec: _channels.ChannelSpec  # trajectory channel (on learner node)
+    rollout: int  # fragment length per batch
+    bcast: Dict[str, Any]  # group/world/rank/root/interval/timeout_ms
+
+
+@dataclasses.dataclass
+class _LearnerPlan:
+    """Everything one learner rank needs for its consume/update loop."""
+
+    in_specs: List[_channels.ChannelSpec]  # its runners' channels (local)
+    report_spec: _channels.ChannelSpec  # learner -> driver, 1 per iteration
+    bcast: Dict[str, Any]
+
+
+# --------------------------------------------------------- learner programs
+
+
+class _SebulbaProgram:
+    """Algorithm-specific learner math, shipped (pickled) to the learner
+    actors. Subclasses implement ``update(learner, samples, iteration)``
+    where ``samples`` are the iteration's [T, B, ...] rollout dicts from
+    this rank's runners (zero-copy views over the trajectory channels —
+    valid until the loop acks, after update returns)."""
+
+    broadcast_interval = 1
+
+    def __init__(self, spec, loss_fn, loss_cfg, opt_cfg):
+        self.spec = spec
+        self.loss_fn = loss_fn
+        self.loss_cfg = dict(loss_cfg)
+        self.opt_cfg = dict(opt_cfg)
+
+    def make_learner(self, rank: int, world: int, seed: int,
+                     group_name: str):
+        from ray_tpu.rllib.core.learner import Learner
+
+        return Learner(
+            self.spec, self.loss_fn, dict(self.opt_cfg), seed=seed,
+            collective_rank=rank, collective_world=world,
+            collective_group=group_name, collective_init=True)
+
+    def iterations_per_step(self, num_runners: int) -> int:
+        """How many topology iterations one driver ``step()`` consumes
+        (each iteration = one batch per runner). IMPALA overrides this to
+        honor ``num_batches_per_iteration``."""
+        return 1
+
+    def update(self, learner, samples, iteration: int) -> Dict[str, float]:
+        raise NotImplementedError
+
+
+class ImpalaSebulbaProgram(_SebulbaProgram):
+    """One V-trace update per consumed runner batch (the dynamic sync
+    loop's math, batch for batch). ``broadcast_interval`` is in UPDATES
+    like the dynamic loop's knob; the topology converts it to iteration
+    granularity (one iteration = R/L updates per learner — the finest
+    schedulable sync point, exact whenever interval divides by R/L).
+    ``num_batches_per_iteration`` is honored at the driver: one train()
+    consumes ceil(nbpi / R) iterations, so batch and env-step accounting
+    matches the dynamic loop whenever nbpi is a multiple of R (the bench
+    harnesses pin this) and otherwise ROUNDS UP to whole iterations —
+    every runner contributes equally per iteration, so partial
+    iterations are not schedulable."""
+
+    def __init__(self, *, spec, loss_fn, loss_cfg, opt_cfg,
+                 broadcast_interval: int = 1,
+                 num_batches_per_iteration: int = 1):
+        super().__init__(spec, loss_fn, loss_cfg, opt_cfg)
+        self.broadcast_interval = require_positive(
+            "broadcast_interval", broadcast_interval)
+        self.num_batches_per_iteration = require_positive(
+            "num_batches_per_iteration", num_batches_per_iteration)
+
+    def iterations_per_step(self, num_runners: int) -> int:
+        return -(-self.num_batches_per_iteration // num_runners)
+
+    def update(self, learner, samples, iteration: int) -> Dict[str, float]:
+        from ray_tpu.rllib.algorithms.impala import to_column_major
+
+        metrics: Dict[str, float] = {}
+        for s in samples:
+            metrics = learner.update_from_batch(
+                to_column_major(s), self.loss_cfg)
+        return metrics
+
+
+class PPOSebulbaProgram(_SebulbaProgram):
+    """The dynamic PPO ``training_step`` math verbatim: merge the
+    iteration's runner batches, GAE, minibatch epochs over the SAME RNG
+    stream (``seed + iteration - 1``), adaptive-KL state held learner-side.
+    PPO is on-policy, so ``broadcast_interval`` is pinned to 1 — the
+    param broadcast is the iteration barrier that keeps rollouts
+    on-policy."""
+
+    broadcast_interval = 1
+
+    def __init__(self, *, spec, loss_fn, loss_cfg, opt_cfg, gamma, lam,
+                 seed, num_epochs, minibatch_size, kl_coeff, kl_target):
+        super().__init__(spec, loss_fn, loss_cfg, opt_cfg)
+        self.gamma = float(gamma)
+        self.lam = float(lam)
+        self.seed = int(seed)
+        self.num_epochs = require_positive("num_epochs", num_epochs)
+        self.minibatch_size = require_positive(
+            "minibatch_size", minibatch_size)
+        self.kl_target = float(kl_target)
+        self._kl_coeff = float(kl_coeff)
+
+    def update(self, learner, samples, iteration: int) -> Dict[str, float]:
+        from ray_tpu.rllib.algorithms.algorithm import merge_time_major
+        from ray_tpu.rllib.algorithms.ppo import prepare_train_batch
+
+        flat = prepare_train_batch(
+            merge_time_major(samples), gamma=self.gamma, lam=self.lam)
+        n = len(flat["actions"])
+        mb = min(self.minibatch_size, n)
+        rng = np.random.default_rng(self.seed + iteration - 1)
+        last: Dict[str, float] = {}
+        for _ in range(self.num_epochs):
+            perm = rng.permutation(n)
+            for lo in range(0, n - mb + 1, mb):
+                idx = perm[lo:lo + mb]
+                minibatch = {k: v[idx] for k, v in flat.items()}
+                minibatch["kl_coeff"] = np.full(
+                    len(idx), self._kl_coeff, np.float32)
+                last = learner.update_from_batch(minibatch, self.loss_cfg)
+        kl = last.get("mean_kl", 0.0)
+        if learner._world > 1:
+            # each rank measures mean_kl on its OWN runners' minibatches;
+            # adapting per-rank would fork the KL controllers (x1.5 on
+            # one rank, x1.0 on another — never resynced, since the
+            # param broadcast carries weights, not program state). One
+            # scalar mean over the grad group keeps every rank's
+            # kl_coeff column identical.
+            from ray_tpu.util import collective as col
+            from ray_tpu.util.collective.types import ReduceOp
+
+            kl = float(col.allreduce(
+                np.asarray([kl], np.float32),
+                group_name=learner._collective_group,
+                op=ReduceOp.MEAN)[0])
+        if kl > 2.0 * self.kl_target:
+            self._kl_coeff *= 1.5
+        elif kl < 0.5 * self.kl_target:
+            self._kl_coeff *= 0.5
+        last["kl_coeff"] = self._kl_coeff
+        return last
+
+
+# ----------------------------------------------- param broadcast plumbing
+
+
+def _all_f32(leaves) -> bool:
+    return all(str(getattr(x, "dtype", "")) == "float32" for x in leaves)
+
+
+def _broadcast_tree_send(col, b: Dict[str, Any], host_tree) -> None:
+    """Root side of one param sync: float32 trees (every RLModule)
+    coalesce into ONE flat broadcast round; mixed-dtype trees fall back
+    to a round per leaf (receivers derive the layout from their own
+    identically-structured params, so no header round is needed)."""
+    import jax
+
+    leaves = [np.ascontiguousarray(x) for x in jax.tree.leaves(host_tree)]
+    if _all_f32(leaves):
+        flat = (leaves[0].ravel() if len(leaves) == 1
+                else np.concatenate([x.ravel() for x in leaves]))
+        col.broadcast(flat, src_rank=b["root"], group_name=b["group"],
+                      timeout_ms=b["timeout_ms"])
+        return
+    for leaf in leaves:
+        col.broadcast(leaf, src_rank=b["root"], group_name=b["group"],
+                      timeout_ms=b["timeout_ms"])
+
+
+def _broadcast_tree_recv(col, b: Dict[str, Any], template_tree):
+    """Receiver side: same rounds as the root, unpacked into the
+    template's structure/shapes."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(template_tree)
+    if _all_f32(leaves):
+        flat = col.broadcast(np.empty(0, np.float32), src_rank=b["root"],
+                             group_name=b["group"],
+                             timeout_ms=b["timeout_ms"])
+        out, off = [], 0
+        for leaf in leaves:
+            n = int(np.prod(leaf.shape)) if leaf.shape else 1
+            out.append(np.asarray(flat[off:off + n]).reshape(leaf.shape))
+            off += n
+        if off != flat.size:
+            raise ValueError(
+                f"broadcast payload carries {flat.size} params, receiver "
+                f"template expects {off} — mismatched module specs")
+        return jax.tree.unflatten(treedef, out)
+    fresh = [col.broadcast(np.empty(0, np.float32), src_rank=b["root"],
+                           group_name=b["group"],
+                           timeout_ms=b["timeout_ms"])
+             for _ in leaves]
+    return jax.tree.unflatten(treedef, fresh)
+
+
+# ------------------------------------------------------- actor-side loops
+
+
+def _open_local_factory(core):
+    """(open_local, local_dict, release_pins) triple over this process's
+    arena — the pipeline stage loop's pin/open bookkeeping, shared."""
+    local: Dict[bytes, _channels.LocalChannel] = {}
+
+    def open_local(spec: _channels.ChannelSpec) -> _channels.LocalChannel:
+        ch = local.get(spec.key())
+        if ch is None:
+            _channels._pin_local_channel(core, spec)
+            ch = _channels.LocalChannel(core.arena, spec)
+            local[spec.key()] = ch
+        return ch
+
+    def release_pins() -> None:
+        from ray_tpu._private.ids import ObjectID
+
+        for key in local:
+            core._schedule_unpin(ObjectID(key))
+
+    return open_local, local, release_pins
+
+
+class _SebulbaRunnerImpl:
+    """Env-runner actor body: wraps the standard SingleAgentEnvRunner (so
+    sampling math is byte-identical to the dynamic loop) and streams its
+    rollouts through a trajectory channel instead of returning them
+    through the object store."""
+
+    def __init__(self, env_name, spec, num_envs, seed, env_config,
+                 obs_connector):
+        from ray_tpu.rllib.env.single_agent_env_runner import (
+            SingleAgentEnvRunner)
+
+        self._runner = SingleAgentEnvRunner(
+            env_name, spec, num_envs=num_envs, seed=seed,
+            env_config=env_config, obs_connector=obs_connector)
+
+    def ping(self) -> str:
+        return "ok"
+
+    def probe_payload_bytes(self, rollout: int) -> int:
+        """Packed size of one trajectory payload (content-independent:
+        pickle-5 out-of-band buffers dominate) — the driver sizes the
+        fixed-shape channels off this, so a too-small buffer can never
+        surface as a mid-training write failure."""
+        payload = serialization.pack({
+            "batch": self._runner.zero_batch(rollout),
+            "metrics": {"episode_return_mean": 0.0,
+                        "episode_len_mean": 0.0, "num_episodes": 0},
+            "iteration": 0, "rpc_calls": 0})
+        return len(payload)
+
+    def run_loop(self, plan: _RunnerPlan) -> dict:
+        import jax
+
+        from ray_tpu._private import api, rpc
+        from ray_tpu.util import collective as col
+
+        core = api._core
+        if core is None:
+            raise RuntimeError("sebulba runner loop outside a worker")
+        open_local, local, release_pins = _open_local_factory(core)
+        remote_specs: List[_channels.ChannelSpec] = []
+        try:
+            out = _channels.VersionedWriter(core, plan.out_spec, open_local)
+            if not out.is_local:
+                remote_specs.append(plan.out_spec)
+        except BaseException:
+            release_pins()
+            raise
+
+        def close_everything() -> None:
+            _channels.close_channels_nowait(
+                core, local.values(), remote_specs)
+
+        b = plan.bcast
+        group_ready = [False]
+
+        def recv_params() -> None:
+            if not group_ready[0]:
+                col.init_collective_group(
+                    b["world"], b["rank"], backend="host",
+                    group_name=b["group"])
+                group_ready[0] = True
+            self._runner.set_weights(_broadcast_tree_recv(
+                col, b, self._runner.params))
+            _m_broadcasts.inc(labels={"role": "runner"})
+
+        n = 0
+        prev_rpc = rpc._m_client_calls.total()
+        try:
+            # round 0: the learners' init params, before the first sample
+            # (the dynamic loop's constructor-time _sync_weights)
+            recv_params()
+            while True:
+                chaos.maybe_crash("worker.podracer_step")
+                n += 1
+                batch = self._runner.sample(plan.rollout)
+                metrics = self._runner.get_metrics()
+                now = rpc._m_client_calls.total()
+                payload = serialization.pack({
+                    "batch": batch, "metrics": metrics, "iteration": n,
+                    "rpc_calls": now - prev_rpc})
+                prev_rpc = now
+                out.write(payload, 2 * n)
+                _m_batches.inc()
+                if n % b["interval"] == 0:
+                    recv_params()
+        except ChannelClosedError:
+            # normal exit: teardown (or a peer's death) closed the
+            # channels; re-fan the close so every peer unwinds
+            try:
+                close_everything()
+            except Exception:
+                logger.exception("runner close-on-exit failed")
+            return {"batches": n}
+        except BaseException:
+            try:
+                close_everything()
+            except Exception:
+                logger.exception("runner close-on-error failed")
+            raise
+        finally:
+            try:
+                if group_ready[0]:
+                    col.destroy_collective_group(b["group"])
+            except Exception:
+                pass
+            release_pins()
+
+    def stop(self) -> None:
+        self._runner.stop()
+
+
+class _SebulbaLearnerImpl:
+    """Learner-rank actor body: consumes its runners' trajectory channels,
+    runs the algorithm program (grads allreduced over the learner group
+    when world > 1), broadcasts fresh params at the interval, and writes
+    one report per iteration back to the driver."""
+
+    def __init__(self, program: _SebulbaProgram, rank: int, world: int,
+                 seed: int, grad_group: str):
+        self._program = program
+        self._learner = program.make_learner(rank, world, seed, grad_group)
+        self._rank = int(rank)
+
+    def ping(self) -> str:
+        return "ok"
+
+    def run_loop(self, plan: _LearnerPlan) -> dict:
+        import jax
+
+        from ray_tpu._private import api, rpc
+        from ray_tpu.util import collective as col
+
+        core = api._core
+        if core is None:
+            raise RuntimeError("sebulba learner loop outside a worker")
+        open_local, local, release_pins = _open_local_factory(core)
+        remote_specs: List[_channels.ChannelSpec] = []
+        try:
+            # trajectory channels live on THIS learner's node (reader-side
+            # placement), so consuming them is always a local seqlock read
+            in_chs = [open_local(s) for s in plan.in_specs]
+            report_w = _channels.VersionedWriter(
+                core, plan.report_spec, open_local)
+            if not report_w.is_local:
+                remote_specs.append(plan.report_spec)
+        except BaseException:
+            release_pins()
+            raise
+
+        def close_everything() -> None:
+            _channels.close_channels_nowait(
+                core, local.values(), remote_specs)
+
+        b = plan.bcast
+        group_ready = [False]
+
+        def sync_params() -> None:
+            if not group_ready[0]:
+                col.init_collective_group(
+                    b["world"], b["rank"], backend="host",
+                    group_name=b["group"])
+                group_ready[0] = True
+            if b["rank"] == b["root"]:
+                _broadcast_tree_send(
+                    col, b, jax.tree.map(np.asarray, self._learner.params))
+            else:
+                # non-root learners receive too: allreduced updates keep
+                # ranks identical already, but taking the root's bytes
+                # makes the sync exact by construction
+                self._learner.set_weights(_broadcast_tree_recv(
+                    col, b, self._learner.params))
+            _m_broadcasts.inc(labels={"role": "learner"})
+
+        n = 0
+        prev_rpc = rpc._m_client_calls.total()
+        try:
+            sync_params()  # round 0: deliver init params to the runners
+            while True:
+                chaos.maybe_crash("worker.podracer_step")
+                n += 1
+                t_iter = time.perf_counter()
+                msgs = [serialization.unpack(ch.read(2 * n))
+                        for ch in in_chs]
+                t_read = time.perf_counter()
+                samples = [m["batch"] for m in msgs]
+                env_steps = sum(int(np.size(s["rewards"]))
+                                for s in samples)
+                runner_metrics = [dict(m["metrics"]) for m in msgs]
+                runner_rpc = int(sum(int(m["rpc_calls"]) for m in msgs))
+                metrics = self._program.update(self._learner, samples, n)
+                # the update consumed the zero-copy views (device/host
+                # copies made); release the writers
+                del samples, msgs
+                for ch in in_chs:
+                    ch.ack(0, 2 * n)
+                t_update = time.perf_counter()
+                if n % b["interval"] == 0:
+                    sync_params()
+                _m_iterations.inc()
+                now = rpc._m_client_calls.total()
+                report = {
+                    "iteration": n,
+                    "learner_rank": self._rank,
+                    "metrics": metrics,
+                    "env_steps": env_steps,
+                    "runner_metrics": runner_metrics,
+                    "runner_rpc_calls": runner_rpc,
+                    # this rank's outbound-RPC delta over the whole
+                    # iteration (reads, update, allreduce, broadcast) —
+                    # the steady-state zero-RPC proof rides in-band
+                    "rpc_calls": now - prev_rpc,
+                    "iterations_total": _m_iterations.value(),
+                    # where the iteration went: waiting on rollouts
+                    # (sampler-bound), updating (learner-bound), or
+                    # syncing params
+                    "wait_s": t_read - t_iter,
+                    "update_s": t_update - t_read,
+                    "bcast_s": time.perf_counter() - t_update,
+                }
+                prev_rpc = now
+                report_w.write(serialization.pack(report), 2 * n)
+        except ChannelClosedError:
+            try:
+                close_everything()
+            except Exception:
+                logger.exception("learner close-on-exit failed")
+            return {"iterations": n}
+        except BaseException:
+            try:
+                close_everything()
+            except Exception:
+                logger.exception("learner close-on-error failed")
+            raise
+        finally:
+            try:
+                if group_ready[0]:
+                    col.destroy_collective_group(b["group"])
+            except Exception:
+                pass
+            release_pins()
+
+    def fetch_weights(self):
+        """Host copy of the params (valid before the loop starts or after
+        it exits — the run loop dedicates this actor)."""
+        return self._learner.get_weights()
+
+
+_runner_actor_cls = None
+_learner_actor_cls = None
+
+
+def _runner_actor():
+    global _runner_actor_cls
+    if _runner_actor_cls is None:
+        import ray_tpu
+
+        _runner_actor_cls = ray_tpu.remote(_SebulbaRunnerImpl)
+    return _runner_actor_cls
+
+
+def _learner_actor():
+    global _learner_actor_cls
+    if _learner_actor_cls is None:
+        import ray_tpu
+
+        _learner_actor_cls = ray_tpu.remote(_SebulbaLearnerImpl)
+    return _learner_actor_cls
+
+
+# ------------------------------------------------------------ the topology
+
+
+class SebulbaTopology:
+    """Compiled split actor/learner RL topology (module docstring).
+
+    Built by ``Algorithm`` when the config says
+    ``.learners(topology="sebulba")``; tests and the chaos soak construct
+    it directly to control actor placement::
+
+        topo = SebulbaTopology(config, program,
+                               runner_options=[{"resources": {"a": 1}}],
+                               learner_options=[{"resources": {"b": 1}}])
+        out = topo.step()      # one iteration's merged learner reports
+        topo.shutdown()
+    """
+
+    def __init__(self, config, program: _SebulbaProgram, *,
+                 runner_options: Optional[Sequence[dict]] = None,
+                 learner_options: Optional[Sequence[dict]] = None,
+                 name: str = "sebulba"):
+        import ray_tpu
+        from ray_tpu._private import api
+
+        core = api._require_core()
+        self._core = core
+        R = int(config.num_env_runners)
+        if R < 1:
+            raise ValueError(
+                "topology='sebulba' needs num_env_runners >= 1 (runners "
+                "are dedicated streaming actors; there is no local mode)")
+        L = max(1, int(config.num_learners))
+        if R % L != 0:
+            raise ValueError(
+                f"num_env_runners ({R}) must divide evenly across "
+                f"num_learners ({L}) — every learner rank consumes a "
+                f"fixed runner set")
+        depth = config.podracer_channel_depth
+        if depth is None:
+            depth = core.config.podracer_channel_depth
+        self._depth = require_positive("podracer_channel_depth", depth)
+        interval_updates = require_positive(
+            "broadcast_interval",
+            getattr(program, "broadcast_interval", 1))
+        # the dynamic loop counts broadcast_interval in UPDATES; one
+        # sebulba iteration runs R/L updates per learner, so convert to
+        # iteration granularity (the finest schedulable sync point —
+        # runners can only join a broadcast at batch boundaries). Exact
+        # whenever the interval divides by R/L; otherwise the nearest
+        # iteration count, never less than every iteration.
+        per = R // L
+        interval = max(1, round(interval_updates / per))
+        self._bcast_timeout_ms = int(1000 * require_positive(
+            "podracer_bcast_timeout_s",
+            core.config.podracer_bcast_timeout_s, kind=float))
+        rollout = require_positive(
+            "rollout_fragment_length", config.rollout_fragment_length)
+        self._R, self._L, self._interval = R, L, interval
+        # one driver step() consumes this many iterations, so train()
+        # batch / env-step accounting matches the dynamic loop's
+        # num_batches_per_iteration
+        self._iters_per_step = require_positive(
+            "iterations_per_step", program.iterations_per_step(R))
+        self._it = 0
+        self._dead = False
+        self._torn = False
+        self._teardown_lock = threading.Lock()
+        self._all_specs: List[_channels.ChannelSpec] = []
+        self._local_channels: Dict[bytes, _channels.LocalChannel] = {}
+        self._loop_refs: List[Any] = []
+        self._actor_info: Dict[str, dict] = {}
+        self._runners: List[Any] = []
+        self._learners: List[Any] = []
+
+        # per-topology token: two concurrently-live topologies must never
+        # meet in collective rendezvous (the pipeline trainer's rule)
+        token = uuid.uuid4().hex[:8]
+        self._bcast_group = f"{name}.{token}.bcast"
+        grad_group = f"{name}.{token}.grads"
+
+        runner_cls = _runner_actor()
+        learner_cls = _learner_actor()
+
+        def options_for(cls, opts, i):
+            o = dict(opts[i]) if opts and i < len(opts) and opts[i] else {}
+            o.setdefault("num_cpus", 1)
+            return cls.options(**o)
+
+        spec = program.spec
+        # everything past this point can strand live actors on failure
+        # (ActorHandles have no GC-kill), so ANY mid-build error unwinds
+        # through shutdown() — which kills whatever was already created
+        try:
+            self._runners = [
+                options_for(runner_cls, runner_options, i).remote(
+                    config.env, spec, config.num_envs_per_env_runner,
+                    # seed + 1000*i: the EnvRunnerGroup actor seeding, so
+                    # runner i samples the same stream as the dynamic
+                    # loop's
+                    config.seed + 1000 * i, config.env_config,
+                    config.env_to_module_connector)
+                for i in range(R)]
+            self._learners = [
+                options_for(learner_cls, learner_options, i).remote(
+                    program, i, L, config.seed, grad_group)
+                for i in range(L)]
+            ray_tpu.get([a.ping.remote()
+                         for a in self._runners + self._learners],
+                        timeout=180)
+
+            # fixed-shape channel sizing off one packed zero batch (+25%
+            # and a floor of slack for the metrics dict)
+            probe = int(ray_tpu.get(
+                self._runners[0].probe_payload_bytes.remote(rollout),
+                timeout=120))
+            self._buffer = probe + probe // 4 + 64 * 1024
+            self._build_channels(config)
+        except BaseException:
+            try:
+                self.shutdown()
+            except Exception:
+                logger.debug("sebulba build unwind failed", exc_info=True)
+            raise
+
+    # -- properties the microbenchmark fallback guards key on
+
+    @property
+    def is_channel_backed(self) -> bool:
+        return bool(self._all_specs) and not self._dead
+
+    @property
+    def channel_depth(self) -> int:
+        return self._depth
+
+    @property
+    def num_runners(self) -> int:
+        return self._R
+
+    @property
+    def num_learners(self) -> int:
+        return self._L
+
+    # -- build
+
+    def _create_channel(self, node_addr, participants, *, depth: int,
+                        buffer: int) -> _channels.ChannelSpec:
+        core = self._core
+        spec = _channels.create_channel(
+            core, node_addr, buffer, depth, 1, participants)
+        self._all_specs.append(spec)
+        if tuple(node_addr) == tuple(core.supervisor_addr):
+            self._local_channels[spec.key()] = _channels.LocalChannel(
+                core.arena, spec)
+        return spec
+
+    def _build_channels(self, config) -> None:
+        core = self._core
+        driver_node = tuple(core.supervisor_addr)
+        if core.arena is None:
+            raise RuntimeError(
+                "sebulba channels need a driver attached to a node arena")
+        ctrl = core.clients.get(core.controller_addr)
+        views = core._run(ctrl.call("node_views"))
+        for a in self._runners + self._learners:
+            hexid = a._actor_id.hex()
+            self._actor_info[hexid] = _channels.resolve_actor_placement(
+                core, a._actor_id, views)
+
+        # any participant's death closes everything: learners are serially
+        # fed by their runners and all ranks meet at the broadcast, so no
+        # subset can make progress alone
+        participants = {core._store_client_id}
+        for info in self._actor_info.values():
+            participants.add(info["worker_id_hex"])
+            participants.add(f"node:{info['node_id_hex']}")
+
+        def node_of(actor):
+            return self._actor_info[actor._actor_id.hex()]["node_addr"]
+
+        per = self._R // self._L
+        world = self._L + self._R
+
+        def bcast(rank):
+            return {"group": self._bcast_group, "world": world,
+                    "rank": rank, "root": 0, "interval": self._interval,
+                    "timeout_ms": self._bcast_timeout_ms}
+
+        # trajectory channels live on the READER's (learner's) node: a
+        # same-node runner writes the seqlock directly, a cross-node
+        # runner pushes through the chunked mirror path
+        traj = [self._create_channel(
+            node_of(self._learners[r // per]), participants,
+            depth=self._depth, buffer=self._buffer)
+            for r in range(self._R)]
+        # reports carry one small stats dict per iteration; a shallow
+        # slot ring (not depth 1) lets learners run a few iterations
+        # ahead of the driver draining reports, so the driver's poll
+        # cadence never paces the learner ranks
+        reports = [self._create_channel(
+            driver_node, participants, depth=min(self._depth, 4),
+            buffer=256 * 1024)
+            for _ in range(self._L)]
+        self._report_readers = [
+            self._local_channels[sp.key()] for sp in reports]
+
+        for hexid in self._actor_info:
+            core.subscribe("actor:" + hexid, self._on_actor_update)
+
+        rollout = int(config.rollout_fragment_length)
+        for r, actor in enumerate(self._runners):
+            self._loop_refs.append(actor.run_loop.remote(_RunnerPlan(
+                out_spec=traj[r], rollout=rollout,
+                bcast=bcast(self._L + r))))
+        for l, actor in enumerate(self._learners):
+            self._loop_refs.append(actor.run_loop.remote(_LearnerPlan(
+                in_specs=traj[l * per:(l + 1) * per],
+                report_spec=reports[l], bcast=bcast(l))))
+
+    # -- failure fan-out (the pipeline trainer's shape)
+
+    def _on_actor_update(self, message) -> None:
+        if self._dead or not isinstance(message, dict):
+            return
+        if message.get("state") in ("DEAD", "RESTARTING"):
+            self._close_for_failure()
+
+    def _close_for_failure(self) -> None:
+        self._dead = True
+        _channels.close_channels_nowait(
+            self._core, self._local_channels.values(), self._all_specs)
+
+    def _surface_failure(self, closed: ChannelClosedError):
+        self._close_for_failure()
+        _channels.surface_loop_failure(self._core, self._loop_refs, closed)
+
+    # -- stepping
+
+    def step(self) -> Dict[str, Any]:
+        """One driver step: read every learner rank's report for the next
+        ``iterations_per_step`` iterations (shared-memory seqlock reads —
+        the driver's entire steady-state cost) and merge. Raises cleanly
+        if the topology died."""
+        if self._dead:
+            raise ChannelClosedError("sebulba topology was torn down")
+        reports: List[dict] = []
+        try:
+            for _ in range(self._iters_per_step):
+                rv = 2 * (self._it + 1)
+                for ch in self._report_readers:
+                    view = ch.read(rv)
+                    rep = serialization.unpack(bytes(view))
+                    del view
+                    ch.ack(0, rv)
+                    reports.append(rep)
+                self._it += 1
+        except ChannelClosedError as e:
+            self._surface_failure(e)
+        env_steps = int(sum(r["env_steps"] for r in reports))
+        _m_env_steps.inc(env_steps)
+        keys = reports[0]["metrics"].keys()
+        metrics = {k: float(np.mean([r["metrics"][k] for r in reports]))
+                   for k in keys}
+        returns: List[float] = []
+        lens: List[float] = []
+        episodes = 0
+        for rep in reports:
+            for m in rep["runner_metrics"]:
+                cnt = int(m.get("num_episodes", 0))
+                episodes += cnt
+                if cnt and m.get("episode_return_mean") is not None:
+                    returns.extend([m["episode_return_mean"]] * cnt)
+                    lens.extend([m["episode_len_mean"]] * cnt)
+        return {
+            "metrics": metrics,
+            "env_steps": env_steps,
+            "reports": reports,
+            "episode_return_mean": (float(np.mean(returns))
+                                    if returns else None),
+            "episode_len_mean": float(np.mean(lens)) if lens else None,
+            "num_episodes": episodes,
+        }
+
+    # -- introspection / teardown
+
+    def fetch_weights(self, learner_rank: int = 0):
+        """Learner params (after shutdown(kill_actors=False) — the run
+        loop dedicates the actor while the topology lives)."""
+        import ray_tpu
+
+        return ray_tpu.get(
+            self._learners[learner_rank].fetch_weights.remote(),
+            timeout=120)
+
+    def shutdown(self, kill_actors: bool = True,
+                 timeout: float = 30) -> Dict[str, Any]:
+        """Close every channel, drain the loops, release the pins,
+        (optionally) kill the actors. Idempotent."""
+        from ray_tpu._private.core_worker import _m_pins
+
+        self._dead = True
+        with self._teardown_lock:
+            if self._torn:
+                return {}
+            self._torn = True
+        core = self._core
+        for ch in self._local_channels.values():
+            try:
+                ch.close()
+            except Exception:
+                pass
+        for hexid in self._actor_info:
+            try:
+                core.unsubscribe("actor:" + hexid, self._on_actor_update)
+            except Exception:
+                pass
+
+        async def close_all():
+            for spec in self._all_specs:
+                try:
+                    await core.clients.get(tuple(spec.node_addr)).call(
+                        "channel_close",
+                        {"channel_id": spec.channel_id}, timeout=10)
+                except Exception:
+                    logger.debug("channel_close failed", exc_info=True)
+
+        if self._all_specs:
+            try:
+                core._run(close_all(), timeout=30)
+            except Exception:
+                logger.debug("sebulba close fan-out failed", exc_info=True)
+        stats: Dict[str, Any] = {"loops": []}
+        for ref in self._loop_refs:
+            try:
+                stats["loops"].append(core.get([ref], timeout=timeout)[0])
+            except Exception:
+                stats["loops"].append(None)
+
+        async def release_all():
+            for spec in self._all_specs:
+                client = core.clients.get(tuple(spec.node_addr))
+                try:
+                    await client.call(
+                        "store_free",
+                        {"object_ids": [spec.channel_id]}, timeout=10)
+                    await client.call(
+                        "store_unpin",
+                        {"object_id": spec.channel_id,
+                         "client": core._store_client_id}, timeout=10)
+                    _m_pins.dec()
+                except Exception:
+                    logger.debug(
+                        "channel pin release failed (reclaimed by the "
+                        "supervisor's dead-client sweep)", exc_info=True)
+
+        if self._all_specs:
+            try:
+                core._run(release_all(), timeout=60)
+            except Exception:
+                logger.debug("sebulba release fan-out failed",
+                             exc_info=True)
+        if kill_actors:
+            import ray_tpu
+
+            for a in self._runners + self._learners:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
+        return stats
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass
+
+
+# ----------------------------------------------------------------- Anakin
+
+
+class AnakinTrainer:
+    """Podracer's co-located topology: vectorized env + learner in ONE
+    process, with env.step fused into the policy rollout and gradient
+    step as a single jitted XLA program (``lax.scan`` over the pure-JAX
+    SyntheticAtari dynamics). The actor-critic update is the V-trace
+    shape (on-policy here, so rho == c == 1 by construction).
+
+        trainer = AnakinTrainer(num_envs=64, rollout=16)
+        out = trainer.train(iterations=100)   # {"total_loss", ...,
+                                              #  "env_steps_per_sec"}
+
+    Pass a small ``frames`` bank + an MLP ``module_spec`` for cheap CI
+    runs; the default is the 84x84x4 Nature-CNN Atari shape.
+    """
+
+    def __init__(self, *, num_envs: int = 32, rollout: int = 16,
+                 episode_len: int = 1000, frames=None, module_spec=None,
+                 num_actions: int = 6, lr: float = 3e-4,
+                 gamma: float = 0.99, entropy_coeff: float = 0.01,
+                 vf_loss_coeff: float = 0.5, grad_clip: float = 0.5,
+                 seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rllib.core.rl_module import RLModuleSpec, make_module
+        from ray_tpu.rllib.env import synthetic_atari as sa
+        from ray_tpu.rllib.utils.advantages import vtrace_returns
+
+        num_envs = require_positive("num_envs", num_envs)
+        rollout = require_positive("rollout", rollout)
+        episode_len = require_positive("episode_len", episode_len)
+        frames_np = (sa.frame_bank(seed) if frames is None
+                     else np.asarray(frames))
+        obs_shape = tuple(int(x) for x in frames_np.shape[1:])
+        if module_spec is None:
+            module_spec = RLModuleSpec(
+                obs_dim=int(np.prod(obs_shape)), num_actions=num_actions,
+                obs_shape=obs_shape)
+        self.spec = module_spec
+        self.module = make_module(module_spec)
+        self.num_envs, self.rollout = num_envs, rollout
+        self.params = self.module.init_params(jax.random.PRNGKey(seed))
+        self._opt = optax.chain(
+            optax.clip_by_global_norm(grad_clip), optax.adam(lr))
+        self.opt_state = self._opt.init(self.params)
+        self._key = jax.random.PRNGKey(seed + 1)
+        self._t = jnp.zeros(num_envs, jnp.int32)
+        self._obs = jnp.array(jnp.broadcast_to(
+            jnp.asarray(frames_np[0]), (num_envs,) + obs_shape))
+        self._iterations = 0
+        self._env_steps = 0
+
+        frames_j = jnp.asarray(frames_np)
+        module = self.module
+        conv = len(module_spec.obs_shape) == 3
+        uint8 = frames_np.dtype == np.uint8
+        opt = self._opt
+
+        def prep(obs):
+            if conv:
+                return obs  # the conv stem normalizes uint8 itself
+            x = obs.reshape(obs.shape[0], -1).astype(jnp.float32)
+            return x / 255.0 if uint8 else x
+
+        def update(params, opt_state, t, obs, key):
+            def env_policy_step(carry, _):
+                t, obs, key = carry
+                key, sub = jax.random.split(key)
+                logits, value = module.forward_train(params, prep(obs))
+                action = jax.random.categorical(sub, logits)
+                logp = jax.nn.log_softmax(logits)[
+                    jnp.arange(logits.shape[0]), action]
+                t1, obs1, reward, trunc = sa.jax_step(
+                    frames_j, episode_len, t, action.astype(jnp.int32))
+                t1, obs1 = sa.jax_reset(frames_j, t1, obs1, trunc)
+                return (t1, obs1, key), (obs, action, logp, value, reward,
+                                         trunc)
+
+            (t1, obs1, key1), traj = jax.lax.scan(
+                env_policy_step, (t, obs, key), None, length=rollout)
+            # rollout tensors are data: gradients flow only through the
+            # loss-side recompute below (behaviour logp stays constant)
+            obs_seq, actions, logp_b, values_b, rewards, truncs = (
+                jax.tree.map(jax.lax.stop_gradient, traj))
+
+            def loss_fn(p):
+                N = rollout * num_envs
+                flat = prep(obs_seq.reshape((N,) + obs_seq.shape[2:]))
+                logits, values = module.forward_train(p, flat)
+                logp_all = jax.nn.log_softmax(logits)
+                tlogp = jnp.take_along_axis(
+                    logp_all, actions.reshape(N)[:, None], axis=-1)[:, 0]
+                tm = lambda x: x.reshape(rollout, num_envs)  # noqa: E731
+                _, boot = module.forward_train(p, prep(obs1))
+                vs, pg_adv = vtrace_returns(
+                    logp_b, tm(tlogp), rewards, tm(values), boot,
+                    jnp.zeros_like(truncs), truncs, gamma=gamma)
+                vs = jax.lax.stop_gradient(vs)
+                pg_adv = jax.lax.stop_gradient(pg_adv)
+                pi_loss = -jnp.mean(tm(tlogp) * pg_adv)
+                vf_loss = 0.5 * jnp.mean((tm(values) - vs) ** 2)
+                probs = jax.nn.softmax(logits)
+                entropy = -jnp.mean(jnp.sum(probs * logp_all, axis=-1))
+                total = (pi_loss + vf_loss_coeff * vf_loss
+                         - entropy_coeff * entropy)
+                return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                               "entropy": entropy}
+
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            metrics = dict(metrics)
+            metrics["reward_mean"] = jnp.mean(rewards)
+            return params, opt_state, t1, obs1, key1, loss, metrics
+
+        # the whole thing — T env steps, T policy forwards, loss, grads,
+        # optimizer — is ONE program; env state and params are donated so
+        # a steady iteration allocates nothing host-side
+        self._update = jax.jit(update, donate_argnums=(0, 1, 2, 3, 4))
+
+    def train(self, iterations: int = 1) -> Dict[str, Any]:
+        import jax
+
+        iterations = require_positive("iterations", iterations)
+        loss = metrics = None
+        t0 = time.perf_counter()
+        for _ in range(iterations):
+            (self.params, self.opt_state, self._t, self._obs, self._key,
+             loss, metrics) = self._update(
+                self.params, self.opt_state, self._t, self._obs,
+                self._key)
+        loss = jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        steps = iterations * self.rollout * self.num_envs
+        self._iterations += iterations
+        self._env_steps += steps
+        out = {k: float(v) for k, v in metrics.items()}
+        out.update({
+            "total_loss": float(loss),
+            "training_iteration": self._iterations,
+            "env_steps": steps,
+            "num_env_steps_sampled_lifetime": self._env_steps,
+            "env_steps_per_sec": steps / max(dt, 1e-9),
+        })
+        return out

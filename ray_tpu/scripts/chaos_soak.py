@@ -750,7 +750,206 @@ def run_pipeline_chaos(
         chaos.reset()
 
 
+def run_podracer_chaos(
+    seed: int,
+    *,
+    drop_prob: float = 0.02,
+    dup_prob: float = 0.05,
+    delay_prob: float = 0.05,
+    delay_max_ms: int = 20,
+    kills: bool = True,
+) -> None:
+    """One seeded chaos run against the Sebulba RL topology.
+
+    Computes the reference trajectory FIRST with the dynamic local loop
+    (pure in-process, no cluster — learner parity pins sebulba == dynamic
+    at broadcast_interval=1), then builds a 2-node cluster with the
+    runner and learner split across it: every trajectory batch is a
+    chunked cross-node mirror push (small chunk bytes so each streams
+    several attacked ``channel_write_chunk``/``channel_commit`` frames)
+    and every parameter broadcast rides the cross-node ring
+    (``collective_chunk`` frames attacked). Three iterations must match
+    the reference losses to 1e-4 — chaos may cost retries, never a wrong
+    update. With ``kills``, a runner (even seeds) or the learner (odd
+    seeds) is hard-killed mid-iteration: the in-flight step must surface
+    a clean ChannelClosedError/ActorDiedError (never a hang, never a
+    silently wrong loss), teardown must unwind, and the driver's channel
+    pins must return to baseline.
+    """
+    import threading
+
+    import ray_tpu
+    from ray_tpu._private import chaos
+    from ray_tpu._private.chaos import FaultController
+    from ray_tpu._private.config import Config
+    from ray_tpu.cluster_utils import Cluster
+
+    def make_cfg(topology):
+        from ray_tpu.rllib import IMPALAConfig
+
+        return (IMPALAConfig()
+                .environment("CartPole-v1")
+                .env_runners(num_env_runners=0 if topology == "dynamic"
+                             else 1,
+                             num_envs_per_env_runner=8,
+                             rollout_fragment_length=16)
+                .training(num_batches_per_iteration=1,
+                          broadcast_interval=1,
+                          model={"hiddens": (16,)})
+                .learners(topology=topology)
+                .debugging(seed=0))
+
+    # reference FIRST: the dynamic local loop, pure in-process (no
+    # cluster, no RPCs — the fault schedule cannot touch it)
+    ref_algo = make_cfg("dynamic").build()
+    try:
+        ref_losses = [ref_algo.train()["total_loss"] for _ in range(4)]
+    finally:
+        ref_algo.stop()
+
+    cfg = Config.from_env()
+    cfg.chaos_seed = seed
+    cfg.chaos_drop_prob = drop_prob
+    cfg.chaos_dup_prob = dup_prob
+    cfg.chaos_delay_prob = delay_prob
+    cfg.chaos_delay_max_ms = delay_max_ms
+    cfg.chaos_methods = CHAOS_METHODS
+    # ~10 KB trajectory payloads stream as several chunk frames per push
+    cfg.object_transfer_chunk_bytes = 1024
+
+    cluster = Cluster(config=cfg)
+    try:
+        cluster.add_node(num_cpus=4, resources={"left": 100})
+        cluster.add_node(num_cpus=4, resources={"right": 100})
+        cluster.wait_for_nodes(2)
+        ray_tpu.init(address=cluster.address)
+        chaos.set_fault_controller(FaultController(
+            seed=seed, drop_prob=drop_prob, dup_prob=dup_prob,
+            delay_prob=delay_prob, delay_max_ms=delay_max_ms,
+            methods=CHAOS_METHODS))
+
+        from ray_tpu._private import api as _api
+        from ray_tpu.rllib.algorithms.impala import IMPALA
+        from ray_tpu.rllib.podracer import (ImpalaSebulbaProgram,
+                                            SebulbaTopology)
+
+        def store_pins():
+            core = _api._core
+            stats = core._run(core.clients.get(core.supervisor_addr).call(
+                "store_stats", timeout=60))
+            return stats["pins_total"]
+
+        pins_before = store_pins()
+        config = make_cfg("sebulba")
+        spec = config.rl_module_spec()
+        program = ImpalaSebulbaProgram(
+            spec=spec, loss_fn=IMPALA.loss_fn,
+            loss_cfg={
+                "gamma": config.gamma,
+                "clip_rho": config.vtrace_clip_rho_threshold,
+                "clip_c": config.vtrace_clip_c_threshold,
+                "vf_loss_coeff": config.vf_loss_coeff,
+                "entropy_coeff": config.entropy_coeff,
+            },
+            opt_cfg={"lr": config.lr, "grad_clip": config.grad_clip},
+            broadcast_interval=1)
+        topo = SebulbaTopology(
+            config, program,
+            runner_options=[{"resources": {"left": 1}}],
+            learner_options=[{"resources": {"right": 1}}])
+        assert topo.is_channel_backed, (
+            "podracer chaos run is not on the channel substrate")
+        for step in range(3):
+            out = topo.step()
+            got = out["metrics"]["total_loss"]
+            assert abs(got - ref_losses[step]) < 1e-4, (
+                f"step {step}: sebulba loss {got} != reference "
+                f"{ref_losses[step]} — chaos corrupted training")
+            for rep in out["reports"]:
+                assert rep["iteration"] == step + 1
+
+        if kills:
+            # participant kill MID-ITERATION: step must fail clean
+            box = {}
+
+            def stepper():
+                try:
+                    box["out"] = topo.step()
+                except Exception as e:  # noqa: BLE001 — the expected path
+                    box["err"] = e
+
+            t = threading.Thread(target=stepper)
+            t.start()
+            time.sleep(0.05)
+            victim = (topo._runners[0] if seed % 2 == 0
+                      else topo._learners[0])
+            ray_tpu.kill(victim)
+            t.join(timeout=180)
+            assert not t.is_alive(), \
+                "step hung after a participant kill"
+            if "err" in box:
+                msg = str(box["err"]).lower()
+                assert ("closed" in msg or "dead" in msg
+                        or "died" in msg or "torn" in msg), (
+                    f"unclean error after kill: {box['err']!r}")
+            else:
+                # the kill landed after the iteration completed: the
+                # loss must still be exact, and the NEXT step must fail
+                # clean
+                got = box["out"]["metrics"]["total_loss"]
+                assert abs(got - ref_losses[3]) < 1e-4, (
+                    "post-kill completed step returned a wrong loss")
+                try:
+                    topo.step()
+                    raise AssertionError(
+                        "step with a dead participant returned instead "
+                        "of raising")
+                except AssertionError:
+                    raise
+                except Exception as e:  # noqa: BLE001 — expected
+                    msg = str(e).lower()
+                    assert ("closed" in msg or "dead" in msg
+                            or "died" in msg or "torn" in msg), (
+                        f"unclean error after kill: {e!r}")
+        topo.shutdown()
+
+        # pins back to baseline. The release RPCs run under the same
+        # fault schedule, so a dropped unpin falls back to the bulk
+        # release path a departing driver uses (one RPC per node).
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and store_pins() != pins_before:
+            time.sleep(0.3)
+        if store_pins() != pins_before:
+            core = _api._core
+            for _ in range(3):
+                try:
+                    core._run(core.clients.get(core.supervisor_addr).call(
+                        "store_release_client",
+                        {"client": core._store_client_id}, timeout=10))
+                    break
+                except Exception:
+                    continue
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline \
+                    and store_pins() != pins_before:
+                time.sleep(0.3)
+        assert store_pins() == pins_before, (
+            "podracer channel pins did not return to baseline")
+    finally:
+        chaos.set_fault_controller(None)  # calm teardown
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        cluster.shutdown()
+        chaos.reset()
+
+
 def _run_one(seed: int, args) -> None:
+    if args.podracer:
+        run_podracer_chaos(
+            seed,
+            drop_prob=args.drop, dup_prob=args.dup, delay_prob=args.delay,
+            delay_max_ms=args.delay_max_ms, kills=not args.no_kills)
+        return
     if args.pipeline:
         run_pipeline_chaos(
             seed,
@@ -804,6 +1003,13 @@ def main() -> int:
                              "frames) under drop/dup/delay must train to "
                              "EXACT reference losses; a mid-flush stage "
                              "kill must fail clean and unwind")
+    parser.add_argument("--podracer", action="store_true",
+                        help="attack the Sebulba RL topology: cross-node "
+                             "trajectory-channel pushes + ring parameter "
+                             "broadcasts under drop/dup/delay must match "
+                             "the dynamic-loop reference losses; a "
+                             "mid-iteration runner/learner kill must fail "
+                             "clean and unwind")
     args = parser.parse_args()
 
     if args.one is not None:
@@ -828,6 +1034,8 @@ def main() -> int:
             child.append("--collective-overlap")
         if args.pipeline:
             child.append("--pipeline")
+        if args.podracer:
+            child.append("--podracer")
         proc = subprocess.run(child)
         took = time.monotonic() - t0
         if proc.returncode != 0:

@@ -271,15 +271,28 @@ class ServeController:
                 if not stats:
                     continue
                 total_ongoing = sum(s["ongoing"] for s in stats)
+                # queued-but-unscheduled work (the continuous batcher's
+                # ray_tpu_serve_queue_depth signal, relayed through
+                # replica stats) counts toward load: a replica with all
+                # slots busy and a deep backlog reports few "ongoing"
+                # requests exactly when more replicas are needed most.
+                # max(), not +: a queued NON-streaming request is also
+                # held open in "ongoing" for its whole await, so summing
+                # would double-count the backlog
+                total_queued = int(sum(s.get("queue_depth", 0)
+                                       for s in stats))
+                load = max(total_ongoing, total_queued)
                 target_per = cfg.get("target_ongoing_requests", 2)
                 desired = max(
                     cfg.get("min_replicas", 1),
                     min(cfg.get("max_replicas", 1),
-                        -(-total_ongoing // target_per) or
+                        -(-load // target_per) or
                         cfg.get("min_replicas", 1)))
                 if desired != st.target:
-                    logger.info("autoscale %s: %d -> %d (ongoing=%d)",
-                                st.name, st.target, desired, total_ongoing)
+                    logger.info(
+                        "autoscale %s: %d -> %d (ongoing=%d queued=%d)",
+                        st.name, st.target, desired, total_ongoing,
+                        total_queued)
                     st.target = desired
 
     # ------------------------------------------------------------- query

@@ -261,10 +261,33 @@ class ReplicaActor:
             if inspect.isawaitable(out):
                 await out
 
+    def _queue_depth(self) -> float:
+        """Backlog the callable is holding beyond in-flight requests —
+        the `ray_tpu_serve_queue_depth` signal. A callable exposes it via
+        a `queue_depth()` method (the LLM server's continuous scheduler
+        does); otherwise fall back to this process's gauge so any
+        scheduler that sets the metric is covered."""
+        probe = getattr(self._callable, "queue_depth", None)
+        if callable(probe):
+            try:
+                return float(probe())
+            except Exception:
+                return 0.0
+        try:
+            from ray_tpu.serve._private.continuous import _m_queue_depth
+
+            return float(_m_queue_depth.value())
+        except Exception:
+            return 0.0
+
     async def stats(self) -> Dict[str, Any]:
         # actively-consumed streams count as ongoing work for autoscaling;
-        # abandoned ones must not pin the replica at scale
+        # abandoned ones must not pin the replica at scale. queue_depth
+        # reports work ADMITTED but not yet scheduled (the continuous
+        # batcher's pending queue) — in-flight counts alone undercount a
+        # backlogged replica, which is exactly when scaling matters.
         return {"ongoing": self._ongoing + self._active_streams(),
+                "queue_depth": self._queue_depth(),
                 "total": self._total,
                 "uptime_s": time.time() - self._started}
 

@@ -307,6 +307,14 @@ class LLMServerImpl:
             return self._sched.stats()
         return {"mode": "batch", "max_batch_size": self._max_batch}
 
+    def queue_depth(self) -> int:
+        """Admitted-but-unscheduled sequences (the replica relays this
+        into its stats so the controller can autoscale on backlog, not
+        just in-flight counts)."""
+        if self._sched is not None:
+            return int(self._sched.stats().get("queue_depth", 0))
+        return 0
+
     def weights_info(self) -> Dict[str, Any]:
         return dict(self._weights_info)
 

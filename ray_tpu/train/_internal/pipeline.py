@@ -303,26 +303,9 @@ class _StageRuntime:
 # ----------------------------------------------------- worker-side run loop
 
 
-class _Writer:
-    """Version-addressed writer over one channel: a LocalChannel when the
-    channel lives in this node's arena, a MirrorWriter push otherwise."""
-
-    def __init__(self, core, spec: _channels.ChannelSpec,
-                 open_local: Callable[[_channels.ChannelSpec],
-                                      _channels.LocalChannel]):
-        self.spec = spec
-        if tuple(spec.node_addr) == tuple(core.supervisor_addr):
-            self._local: Optional[_channels.LocalChannel] = open_local(spec)
-            self._mirror = None
-        else:
-            self._local = None
-            self._mirror = _channels.MirrorWriter(core, spec)
-
-    def write(self, payload, version: int) -> None:
-        if self._local is not None:
-            self._local.write(payload, version)
-        else:
-            self._mirror.push(payload, version)
+# version-addressed local-or-mirror channel writer, shared with the
+# compiled-DAG and podracer layers (_private/channels.py)
+_Writer = _channels.VersionedWriter
 
 
 def _copy_tree(value):

@@ -1,0 +1,391 @@
+"""Podracer RL topologies (ISSUE 10 / ROADMAP item 3).
+
+The contracts under test:
+  * learner parity — Sebulba-topology PPO and IMPALA reproduce the
+    dynamic actor-learner loop's per-iteration losses exactly (same
+    seeds, broadcast_interval=1): streaming rollouts through slot-ring
+    channels and broadcasting params device-to-device must change the
+    data plane, never the math;
+  * the steady-state iteration is ZERO control-plane RPCs per rank —
+    learner AND runner deltas ride each report
+    (ray_tpu_rpc_client_calls_total, the PR-3 idiom), and the driver's
+    own counter must not move across step();
+  * teardown returns every channel pin; killing a participant surfaces
+    a clean error, never a hang or a wrong update;
+  * topology knobs reject explicit zeros (the PR-8 depth=0 lesson);
+  * Anakin's pure-JAX SyntheticAtari dynamics match the gym env exactly,
+    and the fused env+learner update trains.
+
+Sebulba actors are DEDICATED by their run loops, so each test builds a
+fresh topology and shuts it down.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.exceptions import ActorDiedError, ChannelClosedError
+
+
+def _ppo_cfg(topology, runners, seed=0):
+    from ray_tpu.rllib import PPOConfig
+
+    return (PPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=runners,
+                         num_envs_per_env_runner=4,
+                         rollout_fragment_length=32)
+            .training(num_epochs=2, minibatch_size=64,
+                      entropy_coeff=0.01)
+            .learners(topology=topology)
+            .debugging(seed=seed))
+
+
+def _impala_cfg(topology, runners, seed=0, interval=1, rollout=16):
+    from ray_tpu.rllib import IMPALAConfig
+
+    return (IMPALAConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=runners,
+                         num_envs_per_env_runner=4,
+                         rollout_fragment_length=rollout)
+            .training(num_batches_per_iteration=1,
+                      broadcast_interval=interval)
+            .learners(topology=topology)
+            .debugging(seed=seed))
+
+
+def _store_pins(core):
+    stats = core._run(core.clients.get(core.supervisor_addr).call(
+        "store_stats"))
+    return stats["pins_total"]
+
+
+# ----------------------------------------------------------------- parity
+
+
+class TestSebulbaParity:
+    def test_ppo_matches_dynamic_loop(self, ray_init):
+        """THE learner-parity contract: same seeds, broadcast_interval=1
+        (PPO pins it), the channel-streamed topology must reproduce the
+        dynamic loop's losses — including the adaptive-KL trajectory."""
+        dyn = _ppo_cfg("dynamic", 0).build()
+        try:
+            ref = [dyn.train() for _ in range(3)]
+        finally:
+            dyn.stop()
+        seb = _ppo_cfg("sebulba", 1).build()
+        try:
+            assert seb._podracer.is_channel_backed
+            got = [seb.train() for _ in range(3)]
+        finally:
+            seb.stop()
+        for a, b in zip(ref, got):
+            for k in ("total_loss", "policy_loss", "vf_loss", "kl_coeff"):
+                assert abs(a[k] - b[k]) < 1e-5, (k, a[k], b[k])
+
+    def test_impala_matches_dynamic_loop(self, ray_init):
+        dyn = _impala_cfg("dynamic", 0).build()
+        try:
+            ref = [dyn.train()["total_loss"] for _ in range(4)]
+        finally:
+            dyn.stop()
+        seb = _impala_cfg("sebulba", 1).build()
+        try:
+            got = [seb.train()["total_loss"] for _ in range(4)]
+        finally:
+            seb.stop()
+        assert np.allclose(ref, got, atol=1e-5), (ref, got)
+
+
+# -------------------------------------------------------------- contracts
+
+
+class TestSebulbaContracts:
+    @pytest.mark.perf
+    def test_steady_iteration_is_zero_control_rpcs(self, ray_init):
+        """After the first iteration (group rendezvous, channel pins), a
+        whole iteration — R rollouts streamed, learner update, param
+        broadcast, report — costs channel ops and collective rounds
+        only, on every rank AND the driver."""
+        from ray_tpu._private.rpc import _m_client_calls
+
+        seb = _impala_cfg("sebulba", 2).build()
+        try:
+            topo = seb._podracer
+            assert topo.is_channel_backed
+            assert topo.channel_depth >= 1
+            seb.train()  # warm: rendezvous done, pins taken, jits built
+            seb.train()
+            driver_before = _m_client_calls.total()
+            for _ in range(3):
+                out = seb.train()
+                for rep in out["reports"]:
+                    assert rep["rpc_calls"] == 0, (
+                        f"learner rank {rep['learner_rank']} issued "
+                        f"{rep['rpc_calls']} control-plane RPCs in a "
+                        f"steady iteration")
+                    assert rep["runner_rpc_calls"] == 0, (
+                        f"runners of rank {rep['learner_rank']} issued "
+                        f"{rep['runner_rpc_calls']} RPCs in a steady "
+                        f"iteration")
+            assert _m_client_calls.total() == driver_before, (
+                "driver issued control-plane RPCs in steady step()s")
+            # metrics + env-step accounting wired
+            assert out["num_env_steps_sampled_lifetime"] == 5 * 2 * 16 * 4
+            assert out["reports"][0]["iterations_total"] >= 5
+        finally:
+            seb.stop()
+
+    @pytest.mark.slow
+    def test_multi_learner_offpolicy_trains(self, ray_init):
+        """L=2 learner ranks (grad allreduce) x R=2 runners at
+        broadcast_interval=2 and depth=3 — the async IMPALA shape where
+        runners sample ahead bounded by the slot ring."""
+        cfg = _impala_cfg("sebulba", 2, interval=2).learners(
+            topology="sebulba", num_learners=2, podracer_channel_depth=3)
+        seb = cfg.build()
+        try:
+            assert seb._podracer.channel_depth == 3
+            losses = [seb.train()["total_loss"] for _ in range(4)]
+            assert all(np.isfinite(x) for x in losses)
+        finally:
+            seb.stop()
+
+    @pytest.mark.slow
+    def test_ppo_multi_learner_kl_stays_synced(self, ray_init):
+        """Each learner rank measures mean_kl on its own runners' data;
+        the adaptive-KL controller must adapt from the group MEAN or the
+        ranks' kl_coeff columns fork permanently (the broadcast syncs
+        params, not program state)."""
+        cfg = _ppo_cfg("sebulba", 2).learners(topology="sebulba",
+                                              num_learners=2)
+        seb = cfg.build()
+        try:
+            for _ in range(3):
+                out = seb.train()
+                coeffs = {rep["metrics"]["kl_coeff"]
+                          for rep in out["reports"]}
+                assert len(coeffs) == 1, (
+                    f"kl_coeff diverged across learner ranks: {coeffs}")
+        finally:
+            seb.stop()
+
+    def test_teardown_releases_pins_and_channels(self, ray_init):
+        import gc
+
+        from ray_tpu._private import api
+
+        core = api._core
+        gc.collect()
+        time.sleep(0.3)
+        pins_before = _store_pins(core)
+        seb = _impala_cfg("sebulba", 1).build()
+        seb.train()
+        assert _store_pins(core) > pins_before  # channels are pinned
+        seb.stop()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if _store_pins(core) == pins_before:
+                break
+            time.sleep(0.2)
+        assert _store_pins(core) == pins_before, "sebulba leaked pins"
+        with pytest.raises(ChannelClosedError):
+            seb.train()
+
+    def test_runner_death_surfaces_cleanly(self, ray_init):
+        """Killing a runner mid-training must yield a clean
+        ChannelClosedError/ActorDiedError at the driver — never a hang,
+        never a wrong update trained on a half-delivered batch."""
+        seb = _impala_cfg("sebulba", 1).build()
+        try:
+            seb.train()
+            ray_tpu.kill(seb._podracer._runners[0])
+            with pytest.raises((ChannelClosedError, ActorDiedError)):
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    seb.train()
+        finally:
+            seb.stop()
+
+    def test_num_batches_per_iteration_honored(self, ray_init):
+        """A default-style IMPALA config (num_batches_per_iteration > R)
+        must consume the same batch count per train() as the dynamic
+        loop — not silently one batch per runner."""
+        seb = _impala_cfg("sebulba", 1, rollout=8).training(
+            num_batches_per_iteration=3).build()
+        try:
+            out = seb.train()
+            # 3 iterations x 1 runner x (8 steps x 4 envs)
+            assert out["num_env_steps_sampled_lifetime"] == 3 * 8 * 4
+            assert len(out["reports"]) == 3
+        finally:
+            seb.stop()
+
+    def test_checkpoint_and_evaluate_raise_cleanly(self, ray_init):
+        seb = _impala_cfg("sebulba", 1).build()
+        try:
+            with pytest.raises(RuntimeError, match="sebulba"):
+                seb.get_state()
+            with pytest.raises(NotImplementedError):
+                seb.evaluate()
+        finally:
+            seb.stop()
+
+
+# ------------------------------------------------------------------ knobs
+
+
+class TestPodracerKnobs:
+    def test_config_rejects_zero_depth(self):
+        from ray_tpu.rllib import PPOConfig
+
+        with pytest.raises(ValueError, match="podracer_channel_depth"):
+            PPOConfig().learners(topology="sebulba",
+                                 podracer_channel_depth=0)
+
+    def test_unknown_topology_rejected(self):
+        from ray_tpu.rllib import PPOConfig
+
+        with pytest.raises(ValueError, match="topology"):
+            PPOConfig().learners(topology="anakin-but-typod")
+
+    def test_env_knob_zero_rejected(self, ray_init):
+        """RAY_TPU_PODRACER_CHANNEL_DEPTH=0 must raise at build, not
+        silently fall through an `or` chain to the default."""
+        from ray_tpu._private import api
+
+        core = api._core
+        old = core.config.podracer_channel_depth
+        core.config.podracer_channel_depth = 0
+        try:
+            with pytest.raises(ValueError,
+                               match="podracer_channel_depth"):
+                _impala_cfg("sebulba", 1).build()
+        finally:
+            core.config.podracer_channel_depth = old
+
+    def test_require_positive_contract(self):
+        from ray_tpu.rllib.podracer import require_positive
+
+        assert require_positive("x", 3) == 3
+        assert require_positive("x", 1.5, kind=float) == 1.5
+        for bad in (0, -1, None):
+            with pytest.raises(ValueError):
+                require_positive("x", bad)
+
+    def test_sebulba_requires_runner_actors(self, ray_init):
+        with pytest.raises(ValueError, match="num_env_runners"):
+            _impala_cfg("sebulba", 0).build()
+
+    def test_runner_count_must_divide_learners(self, ray_init):
+        with pytest.raises(ValueError, match="divide"):
+            _impala_cfg("sebulba", 3).learners(
+                topology="sebulba", num_learners=2).build()
+
+    def test_anakin_rejects_zero_knobs(self):
+        from ray_tpu.rllib import AnakinTrainer
+
+        with pytest.raises(ValueError, match="num_envs"):
+            AnakinTrainer(num_envs=0)
+        with pytest.raises(ValueError, match="rollout"):
+            AnakinTrainer(num_envs=2, rollout=0)
+
+
+# ----------------------------------------------------------------- anakin
+
+
+def _tiny_anakin(seed=0):
+    from ray_tpu.rllib import AnakinTrainer
+    from ray_tpu.rllib.core.rl_module import RLModuleSpec
+    from ray_tpu.rllib.env import synthetic_atari as sa
+
+    frames = sa.frame_bank(0, shape=(4, 4, 1))
+    spec = RLModuleSpec(obs_dim=16, num_actions=6, hiddens=(16,))
+    return AnakinTrainer(num_envs=4, rollout=8, episode_len=20,
+                         frames=frames, module_spec=spec, seed=seed)
+
+
+class TestAnakin:
+    def test_jax_env_matches_gym_env(self):
+        """The fused update is only legitimate if the jittable dynamics
+        ARE the env: step-for-step obs/reward/truncation parity."""
+        import gymnasium as gym
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.env import synthetic_atari as sa
+
+        episode_len = 7
+        env = gym.make("SyntheticAtari-v0", episode_len=episode_len)
+        obs, _ = env.reset(seed=0)
+        frames = sa.frame_bank(0)
+        t = jnp.zeros(1, jnp.int32)
+        rng = np.random.default_rng(3)
+        for i in range(3 * episode_len):
+            a = int(rng.integers(0, 6))
+            gobs, grew, _gterm, gtrunc, _ = env.step(a)
+            t1, jobs, jrew, jtrunc = sa.jax_step(
+                frames, episode_len, t, jnp.array([a], jnp.int32))
+            assert np.array_equal(np.asarray(jobs[0]), gobs), i
+            assert float(jrew[0]) == grew, i
+            assert bool(jtrunc[0]) == gtrunc, i
+            t, _obs = sa.jax_reset(frames, t1, jobs, jtrunc)
+            if gtrunc:
+                gobs, _ = env.reset()
+                assert int(t[0]) == 0
+                np.testing.assert_array_equal(frames[0], gobs)
+
+    def test_fused_update_trains_and_counts(self):
+        trainer = _tiny_anakin()
+        out = trainer.train(5)
+        assert np.isfinite(out["total_loss"])
+        assert out["env_steps"] == 5 * 8 * 4
+        assert out["env_steps_per_sec"] > 0
+        out2 = trainer.train(5)
+        assert out2["num_env_steps_sampled_lifetime"] == 10 * 8 * 4
+        assert {"policy_loss", "vf_loss", "entropy",
+                "reward_mean"} <= set(out2)
+
+    def test_deterministic_given_seed(self):
+        a = _tiny_anakin(seed=7).train(3)
+        b = _tiny_anakin(seed=7).train(3)
+        assert a["total_loss"] == b["total_loss"]
+
+
+# ------------------------------------------- conv-obs IMPALA loss (fix)
+
+
+class TestImpalaConvLoss:
+    def test_image_obs_reach_conv_torso(self):
+        """IMPALA's loss used to flatten obs to 2D rows, breaking conv
+        modules; image batches must now reach the CNN as [N, H, W, C]."""
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib import IMPALA
+        from ray_tpu.rllib.core.rl_module import RLModule, RLModuleSpec
+
+        spec = RLModuleSpec(obs_dim=32, num_actions=4, hiddens=(8,),
+                            obs_shape=(4, 4, 2),
+                            conv_filters=((4, 2, 1),))
+        module = RLModule(spec)
+        params = module.init_params(jax.random.PRNGKey(0))
+        B, T = 2, 3
+        rng = np.random.default_rng(0)
+        batch = {
+            "obs": rng.integers(0, 255, (B, T, 4, 4, 2)).astype(np.uint8),
+            "actions": rng.integers(0, 4, (B, T)),
+            "logp": np.zeros((B, T), np.float32),
+            "rewards": np.ones((B, T), np.float32),
+            "terminateds": np.zeros((B, T), bool),
+            "truncateds": np.zeros((B, T), bool),
+            "bootstrap_obs": rng.integers(
+                0, 255, (B, 4, 4, 2)).astype(np.uint8),
+        }
+        cfg = {"gamma": 0.99, "clip_rho": 1.0, "clip_c": 1.0,
+               "vf_loss_coeff": 0.5, "entropy_coeff": 0.0}
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        loss, metrics = IMPALA.loss_fn(module, params, batch, cfg)
+        assert np.isfinite(float(loss))

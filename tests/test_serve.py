@@ -150,6 +150,57 @@ class TestAsyncAndBatching:
         assert max(sizes) > 1  # requests actually coalesced
 
 
+class TestQueueDepthAutoscaling:
+    """ROADMAP item 4's remaining bullet: the controller scales replica
+    targets on the ray_tpu_serve_queue_depth signal (admitted-but-
+    unscheduled backlog, relayed through replica stats), not just
+    in-flight request counts."""
+
+    def _wait_replicas(self, app, dep, n, timeout=30):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            st = serve.status()
+            if st.get(app, {}).get(dep, {}).get("replicas") == n:
+                return True
+            time.sleep(0.3)
+        return False
+
+    def test_synthetic_backlog_scales_up(self, serve_shutdown):
+        """A replica with zero in-flight requests but a deep scheduler
+        queue must still trigger scale-up — the continuous batcher
+        admits everything into its pending queue, so 'ongoing' alone
+        undercounts exactly when the replica is saturated."""
+        @serve.deployment(autoscaling_config={
+            "min_replicas": 1, "max_replicas": 3,
+            "target_ongoing_requests": 2})
+        class Backlogged:
+            def queue_depth(self):
+                return 50  # synthetic backlog; no requests in flight
+
+            def __call__(self, x):
+                return x
+
+        serve.run(Backlogged.bind(), name="qd", route_prefix="/qd")
+        assert self._wait_replicas("qd", "Backlogged", 3), (
+            "queue-depth backlog did not scale replicas to max")
+
+    def test_idle_queue_stays_at_min(self, serve_shutdown):
+        @serve.deployment(autoscaling_config={
+            "min_replicas": 1, "max_replicas": 3,
+            "target_ongoing_requests": 2})
+        class Idle:
+            def queue_depth(self):
+                return 0
+
+            def __call__(self, x):
+                return x
+
+        h = serve.run(Idle.bind(), name="qd2", route_prefix="/qd2")
+        assert h.remote(1).result(timeout=10) == 1
+        time.sleep(2.0)  # several autoscale passes
+        assert serve.status()["qd2"]["Idle"]["replicas"] == 1
+
+
 class TestRecovery:
     def test_replica_replaced_after_death(self, serve_shutdown):
         @serve.deployment
