@@ -68,11 +68,19 @@ import struct
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-from ray_tpu._private import chaos, serialization
+from ray_tpu._private import chaos, flight, serialization
 from ray_tpu._private.exceptions import ChannelClosedError
 from ray_tpu._private.metrics import Counter
 
 logger = logging.getLogger(__name__)
+
+# flight-recorder span ids for the zero-RPC hot path (interned once; the
+# record path is per-thread ring writes — no locks, no RPCs, so the
+# steady-state zero-RPC proofs hold with the recorder on)
+_F_WRITE_WAIT = flight.intern("chan.write_wait")
+_F_READ_WAIT = flight.intern("chan.read_wait")
+_F_ACK = flight.intern("chan.ack")
+_F_PUSH = flight.intern("chan.push")
 
 Address = Tuple[str, int]
 
@@ -363,8 +371,10 @@ class LocalChannel:
                 f"({self.capacity}); recompile with "
                 f"experimental_compile(buffer_size_bytes=...)")
         chaos.maybe_delay("channel.write")
+        _t0 = flight.now()
         self._wait(lambda: readers_ready_view(self._view, version),
                    timeout, f"write v{version}")
+        flight.span_since(_F_WRITE_WAIT, _t0)
         if self.depth == 1:
             self._set_u64(_OFF_VERSION, version - 1)  # odd: in progress
             self._view[HEADER_SIZE:HEADER_SIZE + n] = payload
@@ -392,6 +402,7 @@ class LocalChannel:
         The view aliases the shared arena: it is valid until this reader
         acks, after which the writer may overwrite it."""
         chaos.maybe_delay("channel.read")
+        _t0 = flight.now()
         if self.depth == 1:
             self._wait(
                 lambda: self.version >= version and self.version % 2 == 0,
@@ -410,6 +421,7 @@ class LocalChannel:
                 timeout, f"read v{version}")
             length = self._u64(shdr + 8)
             base = _slot_payload_off(slot, self.depth, self.spec.size)
+        flight.span_since(_F_READ_WAIT, _t0)
         _m_reads.inc()
         _m_bytes.inc(length, labels={"op": "read"})
         return self._view[base:base + length].toreadonly()
@@ -433,6 +445,7 @@ class LocalChannel:
             raise ValueError(f"reader slot {slot} out of range")
         chaos.maybe_delay("channel.ack")
         self._set_u64(_OFF_ACKS + 8 * slot, version)
+        flight.instant(_F_ACK, version)
 
 
 def readers_ready_view(view: memoryview, version: int) -> bool:
@@ -478,6 +491,7 @@ class MirrorWriter:
                 f"channel payload of {len(payload)} bytes exceeds the "
                 f"channel buffer ({self.capacity}); recompile with "
                 f"experimental_compile(buffer_size_bytes=...)")
+        _t0 = flight.now()
         try:
             self._core._run(self._push_async(payload, version),
                             timeout=self._timeout + 10)
@@ -490,6 +504,7 @@ class MirrorWriter:
             raise ChannelClosedError(
                 f"push to mirror on {self.spec.node_addr} failed: {e!r}"
             ) from e
+        flight.span_since(_F_PUSH, _t0)
         _m_writes.inc()
         _m_bytes.inc(len(payload), labels={"op": "push"})
 

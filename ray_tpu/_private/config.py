@@ -136,6 +136,14 @@ class Config:
     actor_max_restarts: int = 0
     lineage_max_bytes: int = 64 * 1024**2
     # ---- logging / observability ----
+    # flight recorder (_private/flight.py): always-on per-thread ring
+    # buffers of packed span records over the zero-RPC hot loops, drained
+    # out-of-band via the flight_dump RPC / util.state.flight_timeline.
+    # NOTE: flight.py reads these via the RAY_TPU_FLIGHT_* env vars at
+    # import (before any cluster config exists); the fields here document
+    # the knobs and propagate non-default values to spawned daemons
+    flight_enabled: bool = True
+    flight_buffer_records: int = 16384
     log_dir: str = ""
     event_buffer_size: int = 10_000
     metrics_report_interval_ms: int = 5000

@@ -132,6 +132,9 @@ class Controller:
         self.config = config
         self.snapshot_path = snapshot_path
         self.session_dir = session_dir
+        from ray_tpu._private import flight as _flight
+
+        _flight.set_role("controller")
         # pluggable durable store (gcs_store.py): session-dir files by
         # default; controller_store_uri selects a remote URI backend so
         # the control plane survives head-node disk loss
@@ -556,6 +559,13 @@ class Controller:
 
     async def rpc_metrics(self, body=None) -> str:
         return self._render_metrics()[1]
+
+    async def rpc_flight_dump(self, body=None) -> dict:
+        """Drain the controller's flight-recorder rings (the control
+        plane's own spans land on the merged cluster timeline too)."""
+        from ray_tpu._private import flight
+
+        return flight.drain()
 
     # job submission RPCs (the CLI may come through RPC instead of HTTP)
 

@@ -332,6 +332,9 @@ class CoreWorker:
         self.supervisor_addr = supervisor_addr
         self.job_id = job_id
         self.role = role
+        from ray_tpu._private import flight as _flight
+
+        _flight.set_role(role)  # merged-timeline rows group by role
         self.worker_id = worker_id or WorkerID.from_random()
         self.node_id_hex = ""
         self.arena: Optional[ArenaFile] = None
@@ -1408,6 +1411,24 @@ class CoreWorker:
     @idempotent
     async def rpc_ping(self, body=None) -> str:
         return "pong"
+
+    @idempotent
+    async def rpc_flight_dump(self, body=None) -> dict:
+        """Out-of-band drain of this process's flight-recorder rings
+        (_private/flight.py): the in-band hot-loop spans leave the
+        process ONLY through this pull path, never as steady-state RPCs."""
+        from ray_tpu._private import flight
+
+        return flight.drain()
+
+    @idempotent
+    async def rpc_metrics(self, body=None) -> str:
+        """This process's Prometheus exposition — the cluster-wide scrape
+        (`util.state.cluster_metrics(all_nodes=True)`) reaches worker and
+        driver registries through it."""
+        from ray_tpu._private.metrics import default_registry
+
+        return default_registry().render_prometheus()
 
     def subscribe(self, channel: str, handler: Callable) -> None:
         self._pub_handlers.setdefault(channel, []).append(handler)

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import bisect
 import threading
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 _LabelKey = Tuple[Tuple[str, str], ...]
 
@@ -38,11 +38,47 @@ def _render_labels(key: _LabelKey) -> str:
 
 
 class Metric:
+    def __new__(cls, name=None, *args, **kwargs):
+        # Re-creating a metric of the SAME name and type returns the
+        # registered instance instead of silently replacing it in the
+        # registry — the old behaviour orphaned the first object, so
+        # modules still incrementing it never rendered again. The
+        # get-or-create is ONE critical section: registration happens
+        # here, not in __init__, so two racing first-creators cannot
+        # both see "absent" and leave one holding an unregistered
+        # orphan whose increments never render.
+        registry = kwargs.get("registry")
+        if registry is None:
+            for a in args:
+                if isinstance(a, Registry):
+                    registry = a
+                    break
+        if registry is None:
+            registry = default_registry()
+        with registry._lock:
+            existing = registry._metrics.get(name)
+            if existing is not None:
+                if type(existing) is not cls:
+                    raise ValueError(
+                        f"metric {name!r} already registered with a "
+                        f"different type")
+                return existing
+            inst = super().__new__(cls)
+            registry._metrics[name] = inst
+            return inst
+
     def __init__(self, name: str, description: str, registry: "Registry"):
+        if getattr(self, "_registered", False):
+            return  # reused instance: keep its recorded state
         self.name = name
         self.description = description
         self._lock = threading.Lock()
-        registry._register(self)
+        self._init_state()
+        self._registered = True
+
+    def _init_state(self) -> None:
+        """Subclass hook creating the value stores (runs exactly once per
+        registered instance — re-construction must not wipe them)."""
 
     def value(self, labels: Optional[Dict[str, str]] = None) -> float:
         """Current value for one label set (counter-based assertions in
@@ -66,6 +102,8 @@ class Counter(Metric):
 
     def __init__(self, name, description="", registry=None):
         super().__init__(name, description, registry or default_registry())
+
+    def _init_state(self) -> None:
         self._values: Dict[_LabelKey, float] = {}
 
     def inc(self, value: float = 1.0,
@@ -87,6 +125,8 @@ class Gauge(Metric):
 
     def __init__(self, name, description="", registry=None):
         super().__init__(name, description, registry or default_registry())
+
+    def _init_state(self) -> None:
         self._values: Dict[_LabelKey, float] = {}
 
     def set(self, value: float,
@@ -139,8 +179,11 @@ class Histogram(Metric):
 
     def __init__(self, name, description="",
                  buckets: Sequence[float] = DEFAULT_BUCKETS, registry=None):
+        if not getattr(self, "_registered", False):
+            self.buckets = tuple(sorted(buckets))
         super().__init__(name, description, registry or default_registry())
-        self.buckets = tuple(sorted(buckets))
+
+    def _init_state(self) -> None:
         self._counts: Dict[_LabelKey, List[int]] = {}
         self._sums: Dict[_LabelKey, float] = {}
         self._totals: Dict[_LabelKey, int] = {}
@@ -204,15 +247,6 @@ class Registry:
         self._metrics: Dict[str, Metric] = {}
         self._lock = threading.Lock()
 
-    def _register(self, metric: Metric) -> None:
-        with self._lock:
-            existing = self._metrics.get(metric.name)
-            if existing is not None and type(existing) is not type(metric):
-                raise ValueError(
-                    f"metric {metric.name!r} already registered with a "
-                    f"different type")
-            self._metrics[metric.name] = metric
-
     def get(self, name: str) -> Optional[Metric]:
         with self._lock:
             return self._metrics.get(name)
@@ -222,10 +256,82 @@ class Registry:
             metrics = list(self._metrics.values())
         lines: List[str] = []
         for m in metrics:
+            if not getattr(m, "_registered", False):
+                continue  # registered in __new__, still mid-__init__
             lines.append(f"# HELP {m.name} {m.description}")
             lines.append(f"# TYPE {m.name} {m.TYPE}")
             lines.extend(m.render())
         return "\n".join(lines) + "\n"
+
+
+def relabel_exposition(text: str, extra: Dict[str, str]) -> str:
+    """Inject labels into every sample line of a Prometheus exposition —
+    the cluster-wide scrape merge (`util.state.cluster_metrics(
+    all_nodes=True)`) stamps ``node``/``component`` onto each daemon's
+    text so identically-named series stay distinguishable."""
+    extra_str = ",".join(
+        f'{k}="{_escape_label_value(v)}"' for k, v in sorted(extra.items()))
+    out: List[str] = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            out.append(line)
+            continue
+        # the value never contains spaces; label VALUES may, so split at
+        # the last space only
+        try:
+            left, value = line.rsplit(" ", 1)
+        except ValueError:
+            out.append(line)
+            continue
+        if left.endswith("}"):
+            out.append(f"{left[:-1]},{extra_str}}} {value}")
+        else:
+            out.append(f"{left}{{{extra_str}}} {value}")
+    return "\n".join(out)
+
+
+def merge_expositions(parts: Iterable[str]) -> str:
+    """Merge already-relabelled expositions into parser-valid Prometheus
+    text. A metric family present in several processes (most are) must
+    render as ONE ``# HELP``/``# TYPE`` block with every part's samples
+    grouped under it — the exposition format rejects duplicate TYPE
+    lines and split families, so a plain concatenation scrapes fine by
+    eye but fails promtool/Prometheus ingestion."""
+    help_lines: Dict[str, str] = {}
+    type_lines: Dict[str, str] = {}
+    samples: Dict[str, List[str]] = {}
+    order: List[str] = []
+
+    def bucket(family: str) -> List[str]:
+        got = samples.get(family)
+        if got is None:
+            got = samples[family] = []
+            order.append(family)
+        return got
+
+    for text in parts:
+        family = ""  # samples before any header stay in one '' bucket
+        for line in text.splitlines():
+            if line.startswith("# HELP ") or line.startswith("# TYPE "):
+                family = line.split(" ", 3)[2]
+                target = help_lines if line.startswith("# HELP ") \
+                    else type_lines
+                target.setdefault(family, line)
+                bucket(family)
+            elif not line or line.startswith("#"):
+                continue
+            else:
+                # our renderers emit samples directly under their
+                # family's header block, so `family` still names it
+                bucket(family).append(line)
+    out: List[str] = []
+    for fam in order:
+        if fam in help_lines:
+            out.append(help_lines[fam])
+        if fam in type_lines:
+            out.append(type_lines[fam])
+        out.extend(samples[fam])
+    return "\n".join(out) + "\n"
 
 
 _default: Optional[Registry] = None

@@ -113,6 +113,9 @@ class Supervisor:
         node_name: str = "",
     ):
         self.config = config
+        from ray_tpu._private import flight as _flight
+
+        _flight.set_role("supervisor")
         self.node_id = NodeID.from_random()
         self.controller_addr = controller_addr
         self.session_dir = session_dir
@@ -313,6 +316,60 @@ class Supervisor:
 
     async def rpc_metrics(self, body=None) -> str:
         return self._render_metrics()[1]
+
+    @idempotent
+    async def rpc_metrics_all(self, body=None) -> list:
+        """This node's full registry set: the supervisor's own exposition
+        plus one per live worker (relayed over the worker's `metrics`
+        RPC) — `util.state.cluster_metrics(all_nodes=True)` merges these
+        with node/component labels so every data-plane metric recorded in
+        worker processes is visible cluster-wide."""
+        out = [("supervisor", self._render_metrics()[1])]
+
+        async def scrape(w):
+            # a mid-exit worker must not fail (or serialize) the scrape
+            try:
+                return (f"worker:{w.worker_id_hex[:8]}",
+                        await self.clients.get(w.address).call(
+                            "metrics", {}, timeout=10))
+            except Exception:
+                return None
+        got = await asyncio.gather(
+            *(scrape(w) for w in list(self.workers.values())))
+        out.extend(g for g in got if g is not None)
+        return out
+
+    @idempotent
+    async def rpc_flight_dump(self, body=None) -> dict:
+        """Drain this node's flight recorders: the supervisor's own rings
+        plus (``include_workers``, default true) one dump per live
+        worker, relayed over each worker core's ``flight_dump`` RPC."""
+        from ray_tpu._private import flight
+
+        dumps = [flight.drain()]
+        if not body or body.get("include_workers", True):
+            async def one(w):
+                # concurrent relay: a wedged worker (the very thing a
+                # flight dump is for) costs one 10s timeout, not 10s
+                # times its position in the worker list
+                try:
+                    return await self.clients.get(w.address).call(
+                        "flight_dump", {}, timeout=10)
+                except Exception:
+                    return None  # dead/mid-exit worker: dump what we can
+            got = await asyncio.gather(
+                *(one(w) for w in list(self.workers.values())))
+            dumps.extend(g for g in got if g is not None)
+        return {"dumps": dumps}
+
+    @idempotent
+    async def rpc_flight_clock(self, body=None) -> dict:
+        """Clock-alignment handshake: the driver samples its own wall
+        clock around this call and corrects by RTT/2, yielding this
+        node's wall-clock offset for the merged timeline. Workers share
+        their supervisor's host clock, so one handshake aligns the node."""
+        return {"wall_ns": time.time_ns(),
+                "perf_ns": time.perf_counter_ns()}
 
     async def rpc_metrics_port(self, body=None) -> int:
         return self.metrics_server.port if self.metrics_server else -1

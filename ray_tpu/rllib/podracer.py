@@ -62,11 +62,17 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from ray_tpu._private import channels as _channels
-from ray_tpu._private import chaos, serialization
+from ray_tpu._private import chaos, flight, serialization
 from ray_tpu._private.exceptions import ChannelClosedError
 from ray_tpu._private.metrics import Counter
 
 logger = logging.getLogger(__name__)
+
+# flight-recorder span ids: the per-iteration runner/learner/broadcast
+# phases of the zero-RPC Sebulba loop (per-thread ring records, no RPCs)
+_F_SAMPLE = flight.intern("rl.sample")
+_F_UPDATE = flight.intern("rl.update")
+_F_BCAST = flight.intern("rl.bcast")
 
 _m_iterations = Counter(
     "ray_tpu_podracer_iterations_total",
@@ -389,6 +395,7 @@ class _SebulbaRunnerImpl:
         group_ready = [False]
 
         def recv_params() -> None:
+            t0 = flight.now()
             if not group_ready[0]:
                 col.init_collective_group(
                     b["world"], b["rank"], backend="host",
@@ -396,6 +403,7 @@ class _SebulbaRunnerImpl:
                 group_ready[0] = True
             self._runner.set_weights(_broadcast_tree_recv(
                 col, b, self._runner.params))
+            flight.span_since(_F_BCAST, t0)
             _m_broadcasts.inc(labels={"role": "runner"})
 
         n = 0
@@ -407,7 +415,9 @@ class _SebulbaRunnerImpl:
             while True:
                 chaos.maybe_crash("worker.podracer_step")
                 n += 1
+                t0 = flight.now()
                 batch = self._runner.sample(plan.rollout)
+                flight.span_since(_F_SAMPLE, t0)
                 metrics = self._runner.get_metrics()
                 now = rpc._m_client_calls.total()
                 payload = serialization.pack({
@@ -490,6 +500,7 @@ class _SebulbaLearnerImpl:
         group_ready = [False]
 
         def sync_params() -> None:
+            t0 = flight.now()
             if not group_ready[0]:
                 col.init_collective_group(
                     b["world"], b["rank"], backend="host",
@@ -504,6 +515,7 @@ class _SebulbaLearnerImpl:
                 # makes the sync exact by construction
                 self._learner.set_weights(_broadcast_tree_recv(
                     col, b, self._learner.params))
+            flight.span_since(_F_BCAST, t0)
             _m_broadcasts.inc(labels={"role": "learner"})
 
         n = 0
@@ -522,7 +534,9 @@ class _SebulbaLearnerImpl:
                                 for s in samples)
                 runner_metrics = [dict(m["metrics"]) for m in msgs]
                 runner_rpc = int(sum(int(m["rpc_calls"]) for m in msgs))
+                t0 = flight.now()
                 metrics = self._program.update(self._learner, samples, n)
+                flight.span_since(_F_UPDATE, t0)
                 # the update consumed the zero-copy views (device/host
                 # copies made); release the writers
                 del samples, msgs

@@ -52,6 +52,47 @@ CHAOS_METHODS = ",".join([
 ])
 
 
+# seed of the workload currently running in THIS process (--one mode);
+# _maybe_flight_dump names its artifact after it
+_CURRENT_SEED: int | None = None
+
+
+def _maybe_flight_dump() -> None:
+    """Dump a merged flight timeline while the seed's cluster is still
+    up — unconditionally when ``--flight-dump <dir>`` was given, and
+    AUTOMATICALLY when unwinding an exception (so a red seed leaves a
+    debuggable Perfetto trace instead of just an exit code). Runs inside
+    each workload's ``finally`` before teardown; falls back to this
+    driver's own rings if the cluster is already unreachable."""
+    dump_dir = os.environ.get("RAY_TPU_CHAOS_FLIGHT_DUMP", "")
+    failing = sys.exc_info()[0] is not None
+    if not dump_dir and not failing:
+        return
+    import tempfile
+
+    if not dump_dir:
+        dump_dir = os.path.join(tempfile.gettempdir(), "chaos_flight")
+    tag = "fail" if failing else "ok"
+    seed = "x" if _CURRENT_SEED is None else _CURRENT_SEED
+    path = os.path.join(dump_dir, f"flight_seed{seed}_{tag}.json")
+    try:
+        os.makedirs(dump_dir, exist_ok=True)
+        import ray_tpu
+        from ray_tpu._private import flight
+        from ray_tpu.util import state
+
+        if ray_tpu.is_initialized():
+            try:
+                events = state.flight_timeline(path)
+            except Exception:
+                events = flight.local_timeline(path)
+        else:
+            events = flight.local_timeline(path)
+        print(f"flight timeline ({len(events)} events) -> {path}")
+    except Exception as e:  # noqa: BLE001 — the dump must never mask
+        print(f"flight dump failed: {e!r}")  # the workload's own error
+
+
 def run_chaos_workload(
     seed: int,
     *,
@@ -260,6 +301,7 @@ def run_chaos_workload(
         assert not leaked, f"pending RPC futures leaked: {leaked}"
     finally:
         chaos.set_fault_controller(None)  # calm teardown
+        _maybe_flight_dump()  # before shutdown, while dumps exist
         if ray_tpu.is_initialized():
             ray_tpu.shutdown()
         cluster.shutdown()
@@ -394,6 +436,7 @@ def run_collective_chaos(
                         f"unclean error from dead-peer collective: {e!r}")
     finally:
         chaos.set_fault_controller(None)  # calm teardown
+        _maybe_flight_dump()  # before shutdown, while dumps exist
         if ray_tpu.is_initialized():
             ray_tpu.shutdown()
         cluster.shutdown()
@@ -561,6 +604,7 @@ def run_collective_overlap_chaos(
                     "submit after mid-flight failure did not fail fast"
     finally:
         chaos.set_fault_controller(None)  # calm teardown
+        _maybe_flight_dump()  # before shutdown, while dumps exist
         if ray_tpu.is_initialized():
             ray_tpu.shutdown()
         cluster.shutdown()
@@ -744,6 +788,7 @@ def run_pipeline_chaos(
             "pipeline channel pins did not return to baseline")
     finally:
         chaos.set_fault_controller(None)  # calm teardown
+        _maybe_flight_dump()  # before shutdown, while dumps exist
         if ray_tpu.is_initialized():
             ray_tpu.shutdown()
         cluster.shutdown()
@@ -937,6 +982,7 @@ def run_podracer_chaos(
             "podracer channel pins did not return to baseline")
     finally:
         chaos.set_fault_controller(None)  # calm teardown
+        _maybe_flight_dump()  # before shutdown, while dumps exist
         if ray_tpu.is_initialized():
             ray_tpu.shutdown()
         cluster.shutdown()
@@ -944,6 +990,10 @@ def run_podracer_chaos(
 
 
 def _run_one(seed: int, args) -> None:
+    global _CURRENT_SEED
+    _CURRENT_SEED = seed
+    if args.flight_dump:
+        os.environ["RAY_TPU_CHAOS_FLIGHT_DUMP"] = args.flight_dump
     if args.podracer:
         run_podracer_chaos(
             seed,
@@ -1003,6 +1053,12 @@ def main() -> int:
                              "frames) under drop/dup/delay must train to "
                              "EXACT reference losses; a mid-flush stage "
                              "kill must fail clean and unwind")
+    parser.add_argument("--flight-dump", default="",
+                        help="directory for a merged flight-recorder "
+                             "timeline (Perfetto JSON) per seed; a red "
+                             "seed ALWAYS dumps (to a temp dir when this "
+                             "is unset) so failures leave a debuggable "
+                             "trace instead of just an exit code")
     parser.add_argument("--podracer", action="store_true",
                         help="attack the Sebulba RL topology: cross-node "
                              "trajectory-channel pushes + ring parameter "
@@ -1024,6 +1080,8 @@ def main() -> int:
                  "--drop", str(args.drop), "--dup", str(args.dup),
                  "--delay", str(args.delay),
                  "--delay-max-ms", str(args.delay_max_ms)]
+        if args.flight_dump:
+            child.extend(["--flight-dump", args.flight_dump])
         if args.no_kills:
             child.append("--no-kills")
         if args.no_train:

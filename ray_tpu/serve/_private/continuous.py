@@ -33,7 +33,16 @@ from collections import deque
 from functools import partial
 from typing import Any, Dict, List, Optional
 
+from ray_tpu._private import flight
 from ray_tpu._private.metrics import Counter, Gauge
+
+# flight-recorder span ids: the per-iteration admit/prefill/decode/retire
+# phases the aggregate counters can't localize (per-thread ring records,
+# no locks/RPCs — safe at decode-iteration rates)
+_F_ADMIT = flight.intern("serve.admit")
+_F_PREFILL = flight.intern("serve.prefill")
+_F_DECODE = flight.intern("serve.decode")
+_F_RETIRE = flight.intern("serve.retire")
 
 _m_steps = Counter(
     "ray_tpu_serve_decode_steps_total",
@@ -224,6 +233,7 @@ class ContinuousScheduler:
 
     def _retire(self, seq: _Seq, reason: str) -> None:
         if seq.slot is not None:
+            flight.instant(_F_RETIRE, seq.slot)
             self._slot_seqs[seq.slot] = None
             seq.slot = None
         seq.state = _DONE
@@ -288,6 +298,7 @@ class ContinuousScheduler:
             self._slot_seqs[free] = seq
             self._caches = reset_slot(self._caches, free)
             self._n_admitted += 1
+            flight.instant(_F_ADMIT, free)
             _m_admitted.inc()
             if in_flight:
                 # the signal request-level flush-and-drain cannot produce:
@@ -299,6 +310,7 @@ class ContinuousScheduler:
         slots — concurrent prompts interleave their chunks, so one long
         prompt cannot monopolize prefill (and decode never waits more than
         one chunk). Returns True if a chunk ran."""
+        import jax
         import jax.numpy as jnp
         import numpy as np
 
@@ -317,9 +329,17 @@ class ContinuousScheduler:
             real = len(chunk)
             padded = chunk + [0] * (self.prefill_chunk - real)
             tokens = jnp.asarray([padded], jnp.int32)
+            t0 = flight.now()
             logits, self._caches = self._prefill(
                 self.params, tokens, np.int32(real), np.int32(seq.slot),
                 self._caches)
+            if t0:
+                # jax dispatch is async: without a sync the span would
+                # time the DISPATCH and smear the real prefill compute
+                # into the next decode region (the decode span gets its
+                # sync from the np.asarray below)
+                jax.block_until_ready(logits)
+            flight.span_since(_F_PREFILL, t0)
             self._n_prefill_chunks += 1
             _m_prefill_chunks.inc()
             if not seq.remaining_prompt:
@@ -354,10 +374,12 @@ class ContinuousScheduler:
             live.append(seq)
         if not live:
             return False
+        t0 = flight.now()
         logits, self._caches = self._step(
             self.params, jnp.asarray(toks), jnp.asarray(active),
             self._caches)
         la = np.asarray(logits)
+        flight.span_since(_F_DECODE, t0)
         self._n_steps += 1
         _m_steps.inc()
         self._max_active_slots = max(self._max_active_slots, len(live))

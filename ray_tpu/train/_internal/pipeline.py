@@ -55,11 +55,20 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ray_tpu._private import channels as _channels
-from ray_tpu._private import chaos, serialization
+from ray_tpu._private import chaos, flight, serialization
 from ray_tpu._private.exceptions import ChannelClosedError
 from ray_tpu._private.metrics import Counter, Gauge, Histogram
 
 logger = logging.getLogger(__name__)
+
+# flight-recorder span ids (per-thread ring records, zero RPCs): the
+# per-microbatch phases the aggregate bubble gauge can't localize
+_F_FWD = flight.intern("pipe.fwd")
+_F_BWD = flight.intern("pipe.bwd")
+_F_FLUSH = flight.intern("pipe.flush")
+_F_OPT = flight.intern("pipe.opt")
+_F_DP = flight.intern("pipe.dp_allreduce")
+_F_BUBBLE = flight.intern("pipe.bubble_bp")
 
 _m_microbatches = Counter(
     "ray_tpu_pipeline_microbatches_total",
@@ -287,10 +296,12 @@ class _StageRuntime:
 
             self._ensure_group()
             leaves, treedef = jax.tree.flatten(grads)
+            t0 = flight.now()
             work = col.allreduce_coalesced_async(
                 leaves, group_name=self.group_name, op=ReduceOp.MEAN,
                 timeout_ms=timeout_ms)
             reduced = work.wait(timeout_ms)
+            flight.span_since(_F_DP, t0)
             grads = jax.tree.unflatten(treedef, reduced)
         self._ensure_opt()
         self.params, self._opt_state = self._update(
@@ -412,6 +423,7 @@ def _run_stage_loop(rt: _StageRuntime, plan: _StagePlan) -> dict:
     try:
         while True:
             chaos.maybe_crash("worker.pipeline_step")
+            t_fl = flight.now()
             t_box[0] = time.perf_counter()
             cpu0 = time.process_time()
             wait_box[0] = 0.0
@@ -421,6 +433,7 @@ def _run_stage_loop(rt: _StageRuntime, plan: _StagePlan) -> dict:
             fwd_m, bwd_m = [0], [0]
 
             def forward():
+                t_mb = flight.now()
                 m = fwd_m[0]
                 fwd_m[0] += 1
                 v = vbase + 2 * m
@@ -432,17 +445,20 @@ def _run_stage_loop(rt: _StageRuntime, plan: _StagePlan) -> dict:
                 else:
                     write_value(act_out, rt.forward(m, x), v)
                 _m_microbatches.inc(labels=stage_label)
+                flight.span_since(_F_FWD, t_mb)
 
             def backward():
                 m = bwd_m[0]
                 bwd_m[0] += 1
                 if rt.last:
                     return  # folded into forward (fwd/bwd adjacent)
+                t_mb = flight.now()
                 v = vbase + 2 * m
                 gy = read_value(grad_in, v)
                 gx = rt.backward(m, gy)
                 if not rt.first:
                     write_value(grad_out, gx, v)
+                flight.span_since(_F_BWD, t_mb)
 
             # Eager 1F1B: backward-first whenever the grad is already
             # committed (it frees a stash slot and feeds upstream),
@@ -477,9 +493,15 @@ def _run_stage_loop(rt: _StageRuntime, plan: _StagePlan) -> dict:
                     forward()
 
             microbatches += M
+            t_opt = flight.now()
             flush_stats = rt.flush()
+            flight.span_since(_F_OPT, t_opt)
             total_s = time.perf_counter() - t_box[0]
             bubble = min(1.0, wait_box[0] / max(total_s, 1e-9))
+            # per-flush bubble as a counter track (basis points) — the
+            # driver-side merge renders it alongside the wait spans it
+            # is derived from
+            flight.counter(_F_BUBBLE, int(bubble * 10_000))
             _m_flushes.inc(labels=stage_label)
             _m_stage_seconds.observe(total_s, labels=stage_label)
             _m_bubble.set(bubble, labels=stage_label)
@@ -505,6 +527,7 @@ def _run_stage_loop(rt: _StageRuntime, plan: _StagePlan) -> dict:
                 },
             }
             report_w.write(serialization.pack(report), 2 * (flush_idx + 1))
+            flight.span_since(_F_FLUSH, t_fl)
             flush_idx += 1
     except ChannelClosedError:
         # normal exit: trainer teardown (or a peer's death) closed the

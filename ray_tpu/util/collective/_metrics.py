@@ -7,8 +7,10 @@ the ring transport module.
 
 from __future__ import annotations
 
+import contextlib
 from typing import Dict
 
+from ray_tpu._private import flight
 from ray_tpu._private.metrics import Counter, Gauge, Histogram
 
 ops_total = Counter(
@@ -44,3 +46,26 @@ staging_allocs_total = Counter(
 
 def labels(algo: str) -> Dict[str, str]:
     return {"algo": algo, "backend": "host"}
+
+
+_flight_round_ids: Dict[str, int] = {}
+
+
+@contextlib.contextmanager
+def round_timer(algo: str):
+    """``round_seconds`` histogram + a per-algo flight-recorder span
+    (``col.shm_round`` / ``col.ring_round`` / ``col.kv_round``) around one
+    collective call — the histogram averages, the span shows WHERE in the
+    iteration the round sat."""
+    nid = _flight_round_ids.get(algo)
+    if nid is None:
+        nid = flight.intern(f"col.{algo}_round")
+        _flight_round_ids[algo] = nid
+    t0 = flight.now()
+    try:
+        with round_seconds.time(labels={"algo": algo}):
+            yield
+    finally:
+        # record failed rounds too (the histogram does): a round that
+        # times out on peer death is the stall the timeline is FOR
+        flight.span_since(nid, t0)

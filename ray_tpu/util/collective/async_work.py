@@ -47,6 +47,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ray_tpu._private import flight
 from ray_tpu.util.collective import _metrics
 from ray_tpu.util.collective.types import (CollectiveError, ReduceOp,
                                            prescale_factor)
@@ -54,6 +55,12 @@ from ray_tpu.util.collective.types import (CollectiveError, ReduceOp,
 logger = logging.getLogger(__name__)
 
 _STOP = object()
+
+# flight-recorder span ids: one span per mover/reducer bucket round shows
+# the overlap (or lack of it) the aggregate histograms can only average
+_F_MOVE = flight.intern("col.mover_bucket")
+_F_REDUCE = flight.intern("col.reduce_bucket")
+_F_WAIT = flight.intern("col.wait")
 
 
 # ----------------------------------------------------------------- handles
@@ -82,8 +89,10 @@ class CollectiveWork:
         ``ray_tpu_collective_wait_seconds`` — against
         ``round_seconds`` it gives the overlap fraction."""
         t0 = time.perf_counter()
+        t0f = flight.now()
         ok = self._event.wait(
             None if timeout_ms is None else timeout_ms / 1000.0)
+        flight.span_since(_F_WAIT, t0f)
         _metrics.wait_seconds.observe(time.perf_counter() - t0)
         if not ok:
             raise TimeoutError(
@@ -396,6 +405,7 @@ class AsyncRunner:
                 for bucket in reversed(buckets):
                     if self._dead is not None:
                         break
+                    t0 = flight.now()
                     host = _materialize([sub.leaves[i] for i in bucket])
                     dtype = host[0].dtype
                     total = sum(a.size for a in host)
@@ -415,6 +425,9 @@ class AsyncRunner:
                         off += a.size
                     self._bucketq.put(
                         _BucketTask(sub, staging, meta, scale))
+                    # includes the handoff-queue wait: a full queue IS
+                    # the mover stalling behind the reducer
+                    flight.span_since(_F_MOVE, t0)
             except BaseException as e:  # noqa: BLE001 — fail loud + clean
                 logger.debug("collective mover failed", exc_info=True)
                 self._poison(e)
@@ -429,6 +442,7 @@ class AsyncRunner:
                 continue  # drain mode: unblock the mover, drop the work
             sub = task.sub
             try:
+                t0 = flight.now()
                 impl = self._group._impl_for(sub.timeout_ms)
                 # MEAN was either pre-scaled into the pack (float dtypes)
                 # or falls back to SUM + one divide at unpack — the
@@ -453,6 +467,7 @@ class AsyncRunner:
                         sub.results[i] = seg.reshape(shape).copy()
                     off += size
                 self.pool.release(task.staging)
+                flight.span_since(_F_REDUCE, t0)
                 self._finish_bucket(sub)
             except BaseException as e:  # noqa: BLE001 — fail loud + clean
                 logger.debug("collective reducer failed", exc_info=True)

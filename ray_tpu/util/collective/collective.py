@@ -375,7 +375,7 @@ class KvGroup:
         from ray_tpu.util.collective import _metrics
 
         fn = _reduce_fn(op)
-        with _metrics.round_seconds.time(labels={"algo": self.algo}):
+        with _metrics.round_timer(self.algo):
             red = self._round(
                 np.asarray(arr), lambda parts: fn(np.stack(parts)), timeout_ms
             )

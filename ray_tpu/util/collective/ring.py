@@ -313,7 +313,7 @@ class RingGroup:
         flat = out.reshape(-1)
         segs = _seg_slices(flat.size, w)
         fold = reduce_ufunc(op)
-        with _metrics.round_seconds.time(labels={"algo": self.algo}):
+        with _metrics.round_timer(self.algo):
             # phase 1: reduce-scatter — after w-1 rounds rank r fully owns
             # segment (r+1) % w
             for t in range(w - 1):
@@ -350,7 +350,7 @@ class RingGroup:
     def broadcast(self, arr, root_rank: int, timeout_ms: int) -> np.ndarray:
         w, r = self.world_size, self.rank
         deadline = self._deadline(timeout_ms)
-        with _metrics.round_seconds.time(labels={"algo": self.algo}):
+        with _metrics.round_timer(self.algo):
             if r == root_rank:
                 out = np.asarray(arr)
                 if w > 1:
@@ -369,7 +369,7 @@ class RingGroup:
         deadline = self._deadline(timeout_ms)
         pieces: List[Optional[np.ndarray]] = [None] * w
         pieces[r] = np.asarray(arr)
-        with _metrics.round_seconds.time(labels={"algo": self.algo}):
+        with _metrics.round_timer(self.algo):
             for t in range(w - 1):
                 self._t.send(self._right, pieces[(r - t) % w], deadline)
                 pieces[(r - t - 1) % w] = self._t.recv(self._left, deadline)
@@ -387,7 +387,7 @@ class RingGroup:
         acc = np.ascontiguousarray(np.asarray(arr)).copy()
         segs = np.array_split(acc, w, axis=0)  # views into acc
         fold = reduce_ufunc(op)
-        with _metrics.round_seconds.time(labels={"algo": self.algo}):
+        with _metrics.round_timer(self.algo):
             for t in range(w - 1):
                 send_i = (r - t) % w
                 recv_i = (r - t - 1) % w

@@ -218,7 +218,7 @@ class ShmGroup:
             if out is not src:
                 np.copyto(out.reshape(-1), src.reshape(-1))
         fold = reduce_ufunc(op)
-        with _metrics.round_seconds.time(labels={"algo": self.algo}):
+        with _metrics.round_timer(self.algo):
             self._post_header(src, deadline)
             for p in self._others():
                 pd, ps, pn = self._read_header(p, deadline)
@@ -257,7 +257,7 @@ class ShmGroup:
     def broadcast(self, arr, root_rank: int, timeout_ms: int) -> np.ndarray:
         self._ensure_channels()
         deadline = time.monotonic() + timeout_ms / 1000.0
-        with _metrics.round_seconds.time(labels={"algo": self.algo}):
+        with _metrics.round_timer(self.algo):
             if self.rank == root_rank:
                 src = np.ascontiguousarray(np.asarray(arr))
                 self._post_header(src, deadline)
@@ -287,7 +287,7 @@ class ShmGroup:
         src = np.ascontiguousarray(np.asarray(arr))
         results: List[Optional[np.ndarray]] = [None] * self.world_size
         results[self.rank] = np.asarray(arr)
-        with _metrics.round_seconds.time(labels={"algo": self.algo}):
+        with _metrics.round_timer(self.algo):
             self._post_header(src, deadline)
             metas = {p: self._read_header(p, deadline)
                      for p in self._others()}
@@ -347,7 +347,7 @@ class ShmGroup:
         seg_hi = seg_lo + splits[self.rank].size
         mine = splits[self.rank].copy()
         mine_flat = mine.reshape(-1)
-        with _metrics.round_seconds.time(labels={"algo": self.algo}):
+        with _metrics.round_timer(self.algo):
             self._post_header(src, deadline)
             for p in self._others():
                 pd, ps, pn = self._read_header(p, deadline)

@@ -78,9 +78,54 @@ def summarize_tasks() -> Dict[str, Dict[str, int]]:
     return {k: dict(v) for k, v in summary.items()}
 
 
-def cluster_metrics() -> str:
-    """The controller's Prometheus exposition text."""
-    return _call("metrics")
+def cluster_metrics(all_nodes: bool = False) -> str:
+    """Prometheus exposition text. Default: the controller's own
+    registry (the pre-existing behaviour). ``all_nodes=True`` fans the
+    scrape out to every supervisor AND every worker registry (plus this
+    driver's own) and merges the expositions with ``node``/``component``
+    labels — the data-plane metrics recorded inside worker processes
+    (channels, collectives, pipeline, serve, podracer) are otherwise
+    invisible cluster-wide."""
+    text = _call("metrics")
+    if not all_nodes:
+        return text
+    from ray_tpu._private.metrics import (default_registry,
+                                          merge_expositions,
+                                          relabel_exposition)
+
+    core = api._require_core()
+    parts = [relabel_exposition(
+        text, {"node": "head", "component": "controller"})]
+    parts.append(relabel_exposition(
+        default_registry().render_prometheus(),
+        {"node": "head", "component": "driver"}))
+    nodes = []
+    for node in _call("node_views"):
+        if not node.get("alive", True):
+            continue  # a dead node's client burns the connect deadline
+        name = (node.get("labels") or {}).get("node_name") \
+            or node["node_id_hex"][:8]
+        nodes.append((name, core.clients.get(tuple(node["address"]))))
+
+    async def _gather_scrapes():
+        # concurrent: one wedged supervisor costs its own 30s timeout,
+        # not 30s times its position in the node list
+        import asyncio
+
+        return await asyncio.gather(
+            *(client.call("metrics_all", {}, timeout=30)
+              for _, client in nodes),
+            return_exceptions=True)
+
+    for (name, _), sections in zip(nodes, core._run(_gather_scrapes())):
+        if isinstance(sections, BaseException):
+            continue  # a dying node must not fail the cluster scrape
+        for component, body in sections:
+            parts.append(relabel_exposition(
+                body, {"node": name, "component": component}))
+    # regroup into one HELP/TYPE block per family: concatenation would
+    # emit duplicate TYPE lines, which Prometheus ingestion rejects
+    return merge_expositions(parts)
 
 
 def timeline(path: Optional[str] = None) -> List[Dict[str, Any]]:
@@ -126,6 +171,86 @@ def timeline(path: Optional[str] = None) -> List[Dict[str, Any]]:
         with open(path, "w") as f:
             json.dump(trace, f)
     return trace
+
+
+def flight_timeline(path: Optional[str] = None) -> List[Dict[str, Any]]:
+    """One merged Chrome-trace/Perfetto timeline of every flight
+    recorder in the cluster (`_private/flight.py`): the zero-RPC hot-loop
+    spans (channel waits, 1F1B fwd/bwd/flush, serve admit/prefill/decode
+    iterations, collective rounds, Sebulba phases) that ``timeline()``'s
+    task-event feed cannot see, plus metrics-registry counters sampled at
+    drain time and per-flush bubble counter tracks.
+
+    The drain is out-of-band: one ``flight_dump`` RPC per daemon (each
+    supervisor relays to its workers), issued only when THIS function
+    runs — recording itself never leaves the process. Cross-host clocks
+    align via each process's monotonic->wall anchor plus a per-node
+    wall-offset handshake with the supervisor, corrected by RTT/2.
+
+    Returns the event list; writes Perfetto-loadable JSON to ``path``
+    when given.
+    """
+    import time as _time
+
+    from ray_tpu._private import flight
+
+    core = api._require_core()
+    entries = [(flight.drain(), "head", 0)]
+    try:
+        controller_dump = _call("flight_dump")
+    except Exception:
+        controller_dump = None  # controller mid-restart: merge what we can
+    nodes = []
+    for node in _call("node_views"):
+        if not node.get("alive", True):
+            # a dead node's client would burn the full connect-retry
+            # deadline — worst exactly on the chaos dump-on-failure path
+            continue
+        addr = tuple(node["address"])
+        name = (node.get("labels") or {}).get("node_name") \
+            or node["node_id_hex"][:8]
+        client = core.clients.get(addr)
+        try:
+            # RTT/2-corrected wall-clock offset of this node vs the
+            # driver's host: the supervisor's clock read is assumed to
+            # happen mid-flight, so offset = remote_wall - (t0+t1)/2.
+            # Handshakes stay sequential — each needs its own clean RTT
+            # measurement, and they are cheap
+            t0 = _time.time_ns()
+            clock = core._run(client.call("flight_clock", {}, timeout=15))
+            t1 = _time.time_ns()
+        except Exception:
+            continue  # a dying node must not fail the merge
+        nodes.append((name, client, int(clock["wall_ns"] - (t0 + t1) // 2),
+                      addr))
+
+    if controller_dump is not None:
+        # the controller shares the head node's host clock: reuse that
+        # supervisor's measured offset (a remotely-attached driver's
+        # wall clock can differ from the head's; 0 would skew exactly
+        # the controller's rows)
+        head_host = core.controller_addr[0]
+        head_offset = next((off for _, _, off, a in nodes
+                            if a[0] == head_host), 0)
+        entries.append((controller_dump, "head", head_offset))
+
+    async def _gather_dumps():
+        # the heavy part runs concurrently: total drain time is bounded
+        # by the slowest node, not the sum over nodes
+        import asyncio
+
+        return await asyncio.gather(
+            *(client.call("flight_dump", {"include_workers": True},
+                          timeout=60) for _, client, _, _ in nodes),
+            return_exceptions=True)
+
+    for (name, _, offset_ns, _), reply in zip(nodes,
+                                           core._run(_gather_dumps())):
+        if isinstance(reply, BaseException):
+            continue  # a dying node must not fail the merge
+        for dump in reply.get("dumps", []):
+            entries.append((dump, name, offset_ns))
+    return flight.merge_dumps(entries, path=path)
 
 
 # ------------------------------------------------- live worker profiling

@@ -68,6 +68,14 @@ def _spans_path() -> str:
 
 def _emit(span: Dict[str, Any]) -> None:
     global _file, _file_path
+    # user spans also land in the flight recorder (when it is on), so
+    # they appear on the same merged cluster timeline as the data-plane
+    # hot-loop spans — a lock-free ring write, nothing like the
+    # file-export cost below
+    from ray_tpu._private import flight
+
+    if flight.is_enabled():
+        flight.record_span(span["name"], int(span["duration_s"] * 1e9))
     if _exporter is not None:
         _exporter(span)
         return

@@ -1,14 +1,19 @@
-"""Metrics registry, Prometheus endpoints, and the state API.
+"""Metrics registry, Prometheus endpoints, the state API, and the
+flight recorder (`_private/flight.py`: in-band hot-loop span rings,
+out-of-band drain, cluster-merged Perfetto timeline).
 Reference analogs: `src/ray/stats/metric.h` unit behavior,
 `python/ray/tests/test_metrics_agent.py` (scrape during a run),
-`python/ray/util/state` listing tests."""
+`python/ray/util/state` listing tests, and the dashboard
+reporter/timeline layer for the flight pieces."""
 
+import threading
 import time
 import urllib.request
 
 import pytest
 
 import ray_tpu
+from ray_tpu._private import flight
 from ray_tpu._private.metrics import (Counter, Gauge, Histogram, Registry)
 from ray_tpu.util import state as state_api
 
@@ -49,6 +54,30 @@ class TestRegistry:
         Counter("t_x", registry=reg)
         with pytest.raises(ValueError, match="different type"):
             Gauge("t_x", registry=reg)
+
+    def test_reregister_same_type_reuses_instance(self):
+        """Re-creating a metric by name must return the REGISTERED
+        instance: the old behaviour silently replaced it in the dict,
+        orphaning the first object — modules still incrementing it
+        never rendered again."""
+        reg = Registry()
+        c1 = Counter("t_reuse_total", "first", registry=reg)
+        c1.inc(3)
+        c2 = Counter("t_reuse_total", "second", registry=reg)
+        assert c1 is c2
+        c2.inc(2)
+        # one series carrying BOTH call sites' increments
+        assert "t_reuse_total 5.0" in reg.render_prometheus()
+        # the original holder keeps rendering too (the bug this fixes)
+        c1.inc(1)
+        assert "t_reuse_total 6.0" in reg.render_prometheus()
+        # histograms keep their first bucket layout and observations
+        h1 = Histogram("t_reuse_h", buckets=(1.0, 5.0), registry=reg)
+        h1.observe(0.5)
+        h2 = Histogram("t_reuse_h", registry=reg)
+        assert h1 is h2
+        assert h2.buckets == (1.0, 5.0)
+        assert h2.count_total() == 1
 
 
 class TestClusterObservability:
@@ -274,3 +303,231 @@ class TestUsageTelemetry:
             assert "secret_lib" not in usage.build_report()["libraries_used"]
         finally:
             os.environ.pop("RAY_TPU_USAGE_STATS_ENABLED", None)
+
+
+@pytest.fixture
+def flight_ring():
+    """A small, clean recorder for this thread; restores defaults."""
+    was_enabled = flight.is_enabled()
+    flight.configure(enabled=True, records=64)
+    flight._reset_for_tests()
+    yield
+    flight.configure(enabled=was_enabled, records=16384)
+    flight._reset_for_tests()
+
+
+class TestFlightRecorder:
+    def test_ring_wrap_keeps_newest_and_reports_drops(self, flight_ring):
+        fid = flight.intern("t.wrap")
+        for i in range(200):
+            flight.instant(fid, i)
+        dump = flight.drain()
+        th = next(t for t in dump["threads"] if t["count"] == 200)
+        assert th["dropped"] == 200 - 64
+        events = flight.decode(dump)
+        args = [e["args"]["arg"] for e in events
+                if e.get("ph") == "i" and e["name"] == "t.wrap"]
+        # the NEWEST 64 survive, oldest 136 dropped
+        assert args == list(range(136, 200))
+
+    def test_drain_under_load_consistent_without_stalling(self,
+                                                          flight_ring):
+        """Concurrent drains must see a consistent snapshot (the valid
+        window excludes anything the writer may have torn) and must not
+        pace the recording thread."""
+        flight.configure(records=4096)
+        fid = flight.intern("t.load")
+        stop = threading.Event()
+        wrote = [0]
+
+        def writer():
+            # the recorder thread binds its OWN ring on first record
+            i = 0
+            while not stop.is_set():
+                flight.instant(fid, i)
+                i += 1
+            wrote[0] = i
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        counts = []
+        try:
+            deadline = time.monotonic() + 2.0
+            while time.monotonic() < deadline:
+                dump = flight.drain()
+                th = next((x for x in dump["threads"]
+                           if x["name"].startswith("Thread")), None)
+                if th is None:
+                    continue
+                counts.append(th["count"])
+                events = flight.decode(dump)
+                args = [e["args"]["arg"] for e in events
+                        if e.get("ph") == "i" and e["name"] == "t.load"]
+                # a torn or mis-windowed record would break contiguity
+                assert args == list(range(args[0], args[0] + len(args))) \
+                    if args else True
+        finally:
+            stop.set()
+            t.join(timeout=5)
+        # the writer kept recording across ~hundreds of drains
+        assert wrote[0] > 0 and len(counts) > 10
+        assert counts[-1] > counts[0], "drains stalled the recorder"
+
+    def test_clock_alignment_merges_fake_offset_hosts(self, flight_ring):
+        """Two hosts whose wall clocks disagree by seconds must land on
+        one timeline within tolerance once the per-node RTT/2 offset is
+        applied."""
+        import copy
+
+        fid = flight.intern("t.sync")
+        flight.instant(fid, 7)
+        dump_a = flight.drain()
+        # host B: same monotonic records, wall clock reading 5s AHEAD
+        skew_ns = 5_000_000_000
+        dump_b = copy.deepcopy(dump_a)
+        dump_b["wall_ns"] += skew_ns
+        # the driver's handshake measured the offset with ~300us of
+        # RTT/2 error — alignment only needs to beat human tolerance
+        measured_offset = skew_ns + 300_000
+        ts_a = [e["ts"] for e in flight.decode(dump_a, node="a")
+                if e.get("ph") == "i" and e["name"] == "t.sync"]
+        ts_b = [e["ts"] for e in flight.decode(
+            dump_b, node="b", clock_offset_ns=measured_offset)
+            if e.get("ph") == "i" and e["name"] == "t.sync"]
+        assert ts_a and ts_b
+        assert abs(ts_a[0] - ts_b[0]) < 1_000, "events > 1ms apart"
+        # without the offset they are ~5s apart
+        ts_raw = [e["ts"] for e in flight.decode(dump_b, node="b")
+                  if e.get("ph") == "i" and e["name"] == "t.sync"]
+        assert abs(ts_raw[0] - ts_a[0]) > 4_000_000
+
+    def test_span_kinds_decode(self, flight_ring):
+        nid = flight.intern("t.span")
+        t0 = flight.now()
+        time.sleep(0.002)
+        flight.span_since(nid, t0)
+        flight.begin(nid)
+        flight.end(nid)
+        flight.counter(flight.intern("t.ctr"), 1234)
+        events = flight.decode(flight.drain())
+        x = next(e for e in events if e["ph"] == "X")
+        assert x["name"] == "t.span" and x["dur"] >= 2_000  # >= 2ms in us
+        assert any(e["ph"] == "B" for e in events)
+        assert any(e["ph"] == "E" for e in events)
+        c = next(e for e in events
+                 if e["ph"] == "C" and e["name"] == "t.ctr")
+        assert c["args"]["value"] == 1234
+
+    def test_dead_thread_rings_pruned(self, flight_ring):
+        """Short-lived recording threads must not accrete one ring
+        buffer each forever: the next recording thread's ring-create
+        prunes exited owners (keeping the most recent dead ring
+        drainable until then)."""
+        fid = flight.intern("t.dead")
+
+        def w():
+            flight.instant(fid, 1)
+
+        for _ in range(5):
+            t = threading.Thread(target=w)
+            t.start()
+            t.join()
+        # a ring per dead thread would mean 5 here; each new thread
+        # pruned its predecessors, so at most the LAST dead one remains
+        with flight._rings_lock:
+            assert sum(1 for r in flight._rings if r.dead()) <= 1
+        flight.instant(fid, 2)  # this thread's bind prunes the rest
+        with flight._rings_lock:
+            assert all(not r.dead() for r in flight._rings)
+
+    def test_disabled_records_nothing(self, flight_ring):
+        flight.configure(enabled=False)
+        fid = flight.intern("t.off")
+        flight.instant(fid, 1)
+        t0 = flight.now()
+        assert t0 == 0
+        flight.span_since(fid, t0)
+        flight.configure(enabled=True)
+        events = flight.decode(flight.drain())
+        assert not any(e.get("name") == "t.off" for e in events)
+
+
+class TestFlightTimelineCluster:
+    def test_merged_timeline_all_roles(self, ray_init, tmp_path):
+        """flight_timeline fans the drain out to every daemon (driver,
+        controller, supervisor relaying each worker) and merges ONE
+        Perfetto-loadable JSON with per-role process rows, hot-loop
+        spans, and sampled metric counters."""
+        import json
+
+        from ray_tpu.util import tracing
+
+        @ray_tpu.remote
+        def touch():
+            # a span recorded INSIDE a worker process
+            with flight.span("test.worker_side"):
+                return 1
+
+        assert ray_tpu.get([touch.remote() for _ in range(4)]) == [1] * 4
+        tracing.enable()
+        try:
+            with tracing.span("test.user_span"):
+                pass
+        finally:
+            tracing.disable()
+        path = tmp_path / "flight.json"
+        events = state_api.flight_timeline(str(path))
+        assert events
+        loaded = json.load(open(path))
+        assert len(loaded) == len(events)
+        roles = set()
+        for e in events:
+            pid = str(e.get("pid", ""))
+            for role in ("driver", "controller", "supervisor", "worker"):
+                if f"/{role}:" in pid or pid.startswith(f"{role}:"):
+                    roles.add(role)
+        assert {"driver", "controller", "supervisor", "worker"} <= roles, \
+            roles
+        names = {e.get("name") for e in events}
+        assert "test.user_span" in names  # tracing routed into the rings
+        assert "test.worker_side" in names  # drained out of a worker
+        # registry counters sampled in as counter events
+        assert any(e["ph"] == "C" and
+                   str(e["name"]).startswith("ray_tpu_")
+                   for e in events)
+
+    def test_cluster_metrics_all_nodes(self, ray_init):
+        """The fanned-out scrape merges every registry with node and
+        component labels — data-plane metrics recorded inside worker
+        processes become visible cluster-wide."""
+
+        @ray_tpu.remote
+        def bump():
+            from ray_tpu._private.metrics import Counter as C
+
+            C("test_worker_side_total", "worker-side series").inc(3)
+            return 1
+
+        assert ray_tpu.get(bump.remote()) == 1
+        text = state_api.cluster_metrics(all_nodes=True)
+        assert 'component="controller"' in text
+        assert 'component="driver"' in text
+        assert 'component="supervisor"' in text
+        assert 'component="worker:' in text
+        # the worker-recorded series made it into the merged exposition
+        assert "test_worker_side_total" in text
+        # parser-valid: a family present in many processes must render
+        # ONE # TYPE block (Prometheus rejects duplicates/split groups)
+        type_lines = [ln for ln in text.splitlines()
+                      if ln.startswith("# TYPE ")]
+        assert len(type_lines) == len(set(type_lines)), type_lines
+        # every sample of a family sits directly under its own header
+        fam = None
+        for ln in text.splitlines():
+            if ln.startswith("# TYPE "):
+                fam = ln.split(" ", 3)[2]
+            elif ln and not ln.startswith("#"):
+                name = ln.split("{", 1)[0].split(" ", 1)[0]
+                assert fam and name.startswith(fam), (name, fam)
+        # plain scrape keeps the old controller-only behaviour
+        assert "component=" not in state_api.cluster_metrics()
