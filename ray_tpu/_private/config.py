@@ -160,6 +160,14 @@ class Config:
     dashboard_port: int = 0
     # controller durable-state snapshot cadence (actors/PGs/jobs/KV)
     controller_snapshot_interval_ms: int = 500
+    # in-process KV shards, partitioned by namespace hash; each shard
+    # appends to its own WAL stream (kv_shards.KvShardMap — the
+    # structural first step toward out-of-process control-plane shards)
+    controller_kv_shards: int = 8
+    # how long clients ride out a controller kill+restart window:
+    # registrations and re-issued kv_wait long-polls retry reconnecting
+    # for this budget before surfacing the outage to the caller
+    controller_reconnect_budget_s: float = 30.0
     # durable control-plane store target: "" = session-dir files; any
     # external-storage URI (file://, mock://, s3://) puts snapshots+WAL
     # in that backend so head-disk loss is recoverable
@@ -187,6 +195,17 @@ class Config:
     chaos_crash_points: str = ""
     # ---- testing ----
     fake_cluster: bool = False
+
+    def recovery_grace_s(self) -> float:
+        """How long a node gets to re-register after a controller
+        restart before it is treated as lost. Shared by the controller's
+        post-recovery reconcile (ghost-node death fan-out, actor
+        failover) and the supervisors' missing-node debounce (pin /
+        channel sweep) — the two sides of the recovery protocol must
+        agree on this window or a supervisor could sweep a peer's pins
+        while the controller still expects it back."""
+        return (self.health_check_period_ms
+                * self.health_check_failure_threshold / 1000.0) + 3.0
 
     @classmethod
     def from_env(cls, overrides: Dict[str, Any] | None = None) -> "Config":

@@ -31,10 +31,12 @@ from ray_tpu._private import chaos, serialization
 from ray_tpu._private.config import Config
 from ray_tpu._private.http_util import MetricsHttpServer
 from ray_tpu._private.ids import ActorID, JobID, NodeID, PlacementGroupID
-from ray_tpu._private.metrics import Counter, Gauge, default_registry
+from ray_tpu._private.kv_shards import KvShardMap
+from ray_tpu._private.metrics import (Counter, Gauge, Histogram,
+                                      default_registry)
 from ray_tpu._private.resources import ResourceSet
-from ray_tpu._private.rpc import (ClientPool, RpcServer, idempotent,
-                                  replay_cached, retry_call)
+from ray_tpu._private.rpc import (ClientPool, RpcServer, current_replay_key,
+                                  idempotent, replay_cached, retry_call)
 from ray_tpu._private.scheduling import NodeView, PlacementError, place_bundles
 
 logger = logging.getLogger(__name__)
@@ -163,7 +165,10 @@ class Controller:
         self.named_actors: Dict[Tuple[str, str], str] = {}
         self.pgs: Dict[str, PGRecord] = {}
         self.jobs: Dict[str, JobRecord] = {}
-        self.kv: Dict[str, Dict[str, bytes]] = {}
+        # namespace-hash-sharded KV: each shard has its own table, lock
+        # and WAL stream (kv_shards.py — first step toward out-of-process
+        # control-plane shards)
+        self.kv = KvShardMap(config.controller_kv_shards)
         # kv_wait long-pollers: (ns, key) -> futures resolved by the next
         # put (collective rendezvous, PG readiness — replaces client-side
         # busy-polling on the control plane)
@@ -176,9 +181,24 @@ class Controller:
         self._state_dirty = False
         self._mutation_seq = 0
         self._wal_epoch = 0  # bumped by each snapshot compaction
-        self._persist_lock = asyncio.Lock()  # WAL appends vs compaction
+        # main-stream WAL appends vs compaction; per-KV-shard appends
+        # ride each shard's own lock (compaction acquires all of them)
+        self._persist_lock = asyncio.Lock()
         self._next_job_int = 0
         self._started = time.time()
+        # set when this incarnation recovered durable state: gates the
+        # node-re-register worker reconcile (only a controller restart
+        # can re-register a node that still hosts live workers)
+        self._recovered = False
+        # nodes the PREVIOUS incarnation knew (recovered from WAL/
+        # snapshot "node" frames, NOT live records — supervisors must
+        # re-register): one that never returns gets the DEAD fan-out it
+        # would have received had the controller lived, so owners
+        # requeue its leases instead of hanging forever
+        self._ghost_nodes: Dict[str, Address] = {}
+        # strong refs to fire-and-forget recovery tasks (asyncio keeps
+        # only weak ones; a GC'd reconcile would silently never run)
+        self._bg_tasks: Set[asyncio.Task] = set()
         # structured lifecycle events (≈ src/ray/util/event.h), queryable
         # via util.state.list_cluster_events
         from ray_tpu._private.events import EventLogger
@@ -194,25 +214,52 @@ class Controller:
                             "Placement groups by state")
         self._m_task_events = Counter("ray_tpu_task_events_total",
                                       "Task lifecycle events received")
+        self._m_recoveries = Counter(
+            "ray_tpu_controller_recoveries_total",
+            "Controller restarts that recovered durable state")
+        self._m_recovery_seconds = Histogram(
+            "ray_tpu_controller_recovery_seconds",
+            "Snapshot load + WAL replay wall time per recovery")
+        self._m_kv_shard_keys = Gauge(
+            "ray_tpu_kv_shard_keys",
+            "Keys held per controller KV shard")
 
     # ----------------------------------------------------------- persistence
 
     _SNAPSHOT_VERSION = 1
+    _NO_REPLY = object()  # sentinel: this append carries no RPC reply
 
     def _snapshot_state(self) -> dict:
         """The durable subset: everything a restarted controller needs to
         keep serving existing clients (≈ what the reference rebuilds from
         Redis via gcs_init_data.h). Node records are NOT persisted —
         supervisors re-register on their next sync. Task events and
-        subscribers are soft state."""
+        subscribers are soft state. Completed replay-cache entries ARE
+        persisted: compaction sweeps the WAL frames that embedded them,
+        and dropping them would reopen the exactly-once window for a
+        retry straddling the next restart."""
         return {
             "version": self._SNAPSHOT_VERSION,
             "actors": self.actors,
             "named_actors": self.named_actors,
             "pgs": self.pgs,
             "jobs": self.jobs,
-            "kv": self.kv,
+            # flat ns->table dict: shard-count agnostic on disk
+            "kv": self.kv.merged(),
             "next_job_int": self._next_job_int,
+            "replay": self.server.export_replay(),
+            # ADDRESSES of every LIVE node this incarnation has known
+            # (live records stay soft state): the next incarnation's
+            # reconcile publishes DEAD for any that never re-register.
+            # Already-dead nodes are excluded — their fan-out ran; a
+            # ghost re-declare on every restart would spam duplicate
+            # NODE_DEAD events and could spuriously requeue leases if a
+            # later supervisor reuses the address
+            "nodes_known": {
+                **{h: list(a) for h, a in self._ghost_nodes.items()},
+                **{r.node_id_hex: list(r.address)
+                   for r in self.nodes.values() if r.alive},
+            },
             # WAL frames from epochs <= this are superseded by this
             # snapshot (see gcs_store epoch keying)
             "wal_epoch": self._wal_epoch,
@@ -222,38 +269,98 @@ class Controller:
         self._state_dirty = True
         self._mutation_seq += 1
 
-    async def _wal_append(self, kind: str, payload: Any) -> None:
+    async def _wal_append(self, kind: str, payload: Any, stream: str = "",
+                          lock: Optional[asyncio.Lock] = None,
+                          reply: Any = _NO_REPLY) -> None:
         """Durable write-ahead record BEFORE acking a registration RPC:
         once the caller sees the reply, the record survives a controller
         crash (the reference gets this from synchronous Redis writes in
         the GCS table layer; VERDICT r3 weak #7). O(entry), not
         O(total-state): the interval snapshot compacts the log. The
         actual medium is pluggable (gcs_store.ControlStore: session-dir
-        files or a remote URI backend, ref redis_store_client.h)."""
+        files or a remote URI backend, ref redis_store_client.h).
+
+        ``stream``/``lock``: KV mutations append to their SHARD's own WAL
+        stream under that shard's lock (other record kinds ride the main
+        stream + ``_persist_lock``); compaction acquires every lock.
+
+        ``reply``: when given AND this append runs inside a replay-cached
+        RPC dispatch, the (client_id, msg_id) replay key and the reply
+        value are folded into the SAME frame as the mutation — one
+        durable write, so there is no crash window between "applied" and
+        "reply cached". A retried non-idempotent RPC that straddles a
+        controller restart is then answered from the recovered cache,
+        never re-applied (tests/test_controller_ha.py proves it at the
+        ``ctrl.actor_register`` crash point)."""
         if self._store is None:
             return
-        frame = serialization.dumps((kind, payload))
-        async with self._persist_lock:
+        record: Tuple = (kind, payload)
+        if reply is not self._NO_REPLY:
+            ckey = current_replay_key()
+            if ckey is not None:
+                record = (kind, payload, (ckey[0], ckey[1], ckey[2], reply))
+        frame = serialization.dumps(record)
+        async with (lock or self._persist_lock):
             await asyncio.get_running_loop().run_in_executor(
-                None, self._store.append_wal, self._wal_epoch, frame)
+                None, self._store.append_wal, self._wal_epoch, frame,
+                stream)
 
     def _replay_wal(self) -> int:
-        """Apply WAL entries on top of the loaded snapshot (entries are
-        all >= the last compaction; re-application overwrites in place).
-        A torn tail — crash mid-append — ends the replay cleanly."""
+        """Apply WAL entries on top of the loaded snapshot: EVERY epoch
+        at or after the snapshot's resume point (several accumulate when
+        interval snapshots failed or recovery fell back to an older
+        snapshot epoch), main stream first, then each KV shard stream
+        (streams are listed from the store, so frames written by an
+        incarnation with a different shard count still replay — routing
+        is by namespace through the CURRENT map). Re-application
+        overwrites in place; a torn tail — crash mid-append — ends that
+        stream's replay cleanly."""
         if self._store is None:
             return 0
+        from ray_tpu._private import flight
+
         applied = 0
-        for raw in self._store.read_wal(self._wal_epoch):
+        with flight.span("ctrl.replay_wal"):
+            epochs = sorted(e for e in self._store.list_wal_epochs()
+                            if e >= self._wal_epoch)
+            streams = [""] + sorted(self._store.list_wal_streams())
+            for epoch in epochs:
+                for stream in streams:
+                    applied += self._apply_wal_frames(
+                        self._store.read_wal(epoch, stream))
+            if epochs:
+                # resume appending in a FRESH epoch, never the newest
+                # file seen: that file may end in a torn frame (crash
+                # mid-append), and appending after torn bytes would make
+                # every later acked frame unparseable on the next
+                # recovery — a silent durability hole in the double-crash
+                # case
+                self._wal_epoch = epochs[-1] + 1
+        return applied
+
+    def _apply_wal_frames(self, frames) -> int:
+        applied = 0
+        for raw in frames:
             try:
-                kind, payload = serialization.loads(raw)
+                record = serialization.loads(raw)
             except Exception:
                 break
+            kind, payload = record[0], record[1]
             if kind == "actor":
                 self.actors[payload.actor_id_hex] = payload
                 if payload.name:
                     self.named_actors[(payload.namespace, payload.name)] = (
                         payload.actor_id_hex)
+            elif kind == "actor_ready":
+                actor_hex, address, worker_hex, node_hex, incarnation = \
+                    payload
+                rec = self.actors.get(actor_hex)
+                if rec is not None and rec.state != ACTOR_DEAD:
+                    rec.state = ACTOR_ALIVE
+                    rec.address = tuple(address)
+                    rec.worker_id_hex = worker_hex
+                    rec.node_id_hex = node_hex
+                    rec.incarnation = incarnation
             elif kind == "pg":
                 self.pgs[payload.pg_id_hex] = payload
             elif kind == "job":
@@ -262,10 +369,10 @@ class Controller:
                 self._next_job_int = max(self._next_job_int, payload)
             elif kind == "kv":
                 ns, key, value = payload
-                self.kv.setdefault(ns, {})[key] = value
+                self.kv.namespace(ns)[key] = value
             elif kind == "kv_del":
                 ns, key = payload
-                self.kv.get(ns, {}).pop(key, None)
+                self.kv.peek(ns).pop(key, None)
             elif kind == "actor_dead":
                 actor_hex, reason = payload
                 rec = self.actors.get(actor_hex)
@@ -279,6 +386,19 @@ class Controller:
                 if job is not None:
                     job.alive = False
                     job.end_time = end_time
+            elif kind == "node":
+                node_hex, address = payload
+                self._ghost_nodes[node_hex] = tuple(address)
+            elif kind == "node_dead":
+                # death tombstone: its fan-out already ran; the ghost
+                # reconcile must not re-declare it on every restart
+                self._ghost_nodes.pop(payload, None)
+            if len(record) > 2 and record[2] is not None:
+                # the frame carried its RPC replay key: re-arm the
+                # server's exactly-once cache for retries that straddled
+                # the restart
+                client_id, msg_id, method, reply = record[2]
+                self.server.seed_replay(client_id, msg_id, method, reply)
             applied += 1
         return applied
 
@@ -291,32 +411,88 @@ class Controller:
     def _load_snapshot(self) -> bool:
         if self._store is None:
             return False
-        blob = self._store.load_latest_snapshot()
-        if blob is None:
-            return False
-        try:
-            state = serialization.loads(blob)
-        except Exception:
-            logger.exception("controller snapshot unreadable; starting fresh")
-            return False
-        if state.get("version") != self._SNAPSHOT_VERSION:
-            logger.warning("controller snapshot version mismatch; ignoring")
+        state = None
+        for blob in self._store.load_snapshots():
+            try:
+                candidate = serialization.loads(blob)
+            except Exception:
+                logger.exception(
+                    "controller snapshot unreadable; falling back to the "
+                    "previous epoch")
+                continue
+            if candidate.get("version") != self._SNAPSHOT_VERSION:
+                logger.warning(
+                    "controller snapshot version mismatch; falling back "
+                    "to the previous epoch")
+                continue
+            state = candidate
+            break
+        if state is None:
             return False
         self.actors = state["actors"]
         self.named_actors = state["named_actors"]
         self.pgs = state["pgs"]
         self.jobs = state["jobs"]
-        self.kv = state["kv"]
+        self.kv.load(state.get("kv", {}))
         self._next_job_int = state["next_job_int"]
+        for client_id, msg_id, payload in state.get("replay", []):
+            self.server.seed_replay_payload((client_id, msg_id), payload)
+        for node_hex, address in state.get("nodes_known", {}).items():
+            self._ghost_nodes[node_hex] = tuple(address)
         # resume appending at the epoch AFTER the one this snapshot
-        # superseded; stale lower-epoch WAL frames are ignored and swept
+        # superseded; stale lower-epoch WAL frames are simply ignored by
+        # _replay_wal (which applies EVERY newer epoch, so frames
+        # written after a corrupt/failed later snapshot still land).
+        # No sweep here: retention is the snapshot loop's job, keyed off
+        # the store's snapshot inventory — sweeping on load would drop
+        # the frames an OLDER snapshot needs for the corruption fallback
         self._wal_epoch = state.get("wal_epoch", 0) + 1
-        self._store.sweep_wals(self._wal_epoch - 1)
         logger.info(
             "controller recovered from snapshot: %d actors, %d pgs, "
             "%d jobs, %d kv namespaces",
-            len(self.actors), len(self.pgs), len(self.jobs), len(self.kv))
+            len(self.actors), len(self.pgs), len(self.jobs),
+            self.kv.num_namespaces())
         return True
+
+    async def _compact_once(self) -> None:
+        """One snapshot compaction. Serialize INSIDE the locks: every
+        acked registration takes the main lock (KV mutations their
+        shard's lock) for its WAL append, so a mutation is either
+        already in the blob (its old-epoch frame is then safely
+        superseded) or its append lands in the NEW epoch's file and
+        replays after this snapshot. The epoch bump (not truncation)
+        makes compaction crash-atomic: recovery replays only frames
+        newer than the installed snapshot's recorded epoch.
+
+        Retention keeps ONE generation of history — the previous
+        snapshot plus every WAL epoch newer than it — so recovery from a
+        bit-rotted newest snapshot (load_snapshots fallback) is
+        lossless. The previous snapshot's epoch comes from the STORE
+        INVENTORY, not superseded-1: epoch numbers jump across
+        controller restarts (_replay_wal resumes in a fresh epoch), and
+        arithmetic would sweep the fallback generation. With no older
+        snapshot yet, nothing is swept: the full WAL is the fallback."""
+        import contextlib
+
+        async with contextlib.AsyncExitStack() as stack:
+            await stack.enter_async_context(self._persist_lock)
+            for shard in self.kv.shards:
+                await stack.enter_async_context(shard.lock)
+            blob = serialization.dumps(self._snapshot_state())
+            loop = asyncio.get_running_loop()
+            superseded = self._wal_epoch
+            await loop.run_in_executor(
+                None, self._store.write_snapshot, superseded, blob)
+            self._wal_epoch += 1
+            snaps = await loop.run_in_executor(
+                None, self._store.list_snapshot_epochs)
+            older = [e for e in snaps if e < superseded]
+            if older:
+                prev = older[-1]
+                await loop.run_in_executor(
+                    None, self._store.sweep_wals, prev)
+                await loop.run_in_executor(
+                    None, self._store.sweep_snapshots, prev)
 
     async def _snapshot_loop(self) -> None:
         interval = max(0.1, self.config.controller_snapshot_interval_ms / 1000)
@@ -326,25 +502,7 @@ class Controller:
                 continue  # nothing changed since the last write
             self._state_dirty = False
             try:
-                # Compaction. Serialize INSIDE the lock: every acked
-                # registration takes this lock for its WAL append, so a
-                # mutation is either already in the blob (its old-epoch
-                # frame is then safely superseded) or its append lands in
-                # the NEW epoch's file and replays after this snapshot.
-                # The epoch bump (not truncation) makes compaction
-                # crash-atomic: recovery replays only frames newer than
-                # the installed snapshot's recorded epoch.
-                async with self._persist_lock:
-                    blob = serialization.dumps(self._snapshot_state())
-                    loop = asyncio.get_running_loop()
-                    superseded = self._wal_epoch
-                    await loop.run_in_executor(
-                        None, self._store.write_snapshot, superseded, blob)
-                    self._wal_epoch += 1
-                    await loop.run_in_executor(
-                        None, self._store.sweep_wals, superseded)
-                    await loop.run_in_executor(
-                        None, self._store.sweep_snapshots, superseded)
+                await self._compact_once()
             except Exception:
                 self._state_dirty = True
                 logger.exception("controller snapshot write failed")
@@ -353,9 +511,34 @@ class Controller:
         """Fail over snapshot-recovered actors/PGs whose node never came
         back: the health loop only probes registered nodes, so a host lost
         during the controller outage would otherwise stay 'ALIVE' forever."""
-        grace = (self.config.health_check_period_ms
-                 * self.config.health_check_failure_threshold / 1000.0) + 3.0
-        await asyncio.sleep(grace)
+        await asyncio.sleep(self.config.recovery_grace_s())
+        # nodes the previous incarnation knew that never re-registered:
+        # publish the DEAD fan-out they would have received (address
+        # included so owners can requeue in-flight leases granted there
+        # — without it those tasks hang forever) and let peers' view
+        # sync sweep their node:<hex> pins
+        for ghost_hex, ghost_addr in list(self._ghost_nodes.items()):
+            if ghost_hex in self.nodes:
+                continue
+            logger.warning(
+                "node %s never re-registered after the controller "
+                "outage; declaring it dead", ghost_hex[:8])
+            self.events.emit(
+                "NODE_DEAD",
+                f"node {ghost_hex[:8]}: lost during controller outage",
+                severity="WARNING", node_id=ghost_hex,
+                reason="lost during controller outage")
+            await self._publish("nodes", {"event": "DEAD",
+                                          "node_id_hex": ghost_hex,
+                                          "address": list(ghost_addr)})
+            # tombstone like the registered-node death path: without it
+            # the snapshot/WAL still lists the ghost and EVERY later
+            # restart re-declares it dead (duplicate fan-out + spurious
+            # lease requeue if a replacement reuses the address)
+            await self._wal_append("node_dead", ghost_hex)
+        if self._ghost_nodes:
+            self._mark_dirty()
+        self._ghost_nodes.clear()
         for actor in list(self.actors.values()):
             if actor.state in (ACTOR_ALIVE, ACTOR_PENDING, ACTOR_RESTARTING) \
                     and actor.node_id_hex \
@@ -380,8 +563,12 @@ class Controller:
     # ------------------------------------------------------------- lifecycle
 
     async def start(self) -> Address:
-        recovered = self._load_snapshot()
-        replayed = self._replay_wal()
+        from ray_tpu._private import flight
+
+        t0 = time.monotonic()
+        with flight.span("ctrl.recover"):
+            recovered = self._load_snapshot()
+            replayed = self._replay_wal()
         if replayed:
             logger.info("replayed %d WAL entries", replayed)
         recovered = recovered or replayed > 0
@@ -392,15 +579,22 @@ class Controller:
         if self._store is not None:
             self._snapshot_task = loop.create_task(self._snapshot_loop())
         if recovered:
+            self._recovered = True
+            self._m_recoveries.inc()
+            self._m_recovery_seconds.observe(time.monotonic() - t0)
             self.events.emit(
                 "CONTROLLER_RECOVERED",
                 f"recovered {len(self.actors)} actors, {len(self.pgs)} "
-                f"pgs, {len(self.jobs)} jobs from snapshot",
+                f"pgs, {len(self.jobs)} jobs from snapshot in "
+                f"{time.monotonic() - t0:.3f}s",
                 severity="WARNING")
             # surviving nodes re-register within a sync period; anything
             # still on an unknown node after the grace window was lost
-            # during the outage and must fail over
-            loop.create_task(self._reconcile_recovered())
+            # during the outage and must fail over (strong ref held:
+            # the loop alone would keep only a weak one)
+            task = loop.create_task(self._reconcile_recovered())
+            self._bg_tasks.add(task)
+            task.add_done_callback(self._bg_tasks.discard)
         from ray_tpu._private.job_manager import JobManager
 
         self.job_manager = JobManager(
@@ -456,6 +650,8 @@ class Controller:
             pg_states[p.state] = pg_states.get(p.state, 0) + 1
         for state, count in pg_states.items():
             self._m_pgs.set(count, {"state": state})
+        for i, n in enumerate(self.kv.keys_per_shard()):
+            self._m_kv_shard_keys.set(n, {"shard": str(i)})
         return ("text/plain; version=0.0.4",
                 default_registry().render_prometheus())
 
@@ -641,13 +837,65 @@ class Controller:
             last_busy=time.monotonic(),
         )
         self.nodes[rec.node_id_hex] = rec
+        self._ghost_nodes.pop(rec.node_id_hex, None)
         logger.info("node %s registered at %s", rec.node_id_hex[:8], rec.address)
+        # node RECORDS are soft state (supervisors re-register), but the
+        # node's EXISTENCE is WAL'd: a node that dies during a controller
+        # outage would otherwise be forgotten by the next incarnation,
+        # which then never publishes the DEAD fan-out owners requeue
+        # their in-flight leases on — they'd hang forever (the PR-1 bug
+        # resurfacing across the restart boundary)
+        await self._wal_append("node",
+                               (rec.node_id_hex, list(rec.address)))
         self.events.emit("NODE_REGISTERED",
                          f"node {rec.node_id_hex[:8]} joined",
                          node_id=rec.node_id_hex)
         await self._publish("nodes", {"event": "ALIVE", "node_id_hex": rec.node_id_hex})
         await self._retry_pending_pgs()
+        if self._recovered:
+            # a node RE-registering with a recovered controller still
+            # hosts its worker pool: reconcile our recovered actor table
+            # against its live reality (deaths during the outage may
+            # never have landed — the supervisor's worker_died retry
+            # budget is finite). Held in _bg_tasks: the loop keeps only
+            # a weak reference, and a GC'd task would silently skip the
+            # failover this reconcile exists for.
+            task = asyncio.get_running_loop().create_task(
+                self._reconcile_node_workers(rec))
+            self._bg_tasks.add(task)
+            task.add_done_callback(self._bg_tasks.discard)
         return {"num_nodes": len(self.nodes)}
+
+    async def _reconcile_node_workers(self, rec: NodeRecord) -> None:
+        """Fail over recovered-ALIVE actors whose worker no longer exists
+        on their (re-registered) node. The normal path — the supervisor's
+        ``worker_died`` — retries only ~15s; a longer controller outage
+        would otherwise leave the actor ALIVE forever with every caller
+        hanging on a dead address."""
+        # (actor, worker) PAIRS are fixed BEFORE the profile RPC: an
+        # actor whose ALIVE transition — or restart onto a fresh worker —
+        # lands while the (up to 10s) call is in flight must not be
+        # judged against the stale list; only an actor still on the SAME
+        # worker the snapshot predates can be declared lost by it
+        candidates = [(a, a.worker_id_hex) for a in self.actors.values()
+                      if a.node_id_hex == rec.node_id_hex
+                      and a.state == ACTOR_ALIVE and a.worker_id_hex]
+        try:
+            reply = await self.clients.get(rec.address).call(
+                "worker_profile", {}, timeout=10)
+        except Exception:
+            return  # health loop / next sync covers a flapping node
+        alive_workers = {w["worker_id_hex"] for w in reply.get("workers", [])}
+        for actor, worker_hex in candidates:
+            if (actor.state == ACTOR_ALIVE
+                    and actor.worker_id_hex == worker_hex
+                    and worker_hex not in alive_workers):
+                logger.warning(
+                    "recovered actor %s: worker %s gone during the "
+                    "controller outage; failing over",
+                    actor.actor_id_hex[:8], actor.worker_id_hex[:8])
+                await self._on_actor_failure(
+                    actor, "worker lost during controller outage")
 
     @idempotent  # latest-write-wins gossip
     async def rpc_node_sync(self, body):
@@ -722,6 +970,7 @@ class Controller:
             return
         rec.alive = False
         logger.warning("node %s dead: %s", node_hex[:8], reason)
+        self._ghost_nodes.pop(node_hex, None)
         self.events.emit("NODE_DEAD", f"node {node_hex[:8]}: {reason}",
                          severity="WARNING", node_id=node_hex,
                          reason=reason)
@@ -732,6 +981,12 @@ class Controller:
         await self._publish("nodes", {"event": "DEAD",
                                       "node_id_hex": node_hex,
                                       "address": list(rec.address)})
+        # tombstone the WAL "node" frame AFTER the fan-out went out: the
+        # next incarnation's ghost reconcile must not re-declare a
+        # handled death on every restart, but a crash BEFORE the publish
+        # must re-run it (duplicate fan-out is idempotent; a lost one
+        # hangs owners)
+        await self._wal_append("node_dead", node_hex)
         # fail over actors that lived there
         for actor in list(self.actors.values()):
             if actor.node_id_hex == node_hex and actor.state in (
@@ -777,7 +1032,9 @@ class Controller:
                 f"through the object store (ray_tpu.put) or the collective "
                 f"data plane (ray_tpu.util.collective), not the controller "
                 f"KV.")
-        ns = self.kv.setdefault(body.get("ns", ""), {})
+        ns_name = body.get("ns", "")
+        shard = self.kv.shard_for(ns_name)
+        ns = shard.data.setdefault(ns_name, {})
         overwrite = body.get("overwrite", True)
         if not overwrite and body["key"] in ns:
             return False
@@ -785,25 +1042,34 @@ class Controller:
         self._mark_dirty()
         # KV writes back named-actor rendezvous, collective groups, and
         # runtime-env manifests — registrations in spirit: durable before
-        # the ack, O(entry) via the WAL
-        await self._wal_append("kv", (body.get("ns", ""), body["key"],
-                                      value))
-        self._kv_notify(body.get("ns", ""), body["key"], value)
+        # the ack, O(entry) via the SHARD's own WAL stream. The reply
+        # (True) rides the same frame: a retried overwrite=False claim
+        # straddling a controller restart is answered from the recovered
+        # replay cache instead of being re-judged against its own write
+        # (the serve-weights first-replica-wins pattern depends on it)
+        await self._wal_append("kv", (ns_name, body["key"], value),
+                               stream=shard.stream, lock=shard.lock,
+                               reply=True)
+        self._kv_notify(ns_name, body["key"], value)
         return True
 
     @idempotent
     async def rpc_kv_get(self, body):
-        return self.kv.get(body.get("ns", ""), {}).get(body["key"])
+        return self.kv.peek(body.get("ns", "")).get(body["key"])
 
     @idempotent  # pure read with a deadline; retries just re-park
     async def rpc_kv_wait(self, body) -> dict:
         """Long-poll for a key: return immediately when present, else park
         until the next kv_put on it (or the timeout). One RPC replaces a
         client-side sleep-and-repoll loop — the rendezvous latency floor,
-        and far fewer control-plane round trips."""
+        and far fewer control-plane round trips. A put that landed in the
+        WAL before a controller kill resolves the RE-ISSUED wait (the
+        client re-arms on reconnect, internal_kv.kv_wait) immediately
+        from the recovered KV — this found-fast path IS the server-side
+        half of the re-arm protocol."""
         ns = body.get("ns", "")
         key = body["key"]
-        held = self.kv.get(ns, {})
+        held = self.kv.peek(ns)
         if key in held:
             return {"found": True, "value": held[key]}
         timeout = min(float(body.get("timeout", 30.0)), 30.0)
@@ -825,24 +1091,30 @@ class Controller:
     @replay_cached  # retry after a lost reply must still report existed=True
     async def rpc_kv_del(self, body) -> bool:
         self._mark_dirty()
-        existed = self.kv.get(body.get("ns", ""), {}).pop(
+        ns_name = body.get("ns", "")
+        shard = self.kv.shard_for(ns_name)
+        existed = shard.data.get(ns_name, {}).pop(
             body["key"], None) is not None
         if existed:
             # tombstone BEFORE the ack: without it, a crash after an
             # acked delete replays the earlier "kv" registration frame
-            # and resurrects the key (advisor r4, medium)
-            await self._wal_append("kv_del", (body.get("ns", ""),
-                                              body["key"]))
+            # and resurrects the key (advisor r4, medium); the reply
+            # rides the frame so a restart-straddling retry still
+            # reports existed=True
+            await self._wal_append("kv_del", (ns_name, body["key"]),
+                                   stream=shard.stream, lock=shard.lock,
+                                   reply=True)
         return existed
 
     @idempotent
     async def rpc_kv_exists(self, body) -> bool:
-        return body["key"] in self.kv.get(body.get("ns", ""), {})
+        return body["key"] in self.kv.peek(body.get("ns", ""))
 
     @idempotent
     async def rpc_kv_keys(self, body) -> list:
         prefix = body.get("prefix", "")
-        return [k for k in self.kv.get(body.get("ns", ""), {}) if k.startswith(prefix)]
+        return [k for k in self.kv.peek(body.get("ns", ""))
+                if k.startswith(prefix)]
 
     # ------------------------------------------------------------- actors
 
@@ -858,6 +1130,13 @@ class Controller:
         hexid = body["actor_id_hex"]
         name = body.get("name", "")
         namespace = body.get("namespace", "default")
+        if hexid in self.actors:
+            # Re-delivery of OUR OWN registration (actor ids are random
+            # per registration, so only a retry can collide): recovery
+            # re-derivation for the narrowest crash window where the
+            # durable replay entry is absent. Without this, the retry
+            # trips the name-conflict check below on ITSELF.
+            return {"ok": True}
         if name:
             existing_hex = self.named_actors.get((namespace, name))
             if existing_hex is not None:
@@ -882,7 +1161,9 @@ class Controller:
         if name:
             self.named_actors[(namespace, name)] = hexid
         self._mark_dirty()
-        await self._wal_append("actor", rec)  # ack implies durability
+        # ack implies durability; the reply rides the SAME frame so a
+        # retry straddling a controller restart replays from the cache
+        await self._wal_append("actor", rec, reply={"ok": True})
         chaos.maybe_crash("ctrl.actor_register")  # after WAL, before ack
         self.events.emit("ACTOR_REGISTERED",
                          f"actor {hexid[:8]} ({rec.class_name})",
@@ -902,6 +1183,18 @@ class Controller:
         rec.node_id_hex = body.get("node_id_hex", "")
         rec.incarnation += 1
         self._mark_dirty()
+        # the ALIVE transition used to be interval-snapshot soft state: a
+        # controller kill inside the window left a recovered record
+        # PENDING forever (no node_id_hex -> reconcile skipped it) while
+        # the actor ran. Durable before the ack, like every transition a
+        # peer acts on; the frame's replay key stops a restart-straddling
+        # retry from double-incrementing the incarnation (handle seqno
+        # reset semantics ride it).
+        await self._wal_append(
+            "actor_ready",
+            (rec.actor_id_hex, list(rec.address), rec.worker_id_hex,
+             rec.node_id_hex, rec.incarnation),
+            reply=None)
         await self._publish(
             "actor:" + rec.actor_id_hex,
             {
@@ -990,8 +1283,12 @@ class Controller:
         self._mark_dirty()
         # tombstone: a crash between the kill and the next snapshot must
         # not replay the registration frame and resurrect the actor —
-        # named_actors would rebind to a dead record (advisor r4, medium)
-        await self._wal_append("actor_dead", (rec.actor_id_hex, reason))
+        # named_actors would rebind to a dead record (advisor r4, medium).
+        # When a replay-cached RPC (actor_kill/worker_died/creation_failed)
+        # drove us here, its replay key rides the tombstone so the death
+        # fan-out can never run twice across a controller restart.
+        await self._wal_append("actor_dead", (rec.actor_id_hex, reason),
+                               reply=None)
         self.events.emit("ACTOR_DEAD",
                          f"actor {rec.actor_id_hex[:8]}: {reason}",
                          severity="WARNING", actor_id=rec.actor_id_hex,
@@ -1075,6 +1372,15 @@ class Controller:
 
     @replay_cached  # re-execution re-places a created group from scratch
     async def rpc_pg_create(self, body) -> dict:
+        existing = self.pgs.get(body["pg_id_hex"])
+        if existing is not None:
+            # re-delivery of our own registration (ids are random per
+            # create) after a controller restart dropped the in-memory
+            # replay entry: answer with current state, never re-place —
+            # re-reserving bundles for a CREATED group would double-count
+            # its resources on every assigned node
+            return {"state": existing.state,
+                    "assignment": existing.assignment}
         pg = PGRecord(
             pg_id_hex=body["pg_id_hex"],
             bundles=body["bundles"],
@@ -1100,7 +1406,7 @@ class Controller:
         waiters and then reaps the key — it is terminal, wait() re-checks
         pg_get on every wake anyway, and keeping it would grow the KV by
         one entry per PG ever removed."""
-        ns = self.kv.setdefault("pg", {})
+        ns = self.kv.namespace("pg")
         if state is None:
             ns.pop(pg_id_hex, None)
         elif state == PG_REMOVED:
@@ -1205,18 +1511,23 @@ class Controller:
         self._next_job_int += 1
         issued = self._next_job_int
         self._mark_dirty()
-        await self._wal_append("job_int", issued)  # never reissue on crash
+        # never reissue on crash; the reply rides the frame so a retry
+        # straddling a restart gets the ORIGINAL number from the cache
+        await self._wal_append("job_int", issued, reply=issued)
         return issued
 
     @replay_cached  # keeps start_time stable and the WAL free of dup frames
     async def rpc_job_register(self, body) -> None:
+        if body["job_id_hex"] in self.jobs:
+            return  # restart-straddling re-delivery: keep start_time
         self.jobs[body["job_id_hex"]] = JobRecord(
             job_id_hex=body["job_id_hex"],
             driver_address=tuple(body["driver_address"]) if body.get("driver_address") else None,
             start_time=time.time(),
         )
         self._mark_dirty()
-        await self._wal_append("job", self.jobs[body["job_id_hex"]])
+        await self._wal_append("job", self.jobs[body["job_id_hex"]],
+                               reply=None)
         self.events.emit("JOB_STARTED", f"job {body['job_id_hex'][:8]}",
                          job_id=body["job_id_hex"])
 
@@ -1325,6 +1636,36 @@ class Controller:
     @idempotent
     async def rpc_ping(self, body=None) -> str:
         return "pong"
+
+    @idempotent  # pure placement decision: a redirect, never a grant
+    async def rpc_request_lease(self, body) -> dict:
+        """Controller-mediated lease PLACEMENT — the spillover/entry path
+        only, never the steady state. A supervisor-less driver (client
+        mode) or an exhausted spillback chain asks the controller to pick
+        a node from its authoritative table; the answer is always a
+        ``retry_at`` redirect to that node's supervisor, which grants from
+        its own pool. Leases therefore stay node state the controller
+        never has to recover, and the common case — owner on a node with
+        capacity — leases node-locally without touching this handler
+        (counter-proven via ray_tpu_rpc_server_requests_total in
+        tests/test_controller_ha.py)."""
+        from ray_tpu._private.scheduling import pick_node
+        from ray_tpu._private.task_spec import TaskSpec  # noqa: F401
+
+        spec = serialization.loads(body["spec"])
+        views = [r.view() for r in self.nodes.values() if r.alive]
+        if not views:
+            return {"granted": False, "error": "no alive nodes"}
+        node = pick_node(
+            views, spec.required_resources(), spec.strategy,
+            spread_threshold=self.config.scheduler_spread_threshold)
+        if node is None:
+            # nothing fits NOW: hand it to a supervisor anyway — it parks
+            # the lease as infeasible and advertises the demand to the
+            # autoscaler (a flat rejection here would lose that signal)
+            node = views[0]
+        return {"granted": False, "retry_at": node.address,
+                "hops": int(body.get("hops", 0))}
 
     @idempotent
     async def rpc_autoscaler_state(self, body=None) -> dict:

@@ -365,6 +365,11 @@ class CoreWorker:
         self._actor_submit_locks: Dict[str, asyncio.Lock] = {}
         self._actor_events: Dict[str, asyncio.Event] = {}
         self._pub_handlers: Dict[str, List[Callable]] = {}
+        # every channel this process subscribed on the controller: the
+        # controller's subscriber sets are soft state, so a reconnect to
+        # a (possibly restarted) controller re-issues the whole set —
+        # actor-death/node-death fan-out must survive a controller kill
+        self._subscribed_channels: set = set()
         # (node_id_hex, supervisor_addr) callbacks run on node-death
         # fan-out BEFORE lease requeue — e.g. the collective transport
         # poisons ring waits on peers of the dead node
@@ -409,19 +414,75 @@ class CoreWorker:
             retry_base_s=self.config.rpc_retry_interval_ms / 1000.0,
         )
         addr = await self.server.start()
+        self.address = addr
         if self.supervisor_addr is not None:
             info = await self.clients.get(self.supervisor_addr).call("node_info")
             self.node_id_hex = info["node_id_hex"]
             self.arena = ArenaFile(info["arena_path"], info["arena_size"])
+        # a re-established controller connection may be a RESTARTED
+        # controller whose subscriber sets are empty: re-subscribe
+        # event-driven (no polling; a mere TCP blip re-adds set entries)
+        self.clients.get(self.controller_addr).add_reconnect_hook(
+            self._resubscribe_channels)
         # node-death fan-out: a killed supervisor cannot send worker_failed
         # for its workers, so owners learn about lost leases from the
         # controller's "nodes" channel instead (see _on_node_dead)
         try:
-            await self.clients.get(self.controller_addr).call(
-                "subscribe", {"channel": "nodes", "address": addr}, timeout=10)
+            await self._subscribe_channel("nodes")
         except Exception:
             logger.debug("nodes-channel subscribe failed", exc_info=True)
         return addr
+
+    async def _controller_call(self, method: str, body=None,
+                               timeout: Optional[float] = None):
+        """Controller round trip that rides out a kill + restart window.
+
+        Task-critical paths (actor-alive refresh, PG readiness polls)
+        used to issue bare calls: a controller outage surfaced as a
+        connection error that FAILED the task, even though the data
+        plane and the actor were healthy. retry_call shares one
+        (client_id, msg_id) across attempts, so this is exactly-once
+        safe for every handler class."""
+        return await retry_call(
+            self.clients.get(self.controller_addr), method, body,
+            timeout=(timeout if timeout is not None
+                     else self.config.controller_reconnect_budget_s),
+            per_call_timeout=5,
+            base_interval_s=self.config.rpc_retry_interval_ms / 1000.0,
+        )
+
+    async def _subscribe_channel(self, channel: str) -> None:
+        self._subscribed_channels.add(channel)
+        # reconnect-budgeted (subscribe is @idempotent): an actor
+        # creation whose register ack just straddled a controller kill
+        # must not fail on the follow-up channel subscribe
+        await self._controller_call(
+            "subscribe", {"channel": channel, "address": self.address})
+
+    async def _resubscribe_channels(self) -> None:
+        """RpcClient reconnect hook: re-arm every subscription on the
+        (possibly restarted) controller so pubsub fan-out — actor death,
+        node death, worker logs — keeps reaching this process after a
+        controller kill + restart. "nodes" goes FIRST (node-death
+        fan-out is the subscription whose loss strands owners) and the
+        rest re-arm concurrently, so a process with many live actor
+        channels does not serialize the critical one behind them."""
+        async def one(channel: str) -> None:
+            try:
+                await self.clients.get(self.controller_addr).call(
+                    "subscribe",
+                    {"channel": channel, "address": self.address},
+                    timeout=10)
+            except Exception:
+                logger.debug("re-subscribe of %r failed", channel,
+                             exc_info=True)
+
+        channels = list(self._subscribed_channels)
+        if "nodes" in channels:
+            channels.remove("nodes")
+            await one("nodes")
+        if channels:
+            await asyncio.gather(*(one(c) for c in channels))
 
     def shutdown(self) -> None:
         if self._shutdown:
@@ -517,9 +578,12 @@ class CoreWorker:
     def _register_function(self, key: str, blob: bytes) -> None:
         if key in self._fn_registered:
             return
+        # reconnect-budgeted: a first-submission racing a controller
+        # restart must not fail the task over the function-table write
         self._run(
-            self.clients.get(self.controller_addr).call(
-                "kv_put", {"ns": "fn", "key": key, "value": blob, "overwrite": False}
+            self._controller_call(
+                "kv_put",
+                {"ns": "fn", "key": key, "value": blob, "overwrite": False}
             )
         )
         self._fn_registered.add(key)
@@ -529,9 +593,7 @@ class CoreWorker:
         fn = self._fn_cache.get(key)
         if fn is None:
             blob = self._run(
-                self.clients.get(self.controller_addr).call(
-                    "kv_get", {"ns": "fn", "key": key}
-                )
+                self._controller_call("kv_get", {"ns": "fn", "key": key})
             )
             if blob is None:
                 raise KeyError(f"function {key} not in function table")
@@ -784,7 +846,7 @@ class CoreWorker:
             # arbitrary alive node would reject it terminally. _lease_target
             # already waits out re-placement of the group.
             return usual
-        views = await self.clients.get(self.controller_addr).call("node_views")
+        views = await self._controller_call("node_views")
         alive = {tuple(v["address"]) for v in views if v["alive"]}
         if usual in alive and usual != tuple(exclude or ()):
             return usual
@@ -800,7 +862,7 @@ class CoreWorker:
             # once bundles reserve). REMOVED is terminal.
             delay = 0.05
             while True:
-                pg = await self.clients.get(self.controller_addr).call(
+                pg = await self._controller_call(
                     "pg_get", {"pg_id_hex": spec.strategy.pg_id_hex}
                 )
                 if pg is None or pg["state"] == "REMOVED":
@@ -814,18 +876,21 @@ class CoreWorker:
                 index = 0
                 spec.strategy.bundle_index = 0
             node_hex = pg["assignment"][index]
-            views = await self.clients.get(self.controller_addr).call("node_views")
+            views = await self._controller_call("node_views")
             for v in views:
                 if v["node_id_hex"] == node_hex:
                     return tuple(v["address"])
             raise RuntimeError("placement group node not found")
         if self.supervisor_addr is not None:
+            # the common case: lease node-locally from the owner's own
+            # supervisor — the controller is NOT on the per-task path
+            # (counter-proven in tests/test_controller_ha.py)
             return self.supervisor_addr
-        views = await self.clients.get(self.controller_addr).call("node_views")
-        alive = [v for v in views if v["alive"]]
-        if not alive:
-            raise RuntimeError("no alive nodes")
-        return tuple(alive[0]["address"])
+        # supervisor-less driver (client mode): the controller places the
+        # first hop from its authoritative node table (its request_lease
+        # always answers with a retry_at redirect; the GRANT still
+        # happens at that node's supervisor, so leases stay node state)
+        return self.controller_addr
 
     async def _push(self, task: _PendingTask, lease: _Lease) -> None:
         spec = task.spec
@@ -1432,11 +1497,7 @@ class CoreWorker:
 
     def subscribe(self, channel: str, handler: Callable) -> None:
         self._pub_handlers.setdefault(channel, []).append(handler)
-        self._run(
-            self.clients.get(self.controller_addr).call(
-                "subscribe", {"channel": channel, "address": self.address}
-            )
-        )
+        self._run(self._subscribe_channel(channel))
 
     def unsubscribe(self, channel: str, handler: Callable) -> None:
         """Drop a handler registered via subscribe(). Local-only: the
@@ -2147,7 +2208,12 @@ class CoreWorker:
         self, spec: TaskSpec, name: str, namespace: str, detached: bool, class_name: str
     ) -> None:
         hexid = spec.actor_id.hex()
-        await self.clients.get(self.controller_addr).call(
+        # reconnect-budgeted: one (client_id, msg_id) across attempts, so
+        # the registration rides out a controller kill + restart window —
+        # the controller's WAL-embedded replay entry answers the resend
+        # from cache instead of double-applying (or name-conflicting on
+        # itself)
+        await self._controller_call(
             "actor_register",
             {
                 "actor_id_hex": hexid,
@@ -2163,9 +2229,7 @@ class CoreWorker:
         )
         state = ActorHandleState(spec.actor_id, caller_id=os.urandom(8).hex())
         self._actor_states[hexid] = state
-        await self.clients.get(self.controller_addr).call(
-            "subscribe", {"channel": "actor:" + hexid, "address": self.address}
-        )
+        await self._subscribe_channel("actor:" + hexid)
         for oid in spec.return_ids():
             self._ensure_entry(oid)
         pending = _PendingTask(spec, retries_left=0)
@@ -2225,6 +2289,11 @@ class CoreWorker:
             state.dead = True
             state.death_reason = message.get("reason", "")
             state.address = None
+            # terminal: drop the channel from the reconnect re-subscribe
+            # set, or a long-lived driver accretes one entry per actor
+            # EVER created and replays them all after every controller
+            # restart
+            self._subscribed_channels.discard("actor:" + actor_hex)
             self._fail_inflight_actor_tasks(actor_hex, restarting=False)
         ev = self._actor_events.get(actor_hex)
         if ev is not None:
@@ -2270,9 +2339,7 @@ class CoreWorker:
         if state is None:
             state = ActorHandleState(actor_id, caller_id=os.urandom(8).hex())
             self._actor_states[hexid] = state
-            await self.clients.get(self.controller_addr).call(
-                "subscribe", {"channel": "actor:" + hexid, "address": self.address}
-            )
+            await self._subscribe_channel("actor:" + hexid)
         return state
 
     def submit_actor_task(
@@ -2421,8 +2488,10 @@ class CoreWorker:
                 return
             except (RpcConnectionError, RpcTimeoutError, RemoteError) as push_err:
                 _trace(f"actor_push error {spec.name}: {push_err!r}")
-                # actor may be restarting; refresh state from the controller
-                rec = await self.clients.get(self.controller_addr).call(
+                # actor may be restarting; refresh state from the
+                # controller — riding out a controller restart window
+                # (a transient controller outage must not fail the task)
+                rec = await self._controller_call(
                     "actor_get", {"actor_id_hex": spec.actor_id.hex()}
                 )
                 if rec is None or rec["state"] == "DEAD":
@@ -2467,7 +2536,8 @@ class CoreWorker:
             self._actor_events[hexid] = ev
         ev.clear()
         # double-check via controller in case we missed the publish
-        rec = await self.clients.get(self.controller_addr).call(
+        # (retry-budgeted: must survive a controller restart window)
+        rec = await self._controller_call(
             "actor_get", {"actor_id_hex": hexid}
         )
         if rec is not None:

@@ -63,8 +63,18 @@ def kv_wait(key: str, timeout: float = 30.0, *, ns: str = "default") -> Any:
     """Long-poll for ``key``: returns its value as soon as it exists
     (possibly immediately), raises TimeoutError after ``timeout`` seconds.
     ONE parked RPC per ~30 s slice replaces client-side sleep-and-repoll
-    loops on the control plane (collective rendezvous, PG readiness)."""
+    loops on the control plane (collective rendezvous, PG readiness).
+
+    Controller-restart safe: a wait parked on a controller that is then
+    killed fails with the severed connection — the client RE-ISSUES the
+    wait under the SAME deadline budget instead of hanging or surfacing a
+    spurious error. A put that landed in the controller's WAL before the
+    kill resolves the re-issued wait immediately from the recovered KV
+    (the server-side found-fast path); a put after recovery resolves it
+    through ``_kv_notify`` as usual."""
     import time
+
+    from ray_tpu._private.rpc import RpcConnectionError, RpcTimeoutError
 
     core = _core()
     deadline = time.monotonic() + timeout
@@ -75,12 +85,23 @@ def kv_wait(key: str, timeout: float = 30.0, *, ns: str = "default") -> Any:
                 f"kv_wait: key {key!r} (ns={ns!r}) did not appear within "
                 f"{timeout}s")
         slice_s = min(remaining, 30.0)
-        reply = core._run(
-            core.clients.get(core.controller_addr).call(
-                "kv_wait", {"ns": ns, "key": key, "timeout": slice_s},
-                timeout=slice_s + core.config.rpc_request_timeout_s,
+        try:
+            reply = core._run(
+                core.clients.get(core.controller_addr).call(
+                    "kv_wait", {"ns": ns, "key": key, "timeout": slice_s},
+                    timeout=slice_s + core.config.rpc_request_timeout_s,
+                )
             )
-        )
+        except (RpcConnectionError, RpcTimeoutError):
+            if deadline - time.monotonic() <= 0.2:
+                raise TimeoutError(
+                    f"kv_wait: key {key!r} (ns={ns!r}) did not appear "
+                    f"within {timeout}s (controller unreachable at the "
+                    f"deadline)") from None
+            # controller died mid-park: re-arm after a beat (the re-issued
+            # call's connect path patiently waits out the restart window)
+            time.sleep(0.2)
+            continue
         if reply.get("found"):
             return reply["value"]
 

@@ -39,6 +39,7 @@ Fault tolerance (what gRPC + the GCS managers give the reference, rebuilt):
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import inspect
 import logging
 import os
@@ -48,7 +49,7 @@ import socket
 import struct
 import time
 from collections import OrderedDict
-from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
+from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu._private import serialization
 from ray_tpu._private.chaos import fault_controller
@@ -63,6 +64,28 @@ logger = logging.getLogger(__name__)
 _m_client_calls = Counter(
     "ray_tpu_rpc_client_calls_total",
     "Outbound RPC calls issued by this process (call + notify), by method")
+
+# every request this process's server DISPATCHES (replay-cache hits
+# included), by method: the serve-side twin of the client counter. The
+# controller-HA suite scrapes the controller's series to prove the
+# steady task loop leases node-locally (0 controller request_lease).
+_m_server_requests = Counter(
+    "ray_tpu_rpc_server_requests_total",
+    "Requests dispatched by this process's RPC server, by method")
+
+# the (client_id, msg_id) replay key of the request currently being
+# dispatched, visible to replay-cached handlers: the controller embeds
+# it (plus the reply) in the SAME WAL frame as the mutation, making
+# exactly-once durable across its own restart — one frame, no window
+# between "applied" and "reply cached" for a crash to split.
+_current_replay_key: contextvars.ContextVar = contextvars.ContextVar(
+    "rpc_replay_key", default=None)
+
+
+def current_replay_key() -> Optional[Tuple[bytes, int, str]]:
+    """(client_id, msg_id, method) of the in-flight replay-cached request,
+    or None outside such a dispatch."""
+    return _current_replay_key.get()
 
 _LEN = struct.Struct("<I")
 REQUEST, REPLY, ERROR, ONEWAY = 0, 1, 2, 3
@@ -243,6 +266,8 @@ class RpcServer:
                     drop_reply)
             return
 
+        if kind == REQUEST:
+            _m_server_requests.inc(labels={"method": method})
         key = None
         if kind == REQUEST and client_id is not None \
                 and method in self._replay_methods:
@@ -258,6 +283,11 @@ class RpcServer:
             self._replay_cache[key] = asyncio.get_running_loop().create_future()
 
         payload = None
+        token = None
+        if key is not None:
+            # replay-cached handlers may fold this key into their durable
+            # mutation record (controller WAL) for restart-proof dedupe
+            token = _current_replay_key.set((client_id, msg_id, method))
         try:
             sig_args = (body, peer) if _wants_peer(handler) else (body,)
             result = handler(*sig_args)
@@ -269,6 +299,9 @@ class RpcServer:
             logger.debug("handler %s raised", method, exc_info=True)
             if kind == REQUEST:
                 payload = self._encode_reply(ERROR, msg_id, method, e)
+        finally:
+            if token is not None:
+                _current_replay_key.reset(token)
         if key is not None:
             self._finish_replay(key, payload)
         if payload is not None:
@@ -280,6 +313,37 @@ class RpcServer:
         except Exception:
             # unpicklable result/exception: degrade to its repr
             return serialization.dumps((ERROR, msg_id, method, repr(body)))
+
+    def seed_replay(self, client_id: bytes, msg_id: int, method: str,
+                    reply_value: Any) -> None:
+        """Install a COMPLETED reply for (client_id, msg_id) — recovery
+        seeding from WAL frames that embedded their replay key. A
+        PR-1-style retry straddling the server's restart is then answered
+        from the cache exactly like a same-incarnation redelivery."""
+        self.seed_replay_payload(
+            (client_id, msg_id),
+            self._encode_reply(REPLY, msg_id, method, reply_value))
+
+    def seed_replay_payload(self, key: Tuple[bytes, int],
+                            payload: bytes) -> None:
+        """Install a pre-encoded reply payload (snapshot-carried entries)."""
+        existing = self._replay_cache.get(key)
+        if isinstance(existing, asyncio.Future):
+            return  # a live dispatch owns this key; never clobber it
+        self._replay_cache[key] = payload
+        self._replay_cache.move_to_end(key)
+        excess = len(self._replay_cache) - REPLAY_CACHE_SIZE
+        if excess > 0:
+            for k in [k for k, v in self._replay_cache.items()
+                      if not isinstance(v, asyncio.Future)][:excess]:
+                del self._replay_cache[k]
+
+    def export_replay(self) -> List[Tuple[bytes, int, bytes]]:
+        """Completed replay entries as (client_id, msg_id, payload) — the
+        snapshot's carry so compaction (which sweeps the WAL frames that
+        embedded them) does not reopen the exactly-once window."""
+        return [(k[0], k[1], v) for k, v in self._replay_cache.items()
+                if not isinstance(v, asyncio.Future)]
 
     def _finish_replay(self, key, payload: bytes) -> None:
         fut = self._replay_cache.get(key)
@@ -351,6 +415,13 @@ class RpcClient:
         self._lock = asyncio.Lock()
         self._read_task: Optional[asyncio.Task] = None
         self._closed = False
+        self._ever_connected = False
+        # fired (as tasks) after a RE-connect — i.e. the peer process may
+        # have restarted and lost its soft state. The controller-restart
+        # protocol hangs off this: core workers re-subscribe their pubsub
+        # channels here, event-driven, with zero steady-state polling.
+        self._reconnect_hooks: List[Callable[[], Any]] = []
+        self._eager_task: Optional[asyncio.Task] = None
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -387,7 +458,33 @@ class RpcClient:
                         ) from e
                     await asyncio.sleep(delay)
                     delay = min(delay * 2, 1.0)
-            self._read_task = asyncio.get_running_loop().create_task(self._read_loop())
+            loop = asyncio.get_running_loop()
+            self._read_task = loop.create_task(self._read_loop())
+            reconnected = self._ever_connected
+            self._ever_connected = True
+            if reconnected:
+                # the peer was reachable before and the connection is
+                # fresh: it may be a restarted incarnation with empty
+                # soft state — let interested layers re-establish theirs
+                # (idempotent re-subscribes; a mere TCP blip re-adds the
+                # same set entries). Run as tasks: a hook that RPCs back
+                # through this client must not re-enter under our lock.
+                for hook in list(self._reconnect_hooks):
+                    loop.create_task(self._run_reconnect_hook(hook))
+
+    @staticmethod
+    async def _run_reconnect_hook(hook: Callable[[], Any]) -> None:
+        try:
+            result = hook()
+            if inspect.isawaitable(result):
+                await result
+        except Exception:
+            logger.debug("reconnect hook failed", exc_info=True)
+
+    def add_reconnect_hook(self, hook: Callable[[], Any]) -> None:
+        """Register a callback (sync or async) fired after every
+        re-established connection to this peer."""
+        self._reconnect_hooks.append(hook)
 
     async def _read_loop(self) -> None:
         reader = self._reader
@@ -420,6 +517,37 @@ class RpcClient:
                     pass
             self._writer = None
             self._reader = None
+            if self._reconnect_hooks and not self._closed \
+                    and self._eager_task is None:
+                # a hook-bearing client (a core worker watching the
+                # controller) reconnects EAGERLY: an idle process — the
+                # zero-RPC steady state — would otherwise never re-issue
+                # its subscriptions after a controller restart and
+                # silently miss actor/node death fan-out. One bounded
+                # backoff loop per outage; nothing periodic at steady
+                # state.
+                try:
+                    self._eager_task = asyncio.get_running_loop(
+                    ).create_task(self._eager_reconnect())
+                except RuntimeError:
+                    pass
+
+    async def _eager_reconnect(self) -> None:
+        delay = 0.5
+        try:
+            while not self._closed:
+                await asyncio.sleep(delay)
+                if self._writer is not None \
+                        and not self._writer.is_closing():
+                    return  # a concurrent call already reconnected
+                try:
+                    # success fires the reconnect hooks from inside
+                    await self._ensure_connected(one_shot=True)
+                    return
+                except RpcConnectionError:
+                    delay = min(delay * 2, 5.0)
+        finally:
+            self._eager_task = None
 
     def reserve_msg_id(self) -> int:
         """Pre-allocate a request id so several call() attempts can share one
@@ -559,6 +687,8 @@ class RpcClient:
         self._closed = True
         if self._read_task is not None:
             self._read_task.cancel()
+        if self._eager_task is not None:
+            self._eager_task.cancel()
         if self._writer is not None:
             try:
                 self._writer.close()

@@ -243,6 +243,10 @@ class Supervisor:
         # has its cross-node pull pins force-released (its pulls died
         # with it)
         self._alive_node_hexes: Set[str] = set()
+        # first time each known node went MISSING from the synced view
+        # (distinct from present-but-dead): drives the recovery-window
+        # debounce in _sync_loop
+        self._node_missing_since: Dict[str, float] = {}
         # pin-holding clients that are neither our workers nor nodes
         # (drivers attached to this cluster): last known RPC address and
         # consecutive probe failures, for the liveness sweep that
@@ -430,19 +434,27 @@ class Supervisor:
                 )
                 if isinstance(sync_resp, dict) and sync_resp.get("unknown_node"):
                     # controller restarted (recovered from snapshot, node
-                    # table empty): re-register with current state
-                    await ctrl.call(
-                        "node_register",
-                        {
-                            "node_id_hex": self.node_id.hex(),
-                            "address": self.server.address,
-                            "total": dict(self.total),
-                            "available": dict(self.available),
-                            "labels": {**self.labels,
-                                       "node_name": self.node_name},
-                        },
-                        timeout=5,
-                    )
+                    # table empty): re-register with current state — the
+                    # supervisor-side half of the recovery protocol, so
+                    # it gets its own span on the merged flight timeline
+                    from ray_tpu._private import flight
+
+                    with flight.span("sup.reregister"):
+                        await ctrl.call(
+                            "node_register",
+                            {
+                                "node_id_hex": self.node_id.hex(),
+                                "address": self.server.address,
+                                "total": dict(self.total),
+                                "available": dict(self.available),
+                                "labels": {**self.labels,
+                                           "node_name": self.node_name},
+                            },
+                            timeout=5,
+                        )
+                    logger.warning(
+                        "controller restarted: node %s re-registered",
+                        self.node_id.hex()[:8])
                 views = await ctrl.call("node_views", timeout=5)
                 self.cluster_view = [
                     NodeView(
@@ -458,21 +470,65 @@ class Supervisor:
                 self._reevaluate_infeasible()
                 self._reevaluate_queued()
                 # a dead node's in-flight pulls pinned objects here under
-                # "node:<hex>" — reclaim them so spill/free unblock
+                # "node:<hex>" — reclaim them so spill/free unblock.
+                # "Dead" must be read carefully: a node PRESENT in the
+                # view with alive=False died authoritatively (health
+                # loop / drain) and reaps immediately; a node MISSING
+                # from the view entirely is indeterminate — a freshly
+                # RESTARTED controller serves an empty node table until
+                # peers re-register, and reaping on that first sync used
+                # to close healthy cross-node channels mid-recovery.
+                # Missing nodes are debounced by the health grace window
+                # before their pins/channels are swept (so a node that
+                # truly never returns after a controller outage still
+                # gets the dead-client sweep).
                 alive_now = {v.node_id_hex for v in self.cluster_view
                              if v.alive}
-                for gone in self._alive_node_hexes - alive_now:
-                    if gone != self.node_id.hex():
-                        await self._release_dead_client_pins(
-                            f"node:{gone}", "node")
+                dead_now = {v.node_id_hex for v in self.cluster_view
+                            if not v.alive}
                 for back in alive_now - self._alive_node_hexes:
                     # a flapped node re-registered: let its pulls pin
                     # again (fresh pins; the released ones stay released)
                     self._released_clients.pop(f"node:{back}", None)
-                self._alive_node_hexes = alive_now
+                for gone in self._node_liveness_reap(
+                        alive_now, dead_now, time.monotonic()):
+                    await self._release_dead_client_pins(
+                        f"node:{gone}", "node")
             except Exception as e:
                 logger.debug("sync failed: %s", e)
             await asyncio.sleep(0.2)
+
+    def _node_liveness_reap(self, alive_now: Set[str], dead_now: Set[str],
+                            now: float) -> Set[str]:
+        """Which previously-alive nodes to sweep this sync tick.
+
+        A node PRESENT in the view with alive=False died authoritatively
+        (health loop / drain): reap immediately. A node MISSING from the
+        view entirely is indeterminate — a freshly RESTARTED controller
+        serves an empty node table until peers re-register, and reaping
+        on that first sync closed healthy cross-node channels
+        mid-recovery — so missing nodes are debounced by the health
+        grace window (a node that truly never returns after a controller
+        outage still gets the dead-client sweep). Updates
+        ``_alive_node_hexes`` / ``_node_missing_since``."""
+        grace = self.config.recovery_grace_s()
+        to_reap: Set[str] = set()
+        for gone in self._alive_node_hexes - alive_now:
+            if gone == self.node_id.hex():
+                continue
+            if gone in dead_now:
+                to_reap.add(gone)
+                continue
+            first = self._node_missing_since.setdefault(gone, now)
+            if now - first > grace:
+                to_reap.add(gone)
+        for back in alive_now:
+            self._node_missing_since.pop(back, None)
+        for gone in to_reap:
+            self._node_missing_since.pop(gone, None)
+        self._alive_node_hexes = (
+            (self._alive_node_hexes | alive_now) - to_reap - dead_now)
+        return to_reap
 
     def _try_spill(self, q: _QueuedLease, candidates: List[NodeView]) -> bool:
         """Redirect a queued lease to a remote node if policy picks one.

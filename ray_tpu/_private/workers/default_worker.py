@@ -578,7 +578,14 @@ class Executor:
                 asyncio.get_running_loop().call_later(0.1, requeue)
 
     async def _notify_actor_ready(self, spec: TaskSpec) -> None:
-        await self.core.clients.get(self.core.controller_addr).call(
+        # reconnect-budgeted: the actor CONSTRUCTED — a controller kill +
+        # restart window must not fail the creation over the lost ALIVE
+        # report. _controller_call shares one (client_id, msg_id) across
+        # attempts, and the handler's WAL frame carries that replay key,
+        # so a resend that straddles the restart can never
+        # double-increment the incarnation (handle seqno reset semantics
+        # ride it).
+        await self.core._controller_call(
             "actor_ready",
             {
                 "actor_id_hex": spec.actor_id.hex(),

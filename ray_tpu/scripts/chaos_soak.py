@@ -102,6 +102,7 @@ def run_chaos_workload(
     delay_max_ms: int = 20,
     kills: bool = True,
     train: bool = True,
+    controller_restart: bool = False,
 ) -> None:
     """One seeded chaos run. Raises AssertionError / propagates any failure.
 
@@ -201,6 +202,15 @@ def run_chaos_workload(
             cluster.remove_node(doomed)  # supervisor kill mid-run
             cluster.add_node(num_cpus=2, resources={"doomed": 100})
             cluster.wait_for_nodes(2)
+
+        if controller_restart:
+            # controller SIGKILL + restart with tasks/actor calls in
+            # flight (the default sweep's controller-HA coverage; the
+            # dedicated --controller mode attacks the tentpole
+            # workloads): recovery from WAL+snapshot, supervisors
+            # re-register, every in-flight result below must stay exact
+            cluster.restart_controller()
+            cluster.wait_for_nodes(2, timeout=60)
 
         # compiled-graph channels under the same schedule: a 2-stage
         # cross-node pipeline (stable -> replacement node) whose per-step
@@ -989,11 +999,429 @@ def run_podracer_chaos(
         chaos.reset()
 
 
+def _drain_pins_to_baseline(pins_before: int) -> None:
+    """Shared tail of every channel-workload scenario: wait for the
+    driver's channel pins to return to baseline, falling back to the
+    departing-driver bulk release (the release RPCs run under the same
+    fault schedule, so a dropped unpin must not fail the seed)."""
+    from ray_tpu._private import api as _api
+
+    def store_pins():
+        core = _api._core
+        stats = core._run(core.clients.get(core.supervisor_addr).call(
+            "store_stats", timeout=60))
+        return stats["pins_total"]
+
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and store_pins() != pins_before:
+        time.sleep(0.3)
+    if store_pins() != pins_before:
+        core = _api._core
+        for _ in range(3):
+            try:
+                core._run(core.clients.get(core.supervisor_addr).call(
+                    "store_release_client",
+                    {"client": core._store_client_id}, timeout=10))
+                break
+            except Exception:
+                continue
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and store_pins() != pins_before:
+            time.sleep(0.3)
+    assert store_pins() == pins_before, (
+        "channel pins did not return to baseline after the controller "
+        "restart scenario")
+
+
+# outbound methods a stage/runner/learner WORKER may move during a
+# controller outage: the p2p mirror-push stream (worker -> remote
+# supervisor, the data plane itself) plus the recovery re-subscribe.
+# Everything else — leases, task pushes/completions, kv, actor ops,
+# object-store traffic — must stay at ZERO: the step in flight neither
+# touched the (dead) controller nor fell back off the channel substrate.
+_OUTAGE_ALLOWED_WORKER_METHODS = frozenset({
+    "channel_push", "channel_write_chunk", "channel_commit",
+    "collective_chunk",  # cross-node ring broadcast: worker <-> worker
+    "subscribe",
+})
+
+
+def _worker_method_deltas(cluster):
+    """Per-(worker, method) outbound rpc-call totals, scraped through each
+    supervisor's metrics_all relay (no controller round trip — usable
+    while it is down or freshly restarted)."""
+    import asyncio as _asyncio
+    import re as _re
+
+    from ray_tpu._private.rpc import RpcClient
+
+    async def scrape():
+        found = {}
+        for node in cluster.nodes:
+            client = RpcClient(node.address)
+            try:
+                rows = await client.call("metrics_all", timeout=30)
+            finally:
+                await client.close()
+            for name, text in rows:
+                if not name.startswith("worker:"):
+                    continue  # supervisors legitimately gossip/re-register
+                for line in text.splitlines():
+                    m = _re.match(
+                        r'ray_tpu_rpc_client_calls_total\{'
+                        r'method="([^"]+)"\} ([0-9.e+-]+)', line)
+                    if m:
+                        found[(name, m.group(1))] = float(m.group(2))
+        return found
+
+    return _asyncio.run(scrape())
+
+
+def _assert_outage_deltas_clean(before: dict, after: dict) -> None:
+    moved = {k: after[k] - before.get(k, 0.0)
+             for k in after if after[k] - before.get(k, 0.0) > 0}
+    bad = {k: v for k, v in moved.items()
+           if k[1] not in _OUTAGE_ALLOWED_WORKER_METHODS}
+    assert not bad, (
+        f"workers issued control RPCs during the controller outage "
+        f"(the data plane is not controller-free): {bad}")
+
+
+def _restart_controller_mid(cluster, work, *, settle_s: float = 0.05,
+                            join_s: float = 300.0):
+    """Run ``work()`` in a thread and SIGKILL+restart the controller while
+    it is in flight. Returns work()'s result; re-raises its error."""
+    import threading
+
+    box = {}
+
+    def runner():
+        try:
+            box["out"] = work()
+        except Exception as e:  # noqa: BLE001 — re-raised below
+            box["err"] = e
+
+    t = threading.Thread(target=runner)
+    t.start()
+    time.sleep(settle_s)
+    cluster.restart_controller()
+    t.join(timeout=join_s)
+    assert not t.is_alive(), \
+        "in-flight workload hung across the controller restart"
+    cluster.wait_for_nodes(len(cluster.nodes), timeout=60)
+    if "err" in box:
+        raise box["err"]
+    return box.get("out")
+
+
+def _assert_cluster_recovered() -> None:
+    """Post-recovery: the control plane schedules FRESH work (leases,
+    worker spawns, actor registration all through the new incarnation)."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    def probe(x):
+        return x + 1
+
+    assert ray_tpu.get([probe.remote(i) for i in range(4)],
+                       timeout=120) == [1, 2, 3, 4]
+
+
+def _controller_chaos_pipeline(seed: int, cluster) -> None:
+    """Controller killed MID PIPELINE FLUSH: the compiled-graph stage
+    loops (cross-node chunked mirror pushes) must keep streaming through
+    the outage with 0 control-plane RPCs, and every flush's loss must
+    match the single-process reference exactly."""
+    import jax
+    import numpy as np
+    import optax
+
+    import ray_tpu
+    from ray_tpu.models import presets
+    from ray_tpu.models.transformer import init_params, loss_fn
+    from ray_tpu.train import PipelineTrainer
+
+    mcfg = presets.llama_debug(
+        num_layers=2, vocab_size=128, max_seq_len=32, embed_dim=32,
+        num_heads=2, num_kv_heads=1, mlp_dim=64)
+    batch = np.random.default_rng(0).integers(
+        0, 128, (16, 16)).astype(np.int32)
+    M = 4
+
+    params = init_params(mcfg, jax.random.PRNGKey(0))
+    opt = optax.sgd(0.05)
+    ost = opt.init(params)
+
+    def mb_loss(p, toks):
+        loss, _ = loss_fn(mcfg, p, {"tokens": toks})
+        return loss
+
+    gfn = jax.jit(jax.value_and_grad(mb_loss))
+    ref_losses = []
+    for _ in range(4):
+        acc, losses = None, []
+        for m in range(M):
+            loss, g = gfn(params, batch[m * 4:(m + 1) * 4])
+            losses.append(float(loss))
+            acc = g if acc is None else jax.tree.map(
+                lambda a, b: a + b, acc, g)
+        grads = jax.tree.map(lambda g: g / M, acc)
+        upd, ost = opt.update(grads, ost, params)
+        params = optax.apply_updates(params, upd)
+        ref_losses.append(float(np.mean(losses)))
+
+    from ray_tpu._private import api as _api
+
+    core = _api._core
+    pins_before = core._run(core.clients.get(core.supervisor_addr).call(
+        "store_stats", timeout=60))["pins_total"]
+    trainer = PipelineTrainer(
+        presets.pipeline_stage_defs(mcfg, 2, seed=0),
+        num_microbatches=M, optimizer=("sgd", 0.05),
+        stage_options=[{"resources": {"left": 1}},
+                       {"resources": {"right": 1}}])
+    assert trainer.is_channel_backed and trainer.channel_depth > 1, (
+        "controller chaos run is not on the slot-ring channel substrate")
+    try:
+        for step in range(2):  # warm flushes: jits built, zero-RPC steady
+            out = trainer.step(batch)
+            assert abs(out["loss"] - ref_losses[step]) < 1e-4, (
+                f"step {step}: loss {out['loss']} != {ref_losses[step]}")
+        before = _worker_method_deltas(cluster)
+        out = _restart_controller_mid(cluster,
+                                      lambda: trainer.step(batch))
+        assert abs(out["loss"] - ref_losses[2]) < 1e-4, (
+            f"outage flush corrupted: {out['loss']} != {ref_losses[2]}")
+        # 0 control RPCs through the outage: only the p2p mirror-push
+        # stream (and recovery re-subscribes) may have moved on any
+        # stage rank — no lease/task/kv/store/actor traffic
+        _assert_outage_deltas_clean(before, _worker_method_deltas(cluster))
+        out = trainer.step(batch)  # post-recovery flush
+        assert abs(out["loss"] - ref_losses[3]) < 1e-4, (
+            f"post-recovery flush corrupted: {out['loss']} != "
+            f"{ref_losses[3]}")
+    finally:
+        trainer.shutdown()
+    _drain_pins_to_baseline(pins_before)
+    _assert_cluster_recovered()
+
+
+def _controller_chaos_serve(seed: int, cluster) -> None:
+    """Controller killed MID SERVE LOADGEN: the continuous scheduler's
+    decode iterations run on the replica's own thread and the handle path
+    is direct actor pushes — a request burst STRADDLING the outage must
+    complete with outputs exactly equal to the pre-outage reference, and
+    the deployment must keep serving after recovery."""
+    import threading
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.llm import build_app
+
+    h = serve.run(build_app(max_new_tokens=6, num_replicas=1,
+                            slots=4, prefill_chunk=8),
+                  name="ctrlchaos", route_prefix="/ctrlchaos")
+    try:
+        solo = h.remote({"prompt": "hello 123"}).result(timeout=300)
+        assert solo["text"], "reference generation empty"
+
+        outs = [None] * 8
+        errs = []
+
+        def call(i):
+            try:
+                outs[i] = h.remote(
+                    {"prompt": "hello 123"}).result(timeout=300)
+            except Exception as e:  # noqa: BLE001 — asserted below
+                errs.append(e)
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(8)]
+
+        def burst():
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+
+        _restart_controller_mid(cluster, burst, settle_s=0.2,
+                                join_s=600.0)
+        assert not errs, f"requests failed across the outage: {errs[:2]}"
+        assert all(o is not None and o["text"] == solo["text"]
+                   for o in outs), (
+            "serve outputs diverged from the temperature-0 reference "
+            "across the controller outage")
+        st = h.scheduler_stats.remote().result(timeout=120)
+        assert st["mode"] == "continuous", st
+        assert st["retired"] >= 9, st  # every request decoded + retired
+        # post-recovery: the deployment still serves
+        again = h.remote({"prompt": "hello 123"}).result(timeout=300)
+        assert again["text"] == solo["text"]
+    finally:
+        serve.shutdown()
+    _assert_cluster_recovered()
+
+
+def _controller_chaos_sebulba(seed: int, cluster) -> None:
+    """Controller killed MID SEBULBA ITERATION: trajectory channels and
+    the device-to-device param broadcast never touch the controller, so
+    the iteration in flight must complete with the exact dynamic-loop
+    reference loss and 0 control RPCs on every rank."""
+    import ray_tpu
+    from ray_tpu.rllib import IMPALAConfig
+    from ray_tpu.rllib.algorithms.impala import IMPALA
+    from ray_tpu.rllib.podracer import (ImpalaSebulbaProgram,
+                                        SebulbaTopology)
+
+    def make_cfg(topology):
+        return (IMPALAConfig()
+                .environment("CartPole-v1")
+                .env_runners(num_env_runners=0 if topology == "dynamic"
+                             else 1,
+                             num_envs_per_env_runner=8,
+                             rollout_fragment_length=16)
+                .training(num_batches_per_iteration=1,
+                          broadcast_interval=1,
+                          model={"hiddens": (16,)})
+                .learners(topology=topology)
+                .debugging(seed=0))
+
+    ref_algo = make_cfg("dynamic").build()
+    try:
+        ref_losses = [ref_algo.train()["total_loss"] for _ in range(4)]
+    finally:
+        ref_algo.stop()
+
+    from ray_tpu._private import api as _api
+
+    core = _api._core
+    pins_before = core._run(core.clients.get(core.supervisor_addr).call(
+        "store_stats", timeout=60))["pins_total"]
+    config = make_cfg("sebulba")
+    spec = config.rl_module_spec()
+    program = ImpalaSebulbaProgram(
+        spec=spec, loss_fn=IMPALA.loss_fn,
+        loss_cfg={
+            "gamma": config.gamma,
+            "clip_rho": config.vtrace_clip_rho_threshold,
+            "clip_c": config.vtrace_clip_c_threshold,
+            "vf_loss_coeff": config.vf_loss_coeff,
+            "entropy_coeff": config.entropy_coeff,
+        },
+        opt_cfg={"lr": config.lr, "grad_clip": config.grad_clip},
+        broadcast_interval=1)
+    topo = SebulbaTopology(
+        config, program,
+        runner_options=[{"resources": {"left": 1}}],
+        learner_options=[{"resources": {"right": 1}}])
+    assert topo.is_channel_backed, (
+        "controller chaos run is not on the channel substrate")
+    try:
+        for step in range(2):  # warm: rendezvous, pins, jits
+            out = topo.step()
+            got = out["metrics"]["total_loss"]
+            assert abs(got - ref_losses[step]) < 1e-4, (
+                f"step {step}: loss {got} != {ref_losses[step]}")
+        before = _worker_method_deltas(cluster)
+        out = _restart_controller_mid(cluster, topo.step)
+        got = out["metrics"]["total_loss"]
+        assert abs(got - ref_losses[2]) < 1e-4, (
+            f"outage iteration corrupted: {got} != {ref_losses[2]}")
+        # 0 control RPCs through the outage on runner AND learner ranks:
+        # trajectory-channel pushes + the param broadcast's ring frames
+        # are worker<->worker, so only channel/push methods may move
+        _assert_outage_deltas_clean(before, _worker_method_deltas(cluster))
+        out = topo.step()  # post-recovery iteration
+        got = out["metrics"]["total_loss"]
+        assert abs(got - ref_losses[3]) < 1e-4, (
+            f"post-recovery iteration corrupted: {got} != "
+            f"{ref_losses[3]}")
+    finally:
+        topo.shutdown()
+    _drain_pins_to_baseline(pins_before)
+    _assert_cluster_recovered()
+
+
+def run_controller_chaos(
+    seed: int,
+    *,
+    drop_prob: float = 0.02,
+    dup_prob: float = 0.05,
+    delay_prob: float = 0.05,
+    delay_max_ms: int = 20,
+) -> None:
+    """One seeded controller-HA chaos run (ISSUE 12, ROADMAP item 1).
+
+    The controller is SIGKILLed and restarted from WAL+snapshot while a
+    tentpole workload is MID-FLIGHT — ``seed % 3`` picks which: a
+    pipeline flush (0), a serve request burst (1), or a Sebulba
+    iteration (2), so the default 0..2 sweep covers all three. The
+    drop/dup/delay schedule keeps attacking every control RPC
+    throughout, INCLUDING the recovery handshake (node_register /
+    node_sync / kv_put re-registrations). Required end state: the
+    zero-RPC data plane streamed through the outage (in-band rpc-counter
+    deltas stay 0 on every rank), post-recovery outputs/losses are
+    EXACT, channel pins return to baseline, and the recovered control
+    plane schedules fresh work.
+    """
+    import ray_tpu
+    from ray_tpu._private import chaos
+    from ray_tpu._private.chaos import FaultController
+    from ray_tpu._private.config import Config
+    from ray_tpu.cluster_utils import Cluster
+
+    scenario = seed % 3
+    cfg = Config.from_env()
+    cfg.chaos_seed = seed
+    cfg.chaos_drop_prob = drop_prob
+    cfg.chaos_dup_prob = dup_prob
+    cfg.chaos_delay_prob = delay_prob
+    cfg.chaos_delay_max_ms = delay_max_ms
+    cfg.chaos_methods = CHAOS_METHODS
+    if scenario != 1:
+        # cross-node channel hops stream as several chunk frames each
+        cfg.object_transfer_chunk_bytes = 2048 if scenario == 0 else 1024
+
+    cluster = Cluster(config=cfg)
+    try:
+        if scenario == 1:
+            cluster.add_node(num_cpus=6)
+            cluster.wait_for_nodes(1)
+        else:
+            cluster.add_node(num_cpus=4, resources={"left": 100})
+            cluster.add_node(num_cpus=4, resources={"right": 100})
+            cluster.wait_for_nodes(2)
+        ray_tpu.init(address=cluster.address)
+        chaos.set_fault_controller(FaultController(
+            seed=seed, drop_prob=drop_prob, dup_prob=dup_prob,
+            delay_prob=delay_prob, delay_max_ms=delay_max_ms,
+            methods=CHAOS_METHODS))
+        if scenario == 0:
+            _controller_chaos_pipeline(seed, cluster)
+        elif scenario == 1:
+            _controller_chaos_serve(seed, cluster)
+        else:
+            _controller_chaos_sebulba(seed, cluster)
+    finally:
+        chaos.set_fault_controller(None)  # calm teardown
+        _maybe_flight_dump()  # before shutdown, while dumps exist
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        cluster.shutdown()
+        chaos.reset()
+
+
 def _run_one(seed: int, args) -> None:
     global _CURRENT_SEED
     _CURRENT_SEED = seed
     if args.flight_dump:
         os.environ["RAY_TPU_CHAOS_FLIGHT_DUMP"] = args.flight_dump
+    if args.controller:
+        run_controller_chaos(
+            seed,
+            drop_prob=args.drop, dup_prob=args.dup, delay_prob=args.delay,
+            delay_max_ms=args.delay_max_ms)
+        return
     if args.podracer:
         run_podracer_chaos(
             seed,
@@ -1022,7 +1450,10 @@ def _run_one(seed: int, args) -> None:
         seed,
         drop_prob=args.drop, dup_prob=args.dup, delay_prob=args.delay,
         delay_max_ms=args.delay_max_ms,
-        kills=not args.no_kills, train=not args.no_train)
+        kills=not args.no_kills, train=not args.no_train,
+        # the DEFAULT sweep now also restarts the controller mid-run
+        # (ISSUE 12): recovery is part of the baseline fault envelope
+        controller_restart=not args.no_controller_restart)
 
 
 def main() -> int:
@@ -1059,6 +1490,19 @@ def main() -> int:
                              "seed ALWAYS dumps (to a temp dir when this "
                              "is unset) so failures leave a debuggable "
                              "trace instead of just an exit code")
+    parser.add_argument("--controller", action="store_true",
+                        help="controller-HA mode: SIGKILL + restart the "
+                             "controller MID-WORKLOAD (seed%%3 picks a "
+                             "pipeline flush / serve burst / Sebulba "
+                             "iteration) under drop/dup/delay — the "
+                             "data plane must stream through the outage "
+                             "(0 control RPCs, counter-asserted), "
+                             "outputs/losses exact, pins to baseline, "
+                             "fresh work schedulable after recovery")
+    parser.add_argument("--no-controller-restart", action="store_true",
+                        help="default workload only: skip the mid-run "
+                             "controller kill+restart (it is part of "
+                             "the default fault envelope since ISSUE 12)")
     parser.add_argument("--podracer", action="store_true",
                         help="attack the Sebulba RL topology: cross-node "
                              "trajectory-channel pushes + ring parameter "
@@ -1086,6 +1530,10 @@ def main() -> int:
             child.append("--no-kills")
         if args.no_train:
             child.append("--no-train")
+        if args.no_controller_restart:
+            child.append("--no-controller-restart")
+        if args.controller:
+            child.append("--controller")
         if args.collective:
             child.append("--collective")
         if args.collective_overlap:
