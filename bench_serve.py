@@ -10,12 +10,16 @@ Modes:
   * --serve — end-to-end through a live Serve deployment (router ->
     replica -> continuous scheduler);
   * --loadgen — OPEN-LOOP load generator against the replica serve path:
-    Poisson arrivals, mixed prompt lengths, heavy-tailed per-request
-    `max_new_tokens`; drives BOTH the continuous (iteration-level)
-    scheduler and the request-level `@serve.batch` baseline at the same
-    offered load and reports p50/p99 TTFT, p50/p99 inter-token latency,
-    and useful tokens/s for each, plus the continuous/baseline ratios.
-    Records carry the PR-6 TPU-probe provenance fields (`tpu_lost`,
+    Poisson arrivals; `--workload prefix` (default, ISSUE 13) draws each
+    prompt as a Zipf-distributed shared preamble (8 x 224-token system
+    prompts / few-shot preambles) plus a unique 4-10-token tail, while
+    `--workload mixed` keeps the ISSUE-9 mixed-length/heavy-tail shape.
+    Drives THREE schedulers at the same offered load — paged arena +
+    radix prefix cache, the PR-9 contiguous continuous arena, and the
+    request-level `@serve.batch` baseline — and reports p50/p99 TTFT,
+    p50/p99 inter-token latency, useful tokens/s and `prefix_hit_rate`,
+    plus the paged/continuous and continuous/baseline ratios. Records
+    carry the PR-6 TPU-probe provenance fields (`tpu_lost`,
     `tpu_probe_ok`, `tpu_probe_attempts`, `device`) so CPU-smoke numbers
     are distinguishable from regressions.
 
@@ -185,6 +189,41 @@ def _make_load(seed: int, n: int, rate_rps: float, new_tokens_cap: int):
     return list(zip(arrivals.tolist(), prompts, budgets))
 
 
+def _make_prefix_load(seed: int, n: int, rate_rps: float,
+                      new_tokens_cap: int, *, n_prefixes: int = 8,
+                      prefix_len: int = 224, zipf_s: float = 1.1,
+                      max_seq_len: int = 256):
+    """ISSUE-13 shared-prefix workload: a handful of long system-prompt /
+    few-shot preambles chosen Zipf-distributed (a few preambles dominate,
+    the tail is cold — real multi-tenant traffic shape), each followed by
+    a short unique per-request tail. Prefix reuse is the whole game here:
+    a scheduler that re-prefills every preamble burns ~prefix_len tokens
+    of compute per request that a radix cache turns into a page-table
+    splice."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_rps, size=n))
+    letters = "abcdefghijklmnopqrstuvwxyz "
+    prefixes = ["".join(rng.choice(list(letters), size=prefix_len))
+                for _ in range(n_prefixes)]
+    ranks = np.arange(1, n_prefixes + 1, dtype=float)
+    p = ranks ** (-zipf_s)
+    p /= p.sum()
+    which = rng.choice(n_prefixes, size=n, p=p)
+    tail_lens = rng.integers(4, 11, size=n)
+    prompts = [prefixes[w] + f"{i:03d}" +
+               "".join(rng.choice(list(letters), size=int(t)))
+               for i, (w, t) in enumerate(zip(which, tail_lens))]
+    # prompt + budget must fit the (possibly overridden) context window
+    # regardless of the mixed-workload cap
+    cap = min(new_tokens_cap, max_seq_len - (prefix_len + 3 + 10) - 2)
+    cap = max(2, min(cap, 12))
+    budgets = [int(min(cap, 2 + round(3 * rng.pareto(1.5))))
+               for _ in range(n)]
+    return list(zip(arrivals.tolist(), prompts, budgets))
+
+
 async def _drive_open_loop(server, load, streaming: bool):
     """Replay the arrival schedule against one replica callable. Streaming
     consumption measures true TTFT/inter-token latency; non-streaming
@@ -226,25 +265,53 @@ async def _drive_open_loop(server, load, streaming: bool):
 
 def run_loadgen(mode: str, preset: str, rate_rps: float, n: int, seed: int,
                 *, slots: int = 8, prefill_chunk: int = 16,
-                new_tokens_cap: int = 48) -> dict:
+                new_tokens_cap: int = 48, workload: str = "mixed",
+                kv_layout: str = "contiguous",
+                prefix_cache: bool = False,
+                prefix_len: int = 224, max_seq_len: int = 256,
+                kv_pages: int = 0) -> dict:
     """One open-loop run against a directly-instantiated replica callable
     (the serve path minus transport: scheduler + jitted programs — what
-    the ISSUE-9 comparison is about). mode: "continuous" | "batch"."""
+    the ISSUE-9/13 comparisons are about). mode: "continuous" | "batch";
+    workload: "mixed" (ISSUE 9) | "prefix" (ISSUE 13 Zipf shared-prefix);
+    kv_layout/prefix_cache select the paged arena + radix cache vs the
+    PR-9 contiguous arena (continuous mode only)."""
     from ray_tpu.serve.llm import LLMServerImpl
 
+    kw = {}
+    if mode == "continuous":
+        kw = {"kv_layout": kv_layout,
+              "prefix_cache": prefix_cache if kv_layout == "paged" else None}
+        if kv_layout == "paged" and kv_pages:
+            kw["kv_pages"] = kv_pages
+    if workload == "prefix":
+        # the shared preambles need a context window wider than the debug
+        # preset's 128 (production few-shot preambles dwarf the tails);
+        # every candidate gets the same window
+        kw["preset_overrides"] = {"max_seq_len": max_seq_len}
     server = LLMServerImpl(
         preset=preset, max_new_tokens=new_tokens_cap, scheduler=mode,
         slots=slots, prefill_chunk=prefill_chunk, share_weights=False,
-        max_batch_size=slots)
+        max_batch_size=slots, **kw)
     try:
-        load = _make_load(seed, n, rate_rps, new_tokens_cap)
+        if workload == "prefix":
+            load = _make_prefix_load(seed, n, rate_rps, new_tokens_cap,
+                                     prefix_len=prefix_len,
+                                     max_seq_len=max_seq_len)
+        else:
+            load = _make_load(seed, n, rate_rps, new_tokens_cap)
         # warmup = a full replay of the SAME load, off the clock: the
         # request-level baseline compiles one program per (batch, length,
         # steps) shape its flushes happen to form — measuring its shape-
         # churn compiles would flatter the continuous path (which compiles
-        # exactly two programs) for the wrong reason on CPU
+        # exactly two programs) for the wrong reason on CPU. For the
+        # prefix-cache comparison the warm replay also PRE-POPULATES the
+        # radix cache for both candidates symmetrically (the measured run
+        # sees the steady-state hit rate, not the cold ramp)
         asyncio.run(_drive_open_loop(
             server, load, streaming=(mode == "continuous")))
+        warm = (server.scheduler_stats()
+                if mode == "continuous" else {})
         out = asyncio.run(_drive_open_loop(
             server, load, streaming=(mode == "continuous")))
         out["scheduler"] = server.scheduler_stats()
@@ -256,6 +323,20 @@ def run_loadgen(mode: str, preset: str, rate_rps: float, n: int, seed: int,
             assert st["admitted_mid_flight"] > 0, (
                 "no request was admitted mid-generation; the open-loop "
                 f"load never exercised continuous batching: {st}")
+            assert st["kv_layout"] == kv_layout, st
+            if prefix_cache and kv_layout == "paged":
+                # fallback guard: the radix cache must actually have
+                # spliced prefixes, and exactly two programs compiled
+                assert st["prefix_hits"] > 0, (
+                    f"prefix cache never hit on the shared-prefix load: "
+                    f"{st}")
+                assert st["compiled_programs"] == 2, st
+                # steady-state hit rate: the MEASURED run's delta only
+                # (the warmup replay exists precisely to absorb the
+                # cold-ramp misses — don't blend them back in)
+                dh = st["prefix_hits"] - warm.get("prefix_hits", 0)
+                dm = st["prefix_misses"] - warm.get("prefix_misses", 0)
+                out["prefix_hit_rate"] = round(dh / max(dh + dm, 1), 4)
         return out
     finally:
         server.shutdown()
@@ -264,28 +345,107 @@ def run_loadgen(mode: str, preset: str, rate_rps: float, n: int, seed: int,
 def loadgen_main(args) -> None:
     log = lambda m: print(f"bench_serve: {m}", file=sys.stderr)  # noqa: E731
     prov = _probe_provenance(log)
+    common = dict(slots=args.slots, new_tokens_cap=args.new_tokens_cap,
+                  prefill_chunk=args.prefill_chunk,
+                  prefix_len=args.prefix_len,
+                  max_seq_len=args.max_seq_len)
+    base_detail = {"requests": args.requests, "seed": args.seed,
+                   "slots": args.slots, "preset": args.preset,
+                   "new_tokens_cap": args.new_tokens_cap,
+                   "arrivals": "poisson"}
+    records = []
+
+    # ---- ISSUE-13: Zipf shared-prefix workload, three-way ----
+    # paged arena + radix prefix cache vs the PR-9 continuous arena vs
+    # request-level batching, same offered load (saturating, so tokens/s
+    # measures CAPACITY, not the arrival rate). The paged pool gets
+    # headroom for the radix working set (the 8 preambles stay resident)
+    # on top of the slots' demand — that residency IS the mechanism being
+    # measured; the scheduler stats in the detail show what it held
+    from ray_tpu._private.config import global_config
+
+    pt = global_config().serve_page_tokens  # the scheduler's actual size
+    pool = (args.slots * (args.max_seq_len // pt)
+            + 8 * (args.prefix_len // pt) + 1)
+    pfx_detail = {**base_detail, "workload": "prefix",
+                  "rate_rps": args.prefix_rate,
+                  "max_seq_len": args.max_seq_len,
+                  "new_tokens_dist": "2+3*pareto(1.5), capped at 12",
+                  "prefix_dist": (
+                      f"zipf(s=1.1) over 8 x {args.prefix_len}-token "
+                      f"preambles, 4-10-token tails")}
+    log("paged+prefix continuous (zipf shared-prefix workload) ...")
+    paged = run_loadgen("continuous", args.preset, args.prefix_rate,
+                        args.requests, args.seed, workload="prefix",
+                        kv_layout="paged", prefix_cache=True,
+                        kv_pages=pool, **common)
+    log("PR-9 contiguous continuous (zipf shared-prefix workload) ...")
+    cont_p = run_loadgen("continuous", args.preset, args.prefix_rate,
+                         args.requests, args.seed, workload="prefix",
+                         kv_layout="contiguous", **common)
+    log("request-level batch (zipf shared-prefix workload) ...")
+    base_p = run_loadgen("batch", args.preset, args.prefix_rate,
+                         args.requests, args.seed, workload="prefix",
+                         **common)
+    paged_speedup = paged["tokens_per_sec"] / max(
+        cont_p["tokens_per_sec"], 1e-9)
+    records += [
+        {"metric": "serve_loadgen_paged_prefix_tokens_per_sec",
+         "value": paged["tokens_per_sec"], "unit": "tokens/s",
+         "detail": {**paged, **pfx_detail, **prov}},
+        {"metric": "serve_loadgen_continuous_prefix_tokens_per_sec",
+         "value": cont_p["tokens_per_sec"], "unit": "tokens/s",
+         "detail": {**cont_p, **pfx_detail, **prov}},
+        {"metric": "serve_loadgen_request_batch_prefix_tokens_per_sec",
+         "value": base_p["tokens_per_sec"], "unit": "tokens/s",
+         "detail": {**base_p, **pfx_detail, **prov}},
+        {"metric": "serve_paged_prefix_speedup",
+         "value": round(paged_speedup, 2), "unit": "x",
+         "detail": {"vs": "PR-9 contiguous continuous, same offered load",
+                    "prefix_hit_rate": paged.get("prefix_hit_rate"),
+                    # arena accounting, auditable from the record alone:
+                    # the paged pool carries the radix working set ON TOP
+                    # of the slots' demand — that residency is the
+                    # mechanism being measured, not hidden headroom
+                    "paged_pool_pages": paged["scheduler"]["num_pages"],
+                    "paged_page_tokens":
+                        paged["scheduler"]["page_tokens"],
+                    "paged_peak_pages_in_use":
+                        paged["scheduler"]["peak_pages_in_use"],
+                    "contiguous_arena_tokens":
+                        args.slots * args.max_seq_len,
+                    "paged_p99_ttft_ms": paged["ttft_ms"]["p99"],
+                    "continuous_p99_ttft_ms": cont_p["ttft_ms"]["p99"],
+                    "paged_p50_ttft_ms": paged["ttft_ms"]["p50"],
+                    "continuous_p50_ttft_ms": cont_p["ttft_ms"]["p50"],
+                    **pfx_detail, **prov}},
+    ]
+
+    # ---- ISSUE-9 continuity: mixed workload, continuous vs batch ----
+    # (the PR-9 record, re-measured on the PR-9 contiguous arena: the
+    # mixed-length heavy-tail load where iteration-level scheduling wins;
+    # uniform near-window-length prompts would instead flatter the
+    # whole-prompt-prefill batch path)
+    mix_detail = {**base_detail, "workload": "mixed",
+                  "rate_rps": args.rate,
+                  "new_tokens_dist": "1+4*pareto(1.5), capped"}
+    log("PR-9 contiguous continuous (mixed workload) ...")
     cont = run_loadgen("continuous", args.preset, args.rate, args.requests,
-                       args.seed, slots=args.slots,
-                       new_tokens_cap=args.new_tokens_cap)
+                       args.seed, workload="mixed",
+                       kv_layout="contiguous", **common)
+    log("request-level batch baseline (mixed workload) ...")
     base = run_loadgen("batch", args.preset, args.rate, args.requests,
-                       args.seed, slots=args.slots,
-                       new_tokens_cap=args.new_tokens_cap)
+                       args.seed, workload="mixed", **common)
     speedup = cont["tokens_per_sec"] / max(base["tokens_per_sec"], 1e-9)
     ttft_ratio = (base["ttft_ms"]["p99"] or 0.0) / max(
         cont["ttft_ms"]["p99"] or 1e-9, 1e-9)
-    load_detail = {"rate_rps": args.rate, "requests": args.requests,
-                   "seed": args.seed, "slots": args.slots,
-                   "preset": args.preset,
-                   "new_tokens_cap": args.new_tokens_cap,
-                   "arrivals": "poisson",
-                   "new_tokens_dist": "1+4*pareto(1.5), capped"}
-    records = [
+    records += [
         {"metric": "serve_loadgen_continuous_tokens_per_sec",
          "value": cont["tokens_per_sec"], "unit": "tokens/s",
-         "detail": {**cont, **load_detail, **prov}},
+         "detail": {**cont, **mix_detail, **prov}},
         {"metric": "serve_loadgen_request_batch_tokens_per_sec",
          "value": base["tokens_per_sec"], "unit": "tokens/s",
-         "detail": {**base, **load_detail, **prov}},
+         "detail": {**base, **mix_detail, **prov}},
         {"metric": "serve_continuous_speedup",
          "value": round(speedup, 2), "unit": "x",
          "detail": {"p99_ttft_improvement_x": round(ttft_ratio, 2),
@@ -293,7 +453,7 @@ def loadgen_main(args) -> None:
                     "baseline_p99_ttft_ms": base["ttft_ms"]["p99"],
                     "continuous_p50_ttft_ms": cont["ttft_ms"]["p50"],
                     "baseline_p50_ttft_ms": base["ttft_ms"]["p50"],
-                    **load_detail, **prov}},
+                    **mix_detail, **prov}},
     ]
     for rec in records:
         print(json.dumps(rec))
@@ -322,12 +482,25 @@ def main(argv=None) -> None:
                     help="open-loop load generator: continuous vs "
                          "request-level batching at the same offered load")
     ap.add_argument("--rate", type=float, default=75.0,
-                    help="loadgen Poisson arrival rate (req/s); the "
+                    help="mixed-workload Poisson arrival rate (req/s); the "
                          "default saturates the request-level baseline "
                          "on a CPU host so the capacity gap is visible")
+    ap.add_argument("--prefix-rate", type=float, default=600.0,
+                    help="shared-prefix-workload arrival rate (req/s); "
+                         "must saturate BOTH continuous schedulers so "
+                         "tokens/s measures capacity, not arrivals")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--new-tokens-cap", type=int, default=48)
+    ap.add_argument("--prefill-chunk", type=int, default=16,
+                    help="scheduler prefill chunk width (both continuous "
+                         "candidates)")
+    ap.add_argument("--prefix-len", type=int, default=224,
+                    help="shared preamble length (tokens) for the prefix "
+                         "workload")
+    ap.add_argument("--max-seq-len", type=int, default=256,
+                    help="context-window override for the prefix workload "
+                         "(preamble + tail + budget must fit)")
     ap.add_argument("--json-out", default="",
                     help="also write the full loadgen suite to this file")
     ap.add_argument("--concurrency", type=int, default=16)

@@ -112,6 +112,24 @@ class Config:
     # many tokens between decode iterations, so a long prompt cannot stall
     # the in-flight decodes of other slots
     serve_prefill_chunk: int = 32
+    # KV arena layout: "paged" (pool of page_tokens-sized pages, per-slot
+    # page tables, prefix sharing — ISSUE 13) or "contiguous" (PR-9
+    # worst-case range per slot, kept as the measured baseline)
+    serve_kv_layout: str = "paged"
+    # tokens per KV page. Explicit 0 (env or argument) RAISES at scheduler
+    # build — it never silently becomes this default (the PR-8/PR-9
+    # falsy-zero lesson)
+    serve_page_tokens: int = 16
+    # total pages in the paged pool (page 0 is the reserved garbage page).
+    # 0 = auto: size for the contiguous worst case, slots * arena_len /
+    # page_tokens + 1 — same arena bytes as the PR-9 layout, but slots
+    # only consume what they actually use, so capacity can be raised
+    # ~10x at the same bytes by raising `serve_slots`
+    serve_kv_pages: int = 0
+    # radix prefix cache over prompt tokens: admit a request whose prompt
+    # shares a cached prefix by page-table splice + cursor jump instead of
+    # re-prefilling. Requires the paged layout
+    serve_prefix_cache: bool = True
     # total budget for one cross-node per-step push (chunk window +
     # commit); the commit side also waits for remote reader acks under it
     channel_remote_timeout_s: float = 120.0
@@ -249,6 +267,18 @@ def _render(val) -> str:
     if isinstance(val, (dict, list)):
         return json.dumps(val)
     return str(val)
+
+
+def env_flag_explicit(field_name: str) -> bool | None:
+    """True/False iff the ``RAY_TPU_<FIELD_NAME>`` env var is explicitly
+    set — parsed by the SAME bool rule ``Config.from_env`` uses — else
+    None. For callers that must distinguish an operator's explicit env
+    intent from a config-field default (e.g. loud knob-conflict
+    rejection) without re-implementing the parser."""
+    raw = os.environ.get(_ENV_PREFIX + field_name.upper())
+    if raw is None:
+        return None
+    return bool(_parse(raw, bool, False))
 
 
 _global_config: Config | None = None

@@ -202,6 +202,187 @@ def slot_decode_step(cfg: TransformerConfig, params, tokens, active, caches):
     return jax.vmap(one, in_axes=(0, 0, 0))(tokens, active, caches)
 
 
+# ---------------------------------------------------------------------------
+# paged KV arena — the slot arena rebuilt as a pool of fixed-size pages
+# (ISSUE 13). KV storage is [num_pages, page_tokens, Hkv, D] per layer; a
+# slot owns a PAGE TABLE ([pages_per_slot] int32 of physical page ids)
+# instead of a contiguous worst-case range, so long/idle sequences stop
+# reserving memory they don't use and read-only pages can be SHARED between
+# slots (the prefix cache). The two compiled programs gather a slot's
+# logical view out of the pool, run the exact same per-row math as the
+# contiguous SlotKVCache path, and scatter the view back through a WRITE
+# table — so paging relocates bytes but never changes a single attended
+# value (temperature-0 parity with the contiguous arena is bit-exact).
+#
+# Page 0 is RESERVED as the garbage page: read-table entries for logical
+# pages a slot has not allocated point at it (their positions are >= the
+# slot's cursor, so the causal mask zeroes them exactly — the same
+# masked-garbage invariant the contiguous arena already relies on for
+# stale slot content), and write-table entries for SHARED or unallocated
+# pages redirect there so a slot can never scribble on a page it does not
+# own. The scheduler (serve/_private/continuous.py) maintains the tables
+# host-side and guarantees the page covering every position written by a
+# program is allocated and owned before the call.
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PagedKVCache:
+    """One layer's page pool. k/v: [num_pages, page_tokens, Hkv, D];
+    lengths: [slots] int32 — per-slot write cursors in LOGICAL tokens."""
+
+    k: Any
+    v: Any
+    lengths: Any
+
+    @classmethod
+    def zeros(cls, slots: int, num_pages: int, page_tokens: int,
+              kv_heads: int, head_dim: int,
+              dtype=jnp.bfloat16) -> "PagedKVCache":
+        return cls(
+            k=jnp.zeros((num_pages, page_tokens, kv_heads, head_dim), dtype),
+            v=jnp.zeros((num_pages, page_tokens, kv_heads, head_dim), dtype),
+            lengths=jnp.zeros((slots,), jnp.int32),
+        )
+
+
+def init_paged_caches(cfg: TransformerConfig, slots: int, num_pages: int,
+                      page_tokens: int, pages_per_slot: int,
+                      dtype=None) -> List[PagedKVCache]:
+    if page_tokens < 1:
+        raise ValueError(f"page_tokens must be >= 1, got {page_tokens}")
+    if num_pages < 2:
+        # page 0 is the reserved garbage page; an arena with no
+        # allocatable page cannot hold any sequence
+        raise ValueError(f"num_pages must be >= 2, got {num_pages}")
+    if pages_per_slot * page_tokens > cfg.max_seq_len:
+        # rope/learned position tables are sized cfg.max_seq_len; a longer
+        # logical view would gather clamped positions and decode silently
+        # wrong
+        raise ValueError(
+            f"pages_per_slot * page_tokens ({pages_per_slot * page_tokens}) "
+            f"exceeds cfg.max_seq_len ({cfg.max_seq_len})")
+    dtype = dtype or cfg.dtype
+    return [PagedKVCache.zeros(slots, num_pages, page_tokens, cfg.kv_heads,
+                               cfg.head_dim, dtype)
+            for _ in range(cfg.num_layers)]
+
+
+def paged_reset_slot(caches: List[PagedKVCache], slot: int,
+                     length: int = 0) -> List[PagedKVCache]:
+    """Point a slot's cursor at ``length`` (0 for a cold admit; the cached
+    prefix length for a prefix-cache hit, whose pages the read table
+    splices in). No scrub, same contiguous-write/update-before-attend
+    invariant as ``reset_slot``."""
+    return [dataclasses.replace(
+        c, lengths=c.lengths.at[slot].set(jnp.int32(length)))
+        for c in caches]
+
+
+def _gather_row(c: PagedKVCache, table):
+    """[P] page table -> one slot's logical [1, P*T, Hkv, D] k/v view."""
+    P = table.shape[0]
+    T, H, D = c.k.shape[1:]
+    return (c.k[table].reshape(1, P * T, H, D),
+            c.v[table].reshape(1, P * T, H, D))
+
+
+def paged_prefill_into_slot(cfg: TransformerConfig, params, tokens, real_len,
+                            slot, read_row, write_row,
+                            caches: List[PagedKVCache]):
+    """``prefill_into_slot`` through a page table: gather the slot's
+    logical view from the pool, run the identical chunk forward, scatter
+    the view back through ``write_row``. read_row/write_row: [P] int32 —
+    shared (prefix-cache) pages appear in read_row but are redirected to
+    the garbage page in write_row, so their content is immutable here.
+
+    Caller contract (scheduler-enforced): every page covering the REAL
+    tokens [cursor, cursor + real_len) is allocated and OWNED (write_row
+    == read_row there); pad positions beyond real_len may fall on
+    unallocated entries — their writes redirect to the garbage page and
+    their reads are causally masked. cursor + C fits the logical view."""
+    T = caches[0].k.shape[1]
+    P = read_row.shape[0]
+    rows = []
+    for c in caches:
+        k, v = _gather_row(c, read_row)
+        rows.append(LayerKVCache(
+            k=k, v=v, length=lax.dynamic_slice(c.lengths, (slot,), (1,))[0]))
+    positions = jnp.arange(tokens.shape[1])[None, :] + rows[0].length
+    logits, new_rows = forward(cfg, params, tokens, positions=positions,
+                               kv_caches=rows)
+    last = lax.dynamic_index_in_dim(logits[0], real_len - 1, keepdims=False)
+    H, D = caches[0].k.shape[2:]
+    # windowed scatter-back: the chunk writes only [cursor, cursor + C),
+    # which spans at most ceil(C/T)+1 pages — persisting just that window
+    # (instead of the whole P-page view) keeps the paged program's write
+    # traffic proportional to the chunk, like the contiguous arena's
+    # in-place dynamic_update_slice. Clipped window tails land on
+    # already-in-window pages (same content, harmless) and shared /
+    # unallocated entries redirect to the garbage page.
+    C = tokens.shape[1]
+    W = min(P, (C + T - 1) // T + 1)
+    w0 = rows[0].length // T
+    widx = jnp.clip(w0 + jnp.arange(W), 0, P - 1)
+    dest = write_row[widx]
+    new_caches = []
+    for c, r in zip(caches, new_rows):
+        new_caches.append(PagedKVCache(
+            k=c.k.at[dest].set(r.k.reshape(P, T, H, D)[widx]),
+            v=c.v.at[dest].set(r.v.reshape(P, T, H, D)[widx]),
+            lengths=c.lengths.at[slot].add(real_len)))
+    return last, new_caches
+
+
+def paged_decode_step(cfg: TransformerConfig, params, tokens, active,
+                      read_tables, write_tables,
+                      caches: List[PagedKVCache]):
+    """``slot_decode_step`` through page tables: one fixed-shape program
+    over the whole arena. tokens/active: [slots] int32; read_tables/
+    write_tables: [slots, P] int32. The per-slot math is the contiguous
+    path's vmapped single-sequence forward over the GATHERED view, so an
+    attended value can never differ from the contiguous arena; the scatter
+    through write_tables persists each slot's view back into the pool
+    (shared + unallocated entries land on the garbage page).
+
+    Returns (logits [slots, vocab], caches)."""
+    T = caches[0].k.shape[1]
+    slots, P = read_tables.shape
+    H, D = caches[0].k.shape[2:]
+
+    def one(tok, length, read_row, write_row):
+        rows = []
+        for c in caches:
+            k, v = _gather_row(c, read_row)
+            rows.append(LayerKVCache(k=k, v=v, length=length))
+        positions = rows[0].length + jnp.zeros((1, 1), jnp.int32)
+        logits, new_rows = forward(cfg, params, tok[None, None],
+                                   positions=positions, kv_caches=rows)
+        # windowed scatter-back: a decode step writes exactly ONE
+        # position (``length``), so only the page containing it needs to
+        # persist — inactive/shared entries redirect to the garbage page
+        pidx = jnp.clip(length // T, 0, P - 1)
+        dest = write_row[pidx]
+        outs_k = [lax.dynamic_index_in_dim(
+            r.k[0].reshape(P, T, H, D), pidx, keepdims=False)
+            for r in new_rows]
+        outs_v = [lax.dynamic_index_in_dim(
+            r.v[0].reshape(P, T, H, D), pidx, keepdims=False)
+            for r in new_rows]
+        return logits[0, -1], dest, (outs_k, outs_v)
+
+    lengths = caches[0].lengths
+    logits, dest, (new_k, new_v) = jax.vmap(one, in_axes=(0, 0, 0, 0))(
+        tokens, lengths, read_tables, write_tables)
+    new_caches = []
+    for c, nk, nv in zip(caches, new_k, new_v):
+        new_caches.append(PagedKVCache(
+            k=c.k.at[dest].set(nk),
+            v=c.v.at[dest].set(nv),
+            lengths=c.lengths + active))
+    return logits, new_caches
+
+
 @partial(jax.jit, static_argnums=(0, 4, 5, 6))
 def generate(cfg: TransformerConfig, params, prompt, key,
              max_new_tokens: int, temperature: float = 0.0, top_k: int = 0):

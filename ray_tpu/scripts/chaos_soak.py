@@ -999,6 +999,210 @@ def run_podracer_chaos(
         chaos.reset()
 
 
+def run_serve_chaos(
+    seed: int,
+    *,
+    drop_prob: float = 0.02,
+    dup_prob: float = 0.05,
+    delay_prob: float = 0.05,
+    delay_max_ms: int = 20,
+    kills: bool = True,
+) -> None:
+    """One seeded chaos run against the PAGED + PREFIX-CACHE serve
+    scheduler (ISSUE 13).
+
+    Deploys 2 LLM replicas (paged KV arena + radix prefix cache, the
+    default) and drives a shared-prefix request burst under drop/dup/delay.
+    With ``kills``, one replica is hard-killed MID-BURST: burst requests
+    must either complete with the exact temperature-0 reference output or
+    fail cleanly (never a wrong token), the controller's health sweep must
+    replace the replica, and afterwards the surviving/replacement
+    schedulers' paged state must be back at baseline — every slot retired,
+    every radix refcount zero, and the page gauge equal to the resident
+    prefix-cache pages (gauge-proven; a leak would show as
+    pages_in_use > radix_resident_pages). A cancel-mid-stream scenario
+    then proves a walked-away consumer retires its pages WITHOUT
+    contaminating a later admit that hits the same cached prefix
+    (exact-output-asserted against a cold reference).
+    """
+    import asyncio
+    import threading
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu._private import chaos
+    from ray_tpu._private.chaos import FaultController
+    from ray_tpu._private.config import Config
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.serve.llm import LLMServerImpl
+
+    cfg = Config.from_env()
+    cfg.chaos_seed = seed
+    cfg.chaos_drop_prob = drop_prob
+    cfg.chaos_dup_prob = dup_prob
+    cfg.chaos_delay_prob = delay_prob
+    cfg.chaos_delay_max_ms = delay_max_ms
+    cfg.chaos_methods = CHAOS_METHODS
+
+    cluster = Cluster(config=cfg)
+    try:
+        cluster.add_node(num_cpus=4)
+        cluster.wait_for_nodes(1)
+        ray_tpu.init(address=cluster.address)
+        chaos.set_fault_controller(FaultController(
+            seed=seed, drop_prob=drop_prob, dup_prob=dup_prob,
+            delay_prob=delay_prob, delay_max_ms=delay_max_ms,
+            methods=CHAOS_METHODS))
+
+        class _ChaosLLMImpl(LLMServerImpl):
+            async def __call__(self, request=None):
+                if isinstance(request, dict) and request.get("__die__"):
+                    os._exit(1)  # the mid-burst replica kill
+                return await super().__call__(request)
+
+        dep = serve.deployment(name="llmchaos", max_ongoing_requests=32)(
+            _ChaosLLMImpl)
+        # shared preamble longer than several pages + unique tails: the
+        # burst exercises splice/insert/refcount churn on every admit
+        preamble = "You are a terse assistant. Answer briefly. "
+        prompts = [preamble + f"q{i:02d}?" for i in range(6)]
+        h = serve.run(dep.options(num_replicas=2).bind(
+            max_new_tokens=6, slots=4, prefill_chunk=8, page_tokens=8),
+            name="servechaos", route_prefix="/servechaos")
+
+        # temperature-0 references (replicas are identical; the first
+        # answer per prompt is the reference the rest must equal)
+        refs = {}
+        for p in prompts:
+            refs[p] = h.remote({"prompt": p}).result(timeout=300)["text"]
+            assert refs[p], "reference generation empty"
+
+        n_burst = 24
+        outs = [None] * n_burst
+        errs = []
+
+        def call(i):
+            try:
+                outs[i] = h.remote(
+                    {"prompt": prompts[i % len(prompts)]}).result(
+                        timeout=300)
+            except Exception as e:  # noqa: BLE001 — asserted below
+                errs.append(e)
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(n_burst)]
+        for t in threads:
+            t.start()
+        if kills:
+            time.sleep(0.3)  # let the burst land on both replicas
+            try:
+                h.remote({"__die__": True}).result(timeout=30)
+            except Exception:
+                pass  # the dying replica cannot answer
+        for t in threads:
+            t.join()
+        for o in outs:
+            if o is not None:
+                assert o["text"] == refs[o["prompt"]], (
+                    "burst output diverged from the temperature-0 "
+                    f"reference for {o['prompt']!r}")
+        done = sum(1 for o in outs if o is not None)
+        assert done >= 1, f"every burst request failed: {errs[:3]}"
+        if not kills:
+            assert not errs, f"requests failed without a kill: {errs[:2]}"
+
+        # recovery: the health sweep replaces the killed replica and the
+        # deployment serves the exact reference again
+        deadline = time.monotonic() + 60
+        ok = 0
+        while time.monotonic() < deadline and ok < 8:
+            try:
+                out = h.remote(
+                    {"prompt": prompts[ok % len(prompts)]}).result(
+                        timeout=30)
+                assert out["text"] == refs[out["prompt"]], (
+                    "post-recovery output diverged: "
+                    f"{out['text']!r} for {out['prompt']!r}")
+                ok += 1
+            except AssertionError:
+                raise
+            except Exception:
+                time.sleep(0.5)
+        assert ok >= 8, "deployment did not recover from the replica kill"
+
+        # paged-state hygiene, gauge-proven on the live replicas: every
+        # slot retired, no dangling radix refs, and the page gauge equal
+        # to the resident prefix-cache pages (a leaked slot/page would
+        # leave pages_in_use > radix_resident_pages forever)
+        deadline = time.monotonic() + 30
+        clean = 0
+        while time.monotonic() < deadline and clean < 4:
+            st = h.scheduler_stats.remote().result(timeout=30)
+            assert st["mode"] == "continuous", st
+            assert st["kv_layout"] == "paged", st
+            if (st["active_slots"] == 0 and st["radix_active_refs"] == 0
+                    and st["pages_in_use"] == st["radix_resident_pages"]):
+                clean += 1  # sampled across routing to both replicas
+            else:
+                time.sleep(0.5)
+        assert clean >= 4, (
+            f"paged arena did not return to baseline: {st}")
+        assert st["prefix_hits"] > 0, (
+            f"the shared-prefix burst never hit the radix cache: {st}")
+
+        serve.shutdown()
+
+        # ---- cancel-mid-stream vs the prefix cache (driver-local: the
+        # scheduler itself is RPC-free; chaos stays armed around it) ----
+        srv = LLMServerImpl(max_new_tokens=6, slots=2, prefill_chunk=8,
+                            page_tokens=8, share_weights=False)
+        try:
+            victim = preamble + "stream me something long please"
+
+            async def cold(p):
+                return (await srv({"prompt": p}))["text"]
+
+            ref_text = asyncio.run(cold(victim))
+            st0 = srv.scheduler_stats()
+
+            async def cancel_then_readmit():
+                gen = await srv({"prompt": victim, "stream": True,
+                                 "max_new_tokens": 32})
+                it = gen.__aiter__()
+                await it.__anext__()
+                await it.__anext__()
+                await gen.aclose()  # consumer walks away mid-decode
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    st = srv.scheduler_stats()
+                    if st["active_slots"] == 0 \
+                            and st["radix_active_refs"] == 0:
+                        break
+                    await asyncio.sleep(0.05)
+                st = srv.scheduler_stats()
+                assert st["active_slots"] == 0, st
+                assert st["radix_active_refs"] == 0, st
+                return (await srv({"prompt": victim}))["text"]
+
+            again = asyncio.run(cancel_then_readmit())
+            assert again == ref_text, (
+                "admit after cancel-mid-stream diverged through the "
+                "cached prefix")
+            st1 = srv.scheduler_stats()
+            assert st1["prefix_hits"] > st0["prefix_hits"], (
+                "re-admit never hit the prefix the cancelled stream "
+                f"cached: {st1}")
+        finally:
+            srv.shutdown()
+    finally:
+        chaos.set_fault_controller(None)  # calm teardown
+        _maybe_flight_dump()
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        cluster.shutdown()
+        chaos.reset()
+
+
 def _drain_pins_to_baseline(pins_before: int) -> None:
     """Shared tail of every channel-workload scenario: wait for the
     driver's channel pins to return to baseline, falling back to the
@@ -1428,6 +1632,12 @@ def _run_one(seed: int, args) -> None:
             drop_prob=args.drop, dup_prob=args.dup, delay_prob=args.delay,
             delay_max_ms=args.delay_max_ms, kills=not args.no_kills)
         return
+    if args.serve:
+        run_serve_chaos(
+            seed,
+            drop_prob=args.drop, dup_prob=args.dup, delay_prob=args.delay,
+            delay_max_ms=args.delay_max_ms, kills=not args.no_kills)
+        return
     if args.pipeline:
         run_pipeline_chaos(
             seed,
@@ -1510,6 +1720,14 @@ def main() -> int:
                              "the dynamic-loop reference losses; a "
                              "mid-iteration runner/learner kill must fail "
                              "clean and unwind")
+    parser.add_argument("--serve", action="store_true",
+                        help="attack the paged+prefix serve scheduler: a "
+                             "shared-prefix burst with a mid-burst replica "
+                             "kill must yield exact-or-clean-error outputs, "
+                             "recover, and return every page and radix "
+                             "refcount to baseline (gauge-proven); cancel-"
+                             "mid-stream must leave the cached prefix "
+                             "uncontaminated for a later admit")
     args = parser.parse_args()
 
     if args.one is not None:
@@ -1542,6 +1760,8 @@ def main() -> int:
             child.append("--pipeline")
         if args.podracer:
             child.append("--podracer")
+        if args.serve:
+            child.append("--serve")
         proc = subprocess.run(child)
         took = time.monotonic() - t0
         if proc.returncode != 0:

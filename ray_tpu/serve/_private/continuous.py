@@ -21,8 +21,24 @@ program shape is compiled once and the host-side loop only decides *which*
 sequences occupy which slots. All jax work runs on the scheduler's own
 thread — the replica's asyncio event loop only ever touches queues.
 
+ISSUE 13 rebuilds the arena as a PAGED pool (``kv_layout="paged"``, the
+default): KV storage is a pool of ``page_tokens``-sized pages
+(``models.decode.PagedKVCache``), each slot owns a page table instead of a
+contiguous worst-case ``arena_len`` range, and the same two compiled
+programs gather/scatter through the tables at fixed shapes — so long/idle
+sequences stop reserving memory they never use and a replica admits far
+more concurrent sequences at the same arena bytes. On top of paging a
+PREFIX/RADIX CACHE (``serve/_private/paging.RadixCache``) makes admitting
+a request whose prompt shares a cached prefix a page-table splice + cursor
+jump instead of a re-prefill; eviction is LRU over refcount-0 nodes under
+arena pressure. ``kv_layout="contiguous"`` keeps the PR-9 arena as the
+measured baseline (the collective layer's ``algo="kv"`` idiom).
+
 Knobs: ``RAY_TPU_SERVE_SLOTS`` (arena width), ``RAY_TPU_SERVE_PREFILL_CHUNK``
-(prefill chunk tokens); both overridable per-deployment via LLMServer init.
+(prefill chunk tokens), ``RAY_TPU_SERVE_KV_LAYOUT``,
+``RAY_TPU_SERVE_PAGE_TOKENS``, ``RAY_TPU_SERVE_KV_PAGES`` (0 = size the
+pool to the contiguous worst case), ``RAY_TPU_SERVE_PREFIX_CACHE``; all
+overridable per-deployment via LLMServer init.
 """
 
 from __future__ import annotations
@@ -83,7 +99,8 @@ class _Seq:
     __slots__ = ("prompt", "remaining_prompt", "max_new", "temperature",
                  "seed", "slot", "state", "n_generated", "next_token",
                  "queue", "loop", "cancelled", "t_submit", "t_first_token",
-                 "rng")
+                 "rng", "cached_len", "cursor", "owned_pages", "radix_node",
+                 "table_fill")
 
     def __init__(self, prompt: List[int], max_new: int, temperature: float,
                  seed: int, loop, queue):
@@ -102,6 +119,12 @@ class _Seq:
         self.t_submit = time.monotonic()
         self.t_first_token: Optional[float] = None
         self.rng = None  # lazily created numpy Generator for temperature > 0
+        # ---- paged-arena bookkeeping (host mirrors of device state) ----
+        self.cached_len = 0            # spliced prefix tokens (page-aligned)
+        self.cursor = 0                # mirrors the slot's device cursor
+        self.owned_pages: List[int] = []  # pages this slot must free
+        self.radix_node = None         # ref-counted prefix-cache node
+        self.table_fill = 0            # logical pages present in the table
 
 
 class ContinuousScheduler:
@@ -118,11 +141,19 @@ class ContinuousScheduler:
                  prefill_chunk: Optional[int] = None,
                  arena_len: Optional[int] = None,
                  eos_id: Optional[int] = None,
-                 cache_dtype=None):
+                 cache_dtype=None,
+                 kv_layout: Optional[str] = None,
+                 page_tokens: Optional[int] = None,
+                 kv_pages: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None):
+        import numpy as np
         import jax
 
         from ray_tpu._private.config import global_config
-        from ray_tpu.models.decode import (init_slot_caches,
+        from ray_tpu.models.decode import (init_paged_caches,
+                                           init_slot_caches,
+                                           paged_decode_step,
+                                           paged_prefill_into_slot,
                                            prefill_into_slot,
                                            slot_decode_step)
 
@@ -138,6 +169,13 @@ class ContinuousScheduler:
         self.arena_len = int(cfg.max_seq_len if arena_len is None
                              else arena_len)
         self.eos_id = eos_id
+        self.kv_layout = (conf.serve_kv_layout if kv_layout is None
+                          else kv_layout)
+        if self.kv_layout not in ("paged", "contiguous"):
+            raise ValueError(
+                f"kv_layout must be 'paged' or 'contiguous', got "
+                f"{self.kv_layout!r}")
+        self._paged = self.kv_layout == "paged"
         if self.slots < 1:
             raise ValueError(f"slots must be >= 1, got {self.slots}")
         if self.prefill_chunk < 1:
@@ -148,13 +186,77 @@ class ContinuousScheduler:
                 f"prefill_chunk ({self.prefill_chunk}) exceeds the arena "
                 f"length ({self.arena_len})")
         self._jax = jax
-        # donated caches: the arena mutates in place across iterations
-        self._prefill = jax.jit(partial(prefill_into_slot, cfg),
-                                donate_argnums=(4,))
-        self._step = jax.jit(partial(slot_decode_step, cfg),
-                             donate_argnums=(3,))
-        self._caches = init_slot_caches(cfg, self.slots, self.arena_len,
-                                        cache_dtype)
+        self._arena = None
+        self._radix = None
+        if self._paged:
+            from ray_tpu.serve._private.paging import PageArena, RadixCache
+
+            self.page_tokens = int(conf.serve_page_tokens
+                                   if page_tokens is None else page_tokens)
+            if self.page_tokens < 1:
+                # explicit 0 (arg or RAY_TPU_SERVE_PAGE_TOKENS=0) raises —
+                # never silently the config default through a falsy `or`
+                raise ValueError(
+                    f"page_tokens must be >= 1, got {self.page_tokens}")
+            if self.arena_len % self.page_tokens != 0:
+                raise ValueError(
+                    f"arena_len ({self.arena_len}) must be a multiple of "
+                    f"page_tokens ({self.page_tokens})")
+            self._pages_per_slot = self.arena_len // self.page_tokens
+            kvp = int(conf.serve_kv_pages if kv_pages is None else kv_pages)
+            if kvp < 0:
+                raise ValueError(f"kv_pages must be >= 0, got {kvp}")
+            if kvp == 0:
+                # auto: the contiguous worst case (every slot could fill
+                # its whole logical range) + the reserved garbage page
+                kvp = self.slots * self._pages_per_slot + 1
+            self.num_pages = kvp
+            self._arena = PageArena(self.num_pages, self.page_tokens)
+            use_prefix = (conf.serve_prefix_cache if prefix_cache is None
+                          else bool(prefix_cache))
+            if use_prefix:
+                self._radix = RadixCache(self._arena)
+            # host-side page tables: logical page j of slot s lives at
+            # physical page read_tables[s, j]; 0 = the garbage page
+            # (unallocated reads are causally masked, redirected writes
+            # are absorbed)
+            self._read_tables = np.zeros(
+                (self.slots, self._pages_per_slot), np.int32)
+            self._write_tables = np.zeros(
+                (self.slots, self._pages_per_slot), np.int32)
+            # donated caches: the pool mutates in place across iterations;
+            # the tables are tiny per-call host->device uploads
+            self._prefill = jax.jit(partial(paged_prefill_into_slot, cfg),
+                                    donate_argnums=(6,))
+            self._step = jax.jit(partial(paged_decode_step, cfg),
+                                 donate_argnums=(5,))
+            self._caches = init_paged_caches(
+                cfg, self.slots, self.num_pages, self.page_tokens,
+                self._pages_per_slot, cache_dtype)
+        else:
+            from ray_tpu._private.config import env_flag_explicit
+
+            env_on = env_flag_explicit("serve_prefix_cache")
+            if prefix_cache or (prefix_cache is None and env_on):
+                # explicit intent conflicts loudly. "Explicit" means the
+                # constructor arg or the env var (parsed by the config
+                # layer's own bool rule); serve_prefix_cache=True arriving
+                # through config is indistinguishable from the default
+                # (which documents itself as paged-layout-only), so it
+                # simply does not apply to the contiguous baseline
+                raise ValueError(
+                    "prefix_cache requires kv_layout='paged' (the "
+                    "contiguous arena has no shareable pages)")
+            self.page_tokens = 0
+            self._pages_per_slot = 0
+            self.num_pages = 0
+            # donated caches: the arena mutates in place across iterations
+            self._prefill = jax.jit(partial(prefill_into_slot, cfg),
+                                    donate_argnums=(4,))
+            self._step = jax.jit(partial(slot_decode_step, cfg),
+                                 donate_argnums=(3,))
+            self._caches = init_slot_caches(cfg, self.slots, self.arena_len,
+                                            cache_dtype)
         self._slot_seqs: List[Optional[_Seq]] = [None] * self.slots
         self._prefill_rr = 0  # round-robin cursor over prefilling slots
         self._pending: deque = deque()
@@ -168,6 +270,7 @@ class ContinuousScheduler:
         self._n_admitted = 0
         self._n_retired = 0
         self._n_tokens = 0
+        self._n_prefix_hit_tokens = 0
         self._admitted_mid_flight = 0
         self._max_active_slots = 0
         self._peak_queue_depth = 0
@@ -179,10 +282,18 @@ class ContinuousScheduler:
 
     def max_prompt_len(self, max_new: int) -> int:
         """Longest admissible prompt for a given generation budget: the
-        padded prefill chunks AND prompt+new tokens must fit the arena."""
+        padded prefill chunks AND prompt+new tokens must fit the arena.
+        Page-aware: with a paged pool smaller than one slot's worst case,
+        the whole-pool page budget also caps a single sequence — an
+        over-budget request is rejected loudly at submit, before any
+        pages are allocated."""
         c = self.prefill_chunk
-        by_pad = (self.arena_len // c) * c
-        return min(by_pad, self.arena_len - max_new)
+        effective = self.arena_len
+        if self._paged:
+            effective = min(effective,
+                            self._arena.usable_pages * self.page_tokens)
+        by_pad = (effective // c) * c
+        return min(by_pad, effective - max_new)
 
     def submit(self, prompt_ids: List[int], *, max_new_tokens: int,
                temperature: float = 0.0, seed: int = 0,
@@ -231,7 +342,25 @@ class ContinuousScheduler:
             # consumer's loop is gone — nobody is listening; retire quietly
             seq.cancelled = True
 
+    def _release_slot_resources(self, seq: _Seq) -> None:
+        """Paged-arena teardown for one slot: drop the prefix-cache ref,
+        free owned pages, and zero the page-table rows (so an inactive
+        slot's decode gather/scatter touches only the garbage page)."""
+        if not self._paged or seq.slot is None:
+            return
+        slot = seq.slot
+        if seq.radix_node is not None:
+            self._radix.release(seq.radix_node)
+            seq.radix_node = None
+        if seq.owned_pages:
+            self._arena.free(seq.owned_pages)
+            seq.owned_pages = []
+        seq.table_fill = 0
+        self._read_tables[slot, :] = 0
+        self._write_tables[slot, :] = 0
+
     def _retire(self, seq: _Seq, reason: str) -> None:
+        self._release_slot_resources(seq)
         if seq.slot is not None:
             flight.instant(_F_RETIRE, seq.slot)
             self._slot_seqs[seq.slot] = None
@@ -242,6 +371,7 @@ class ContinuousScheduler:
         self._emit(seq, ("end", reason))
 
     def _fail(self, seq: _Seq, msg: str) -> None:
+        self._release_slot_resources(seq)
         if seq.slot is not None:
             self._slot_seqs[seq.slot] = None
             seq.slot = None
@@ -249,6 +379,39 @@ class ContinuousScheduler:
         self._n_retired += 1
         _m_retired.inc()
         self._emit(seq, ("err", msg))
+
+    def _ensure_pages(self, seq: _Seq, upto: int) -> bool:
+        """Grow the slot's page table so its logical view covers
+        [0, upto) tokens, evicting LRU unreferenced prefix-cache nodes
+        under pressure. On exhaustion the SEQUENCE fails cleanly (the
+        scheduler and its other slots keep running). Returns True if the
+        pages are present."""
+        from ray_tpu.serve._private.paging import OutOfPagesError
+
+        need = -(-upto // self.page_tokens)
+        missing = need - seq.table_fill
+        if missing <= 0:
+            return True
+        try:
+            pages = self._arena.alloc(missing)
+        except OutOfPagesError:
+            if self._radix is not None:
+                self._radix.evict(missing - self._arena.free_pages)
+            try:
+                pages = self._arena.alloc(missing)
+            except OutOfPagesError:
+                self._fail(seq, f"kv arena out of pages (need {missing} "
+                                f"more, {self._arena.free_pages} free of "
+                                f"{self._arena.usable_pages}; nothing "
+                                f"evictable)")
+                return False
+        slot = seq.slot
+        for j, p in enumerate(pages, start=seq.table_fill):
+            self._read_tables[slot, j] = p
+            self._write_tables[slot, j] = p
+        seq.owned_pages.extend(pages)
+        seq.table_fill = need
+        return True
 
     def _sample(self, seq: _Seq, logits_row) -> int:
         import numpy as np
@@ -276,8 +439,42 @@ class ContinuousScheduler:
             return True
         return seq.n_generated >= seq.max_new
 
+    def _splice_prefix(self, seq: _Seq) -> None:
+        """Prefix-cache lookup at admission: splice the longest cached
+        page-aligned prefix of the prompt into the slot's read table
+        (write entries stay on the garbage page — shared pages are
+        immutable) and jump the cursor past it. The last prompt token is
+        never matched: it must re-prefill to produce the first sampled
+        token's logits. The splice is clamped so the remaining tail's
+        padded chunks still fit the logical view (chunks restart at the
+        cursor, which is page- but not chunk-aligned)."""
+        pages, matched, node = self._radix.match(seq.prompt[:-1])
+        if matched == 0:
+            self._radix.note_miss()
+            return
+        T, C = self.page_tokens, self.prefill_chunk
+        keep = matched
+        while keep > 0:
+            rem = len(seq.prompt) - keep
+            if keep + (-(-rem // C)) * C <= self.arena_len:
+                break
+            keep -= T
+        if keep <= 0:
+            # the whole match was clamped away — nothing avoided, so this
+            # is a MISS for the hit-rate metrics
+            self._radix.release(node)
+            self._radix.note_miss()
+            return
+        self._radix.note_hit(keep)
+        n = keep // T
+        self._read_tables[seq.slot, :n] = pages[:n]
+        seq.cached_len = keep
+        seq.table_fill = n
+        seq.radix_node = node
+        self._n_prefix_hit_tokens += keep
+
     def _admit(self) -> None:
-        from ray_tpu.models.decode import reset_slot
+        from ray_tpu.models.decode import paged_reset_slot, reset_slot
 
         while True:
             with self._lock:
@@ -296,7 +493,21 @@ class ContinuousScheduler:
             seq.slot = free
             seq.state = _PREFILL
             self._slot_seqs[free] = seq
-            self._caches = reset_slot(self._caches, free)
+            if self._paged:
+                seq.cached_len = 0
+                seq.owned_pages = []
+                seq.radix_node = None
+                seq.table_fill = 0
+                self._read_tables[free, :] = 0
+                self._write_tables[free, :] = 0
+                if self._radix is not None:
+                    self._splice_prefix(seq)
+                seq.cursor = seq.cached_len
+                seq.remaining_prompt = seq.prompt[seq.cached_len:]
+                self._caches = paged_reset_slot(self._caches, free,
+                                                seq.cached_len)
+            else:
+                self._caches = reset_slot(self._caches, free)
             self._n_admitted += 1
             flight.instant(_F_ADMIT, free)
             _m_admitted.inc()
@@ -324,15 +535,32 @@ class ContinuousScheduler:
             if seq.cancelled:
                 self._retire(seq, "cancelled")
                 continue
+            # pages are needed only up to the REAL tokens this chunk
+            # writes — pad positions beyond them land on unallocated
+            # table entries, which the garbage-page write redirect
+            # absorbs by design (don't fail a fitting sequence for
+            # pad-only pages when the pool is tight)
+            if self._paged and not self._ensure_pages(
+                    seq, seq.cursor + min(len(seq.remaining_prompt),
+                                          self.prefill_chunk)):
+                continue  # failed cleanly; other slots keep running
             chunk = seq.remaining_prompt[:self.prefill_chunk]
             seq.remaining_prompt = seq.remaining_prompt[self.prefill_chunk:]
             real = len(chunk)
             padded = chunk + [0] * (self.prefill_chunk - real)
             tokens = jnp.asarray([padded], jnp.int32)
             t0 = flight.now()
-            logits, self._caches = self._prefill(
-                self.params, tokens, np.int32(real), np.int32(seq.slot),
-                self._caches)
+            if self._paged:
+                logits, self._caches = self._prefill(
+                    self.params, tokens, np.int32(real), np.int32(seq.slot),
+                    jnp.asarray(self._read_tables[seq.slot]),
+                    jnp.asarray(self._write_tables[seq.slot]),
+                    self._caches)
+                seq.cursor += real
+            else:
+                logits, self._caches = self._prefill(
+                    self.params, tokens, np.int32(real), np.int32(seq.slot),
+                    self._caches)
             if t0:
                 # jax dispatch is async: without a sync the span would
                 # time the DISPATCH and smear the real prefill compute
@@ -342,6 +570,9 @@ class ContinuousScheduler:
             flight.span_since(_F_PREFILL, t0)
             self._n_prefill_chunks += 1
             _m_prefill_chunks.inc()
+            if self._paged and self._radix is not None \
+                    and not seq.remaining_prompt:
+                self._offer_prompt_pages(seq)
             if not seq.remaining_prompt:
                 # prompt fully resident: sample the first token NOW — this
                 # is the time-to-first-token moment
@@ -354,6 +585,37 @@ class ContinuousScheduler:
                     seq.next_token = tok
             return True
         return False
+
+    def _offer_prompt_pages(self, seq: _Seq) -> None:
+        """Prompt fully resident: offer its full pages to the radix cache
+        so a later admit with the same prefix splices instead of
+        re-prefilling. Pages the tree adopts become shared read-only
+        (write-table entries redirect to the garbage page — they are
+        never written again anyway: pads and decode tokens land at
+        positions >= the prompt length, i.e. in later pages); spans
+        another sequence cached first stay slot-owned duplicates. The
+        slot swaps its admission-time node ref for the deeper inserted
+        node, which pins the whole path against eviction while it
+        decodes."""
+        T = self.page_tokens
+        ins_len = (len(seq.prompt) // T) * T
+        if ins_len <= seq.cached_len:
+            return
+        n = ins_len // T
+        slot = seq.slot
+        offered = [int(x) for x in self._read_tables[slot, :n]]
+        dups, node = self._radix.insert(seq.prompt[:ins_len], offered)
+        adopted = set(offered) - set(dups)
+        if adopted:
+            seq.owned_pages = [p for p in seq.owned_pages
+                               if p not in adopted]
+            for j in range(n):
+                if int(self._write_tables[slot, j]) in adopted:
+                    self._write_tables[slot, j] = 0
+        if node is not None:
+            if seq.radix_node is not None:
+                self._radix.release(seq.radix_node)
+            seq.radix_node = node
 
     def _decode_once(self) -> bool:
         """One batched decode iteration over every DECODE slot."""
@@ -369,21 +631,30 @@ class ContinuousScheduler:
             if seq.cancelled:
                 self._retire(seq, "cancelled")
                 continue
+            if self._paged and not self._ensure_pages(seq, seq.cursor + 1):
+                continue  # this sequence failed cleanly; others continue
             toks[i] = seq.next_token
             active[i] = 1
             live.append(seq)
         if not live:
             return False
         t0 = flight.now()
-        logits, self._caches = self._step(
-            self.params, jnp.asarray(toks), jnp.asarray(active),
-            self._caches)
+        if self._paged:
+            logits, self._caches = self._step(
+                self.params, jnp.asarray(toks), jnp.asarray(active),
+                jnp.asarray(self._read_tables),
+                jnp.asarray(self._write_tables), self._caches)
+        else:
+            logits, self._caches = self._step(
+                self.params, jnp.asarray(toks), jnp.asarray(active),
+                self._caches)
         la = np.asarray(logits)
         flight.span_since(_F_DECODE, t0)
         self._n_steps += 1
         _m_steps.inc()
         self._max_active_slots = max(self._max_active_slots, len(live))
         for seq in live:
+            seq.cursor += 1
             tok = self._sample(seq, la[seq.slot])
             if self._emit_token(seq, tok):
                 self._retire(seq, "eos" if self.eos_id is not None
@@ -444,16 +715,28 @@ class ContinuousScheduler:
         for seq in list(self._slot_seqs):
             if seq is not None:
                 self._fail(seq, "scheduler shut down")
+        if self._radix is not None:
+            # every slot ref is gone; drain the cache so the page gauge
+            # returns to zero (chaos_soak asserts this after a kill)
+            self._radix.clear()
 
     @property
     def closed(self) -> bool:
         return self._closed
 
+    def compiled_programs(self) -> int:
+        """Total compiled program count across the scheduler's two jitted
+        entry points — the two-compiles contract says this is exactly 2
+        (one prefill shape + one decode shape) no matter how lengths,
+        pages and prefix hits churn."""
+        return int(self._prefill._cache_size() + self._step._cache_size())
+
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             q = len(self._pending)
-        return {
+        out = {
             "mode": "continuous",
+            "kv_layout": self.kv_layout,
             "slots": self.slots,
             "prefill_chunk": self.prefill_chunk,
             "arena_len": self.arena_len,
@@ -470,4 +753,13 @@ class ContinuousScheduler:
             "peak_queue_depth": self._peak_queue_depth,
             "queue_depth": q,
             "active_slots": sum(1 for s in self._slot_seqs if s is not None),
+            "compiled_programs": self.compiled_programs(),
         }
+        if self._paged:
+            out["page_tokens"] = self.page_tokens
+            out["pages_per_slot"] = self._pages_per_slot
+            out.update(self._arena.stats())
+            if self._radix is not None:
+                out.update(self._radix.stats())
+                out["prefix_hit_tokens"] = self._n_prefix_hit_tokens
+        return out

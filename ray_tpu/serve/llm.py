@@ -4,13 +4,17 @@ The reference leaves model serving to torch/vLLM inside replicas (its
 `ray.serve.llm` wraps vLLM engines); here the decode loop is TPU-native
 and the batching is CONTINUOUS (iteration-level, ISSUE 9):
 
-  * a slotted KV-cache arena (`models.decode.SlotKVCache`) plus ONE
-    fixed-shape jitted decode step over all slots per iteration; new
-    requests are admitted into free slots between iterations (chunked
-    prefill), finished/EOS/cancelled sequences retire their slot
-    immediately — ≈ vLLM's iteration-level scheduler, not a
-    flush-and-drain `@serve.batch` window (kept as `scheduler="batch"`,
-    the measured baseline);
+  * a PAGED KV arena (`models.decode.PagedKVCache`, ISSUE 13) plus ONE
+    fixed-shape jitted decode step over all slots per iteration; slots
+    own page tables instead of worst-case `max_seq_len` ranges, a radix
+    prefix cache turns shared system-prompt/few-shot preambles into a
+    page-table splice + cursor jump at admission, new requests are
+    admitted into free slots between iterations (chunked prefill),
+    finished/EOS/cancelled sequences retire their slot (and pages)
+    immediately — ≈ vLLM's PagedAttention + SGLang's RadixAttention
+    scheduling, not a flush-and-drain `@serve.batch` window (kept as
+    `scheduler="batch"`, the measured baseline; `kv_layout="contiguous"`
+    keeps the PR-9 arena);
   * token streaming: `{"prompt": ..., "stream": true}` returns an async
     generator consuming the scheduler's per-slot token queue — the stream
     rides the same batched program as everything else (no per-stream
@@ -57,6 +61,7 @@ class LLMServerImpl:
     through the object arena unless ``share_weights=False``."""
 
     def __init__(self, preset: str = "llama_debug",
+                 preset_overrides: Optional[Dict[str, Any]] = None,
                  max_new_tokens: int = 16,
                  temperature: float = 0.0,
                  max_batch_size: int = 8,
@@ -66,6 +71,10 @@ class LLMServerImpl:
                  slots: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
                  arena_len: Optional[int] = None,
+                 kv_layout: Optional[str] = None,
+                 page_tokens: Optional[int] = None,
+                 kv_pages: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None,
                  share_weights: bool = True,
                  weights_key: Optional[str] = None,
                  weights_bcast: Optional[Dict[str, Any]] = None,
@@ -81,7 +90,10 @@ class LLMServerImpl:
                 f"{scheduler!r}")
         self._jnp = jnp
         self._jax = jax
-        self.cfg = getattr(presets, preset)()
+        # preset fields (e.g. a wider max_seq_len context window for long
+        # few-shot preambles) are overridable per deployment; the KV arena
+        # and admission limits follow cfg.max_seq_len automatically
+        self.cfg = getattr(presets, preset)(**(preset_overrides or {}))
         self.max_new_tokens = max_new_tokens
         self.temperature = temperature
         self._max_batch = max_batch_size
@@ -111,7 +123,14 @@ class LLMServerImpl:
         can_share = share_weights and (params_loader is None
                                        or weights_key is not None)
         if can_share:
-            key = weights_key or f"llm:{preset}:seed0"
+            # preset overrides change the parameter shapes — fold them
+            # into the default share key so differently-configured
+            # deployments never attach to each other's arena copy
+            ov = ""
+            if preset_overrides:
+                ov = ":" + ",".join(f"{k}={preset_overrides[k]}"
+                                    for k in sorted(preset_overrides))
+            key = weights_key or f"llm:{preset}{ov}:seed0"
             host, self._weights_info = _weights.get_or_publish(key, load)
         else:
             host, self._weights_info = load(), {"mode": "local",
@@ -143,7 +162,9 @@ class LLMServerImpl:
             self._sched = ContinuousScheduler(
                 self.cfg, self.params, slots=slots,
                 prefill_chunk=prefill_chunk, arena_len=arena_len,
-                eos_id=eos_id)
+                eos_id=eos_id, kv_layout=kv_layout,
+                page_tokens=page_tokens, kv_pages=kv_pages,
+                prefix_cache=prefix_cache)
 
     # ------------------------------------------------------- continuous
 

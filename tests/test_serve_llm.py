@@ -9,6 +9,12 @@ slots (queues, no drops), the `_BatchQueue` hardening (flush-race, per-
 item errors, deploy-time overrides), and the shared-weights pin
 accounting (second replica adds no arena bytes; replica death releases
 its pins).
+
+Since ISSUE 13 the scheduler's default KV layout is PAGED with the radix
+prefix cache on — this suite intentionally runs the defaults end to end;
+the paged/radix-specific contracts (parity vs the contiguous arena,
+capacity at fixed pool bytes, eviction, two-compiles guard) live in
+tests/test_paged_kv.py.
 """
 
 import asyncio
