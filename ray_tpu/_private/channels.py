@@ -604,6 +604,83 @@ def close_channels_nowait(core, local_channels, specs) -> None:
             timeout=10))
 
 
+def open_local_factory(core):
+    """(open_local, local_dict, release_pins) triple over this process's
+    arena — the pin/open bookkeeping every channel run loop needs (stage
+    loops, podracer runners/learners, streaming data stages), shared so
+    the pin contract lives in one place."""
+    local: Dict[bytes, "LocalChannel"] = {}
+
+    def open_local(spec: "ChannelSpec") -> "LocalChannel":
+        ch = local.get(spec.key())
+        if ch is None:
+            _pin_local_channel(core, spec)
+            ch = LocalChannel(core.arena, spec)
+            local[spec.key()] = ch
+        return ch
+
+    def release_pins() -> None:
+        from ray_tpu._private.ids import ObjectID
+
+        for key in local:
+            core._schedule_unpin(ObjectID(key))
+
+    return open_local, local, release_pins
+
+
+def close_specs(core, specs, timeout: float = 30) -> None:
+    """Blocking teardown-path close fan-out: one channel_close per spec,
+    per-spec failures logged and swallowed (a dead node's channels are
+    already closed by its supervisor's death paths). Shared by the
+    pipeline trainer, the sebulba topology and the streaming data
+    executor so the shutdown contract lives in one place."""
+
+    async def close_all():
+        for spec in specs:
+            try:
+                await core.clients.get(tuple(spec.node_addr)).call(
+                    "channel_close",
+                    {"channel_id": spec.channel_id}, timeout=10)
+            except Exception:
+                logger.debug("channel_close failed", exc_info=True)
+
+    if specs:
+        try:
+            core._run(close_all(), timeout=timeout)
+        except Exception:
+            logger.debug("channel close fan-out failed", exc_info=True)
+
+
+def free_and_unpin_specs(core, specs, timeout: float = 60) -> None:
+    """Blocking teardown-path release fan-out: store_free + the driver's
+    creation-pin store_unpin per spec. Failures are logged and left to
+    the supervisor's dead-client sweep (the departing-driver fallback)."""
+    from ray_tpu._private.core_worker import _m_pins
+
+    async def release_all():
+        for spec in specs:
+            client = core.clients.get(tuple(spec.node_addr))
+            try:
+                await client.call(
+                    "store_free",
+                    {"object_ids": [spec.channel_id]}, timeout=10)
+                await client.call(
+                    "store_unpin",
+                    {"object_id": spec.channel_id,
+                     "client": core._store_client_id}, timeout=10)
+                _m_pins.dec()
+            except Exception:
+                logger.debug(
+                    "channel pin release failed (reclaimed by the "
+                    "supervisor's dead-client sweep)", exc_info=True)
+
+    if specs:
+        try:
+            core._run(release_all(), timeout=timeout)
+        except Exception:
+            logger.debug("channel release fan-out failed", exc_info=True)
+
+
 def resolve_actor_placement(core, actor_id, views=None) -> dict:
     """Wait (bounded) for the actor to be ALIVE, then snapshot its
     worker/node identity. Channel placement pins to this incarnation:

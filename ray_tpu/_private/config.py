@@ -133,6 +133,18 @@ class Config:
     # total budget for one cross-node per-step push (chunk window +
     # commit); the commit side also waits for remote reader acks under it
     channel_remote_timeout_s: float = 120.0
+    # ---- streaming data plane (data/_internal/streaming.py) ----
+    # slot-ring depth of every streaming-ingest channel (reader ->
+    # transform -> batcher -> consumer): how many blocks/batches each
+    # stage may run ahead of its consumer. Writer backpressure IS the
+    # prefetch bound of Dataset.stream_batches. Explicit zeros are
+    # REJECTED at build (the PR-8/PR-9 falsy-zero lesson)
+    data_stream_depth: int = 4
+    # default windowed-shuffle buffer ROWS inside the batcher stage when
+    # a stream doesn't pass shuffle_buffer= itself; 0 (the default) means
+    # no shuffle, but an EXPLICIT RAY_TPU_DATA_SHUFFLE_BUFFER=0 raises at
+    # build instead of silently meaning "off"
+    data_shuffle_buffer: int = 0
     # ---- Podracer RL topologies (rllib/podracer.py) ----
     # slot-ring depth of each runner->learner trajectory channel: how many
     # rollout batches a runner may stream ahead of its learner consuming

@@ -186,12 +186,66 @@ class Dataset:
                      batch_format: str = "numpy", drop_last: bool = False,
                      prefetch_batches: int = 1,
                      local_shuffle_buffer_size: Optional[int] = None,
-                     local_shuffle_seed: Optional[int] = None):
+                     local_shuffle_seed: Optional[int] = None,
+                     streaming: bool = False):
+        """Iterate fixed-size batches.
+
+        ``streaming=True`` routes a read->map plan through the compiled
+        streaming pipeline (`stream_batches`) instead of the task-based
+        executor: shard readers -> transform actors -> batcher over
+        slot-ring channels, ``prefetch_batches`` becoming the channel
+        depth (the backpressure bound). Streaming yields numpy batches
+        only and its windowed shuffle runs inside the batcher stage
+        (same knobs, its own seeded stream) — plans that need barriers
+        or materialized refs raise rather than silently falling back.
+        """
+        if streaming:
+            if batch_format != "numpy":
+                raise ValueError(
+                    "streaming=True yields numpy batches only "
+                    f"(got batch_format={batch_format!r})")
+            return self.stream_batches(
+                batch_size=batch_size,
+                drop_last=drop_last,
+                # the task path's default (1) means "default depth" here,
+                # not a depth-1 ring; explicit zeros still raise inside
+                prefetch_batches=prefetch_batches,
+                shuffle_buffer=local_shuffle_buffer_size,
+                seed=local_shuffle_seed)
         return self.iterator().iter_batches(
             batch_size=batch_size, batch_format=batch_format,
             drop_last=drop_last, prefetch_batches=prefetch_batches,
             local_shuffle_buffer_size=local_shuffle_buffer_size,
             local_shuffle_seed=local_shuffle_seed)
+
+    def stream_batches(self, *, batch_size: Optional[int] = 256,
+                       epochs: int = 1, seed: Optional[int] = 0,
+                       shuffle_buffer: Optional[int] = None,
+                       num_readers: Optional[int] = None,
+                       prefetch_batches: Optional[int] = None,
+                       depth: Optional[int] = None,
+                       drop_last: bool = False, **kw):
+        """Consume this dataset through the compiled streaming pipeline
+        (`data/_internal/streaming.py`): shard readers -> transform
+        actors -> a fixed-shape batcher over depth-k channels, zero
+        steady-state control-plane RPCs per stage. Yields numpy-dict
+        batches for ``epochs`` passes, the shard order re-seeded per
+        epoch; the iterator's ``.epoch_stats`` carries per-epoch stall
+        and RPC accounting and ``.executor`` exposes ``feed()`` for
+        handing batches to a trainer without a copy."""
+        from ray_tpu.data._internal.streaming import StreamingBatches
+
+        if depth is None and prefetch_batches is not None \
+                and prefetch_batches != 1:
+            # depth= is the precise knob (any ring depth, including 1);
+            # prefetch_batches rides along from iter_batches, whose
+            # task-path default of 1 means "default depth" here. An
+            # explicit 0 on either raises inside (the falsy-zero lesson)
+            depth = prefetch_batches
+        return StreamingBatches(
+            self._ops, batch_size=batch_size, epochs=epochs, seed=seed,
+            shuffle_buffer=shuffle_buffer, num_readers=num_readers,
+            depth=depth, drop_last=drop_last, **kw)
 
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
         for block in self.iter_blocks():

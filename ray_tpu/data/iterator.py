@@ -27,6 +27,13 @@ class DataIterator:
     def _block_iter(self) -> Iterator[Block]:
         raise NotImplementedError
 
+    def _block_iter_windowed(self, window: int) -> Iterator[Block]:
+        """Block stream with up to ``window`` fetches bound ahead.
+        Subclasses that resolve refs override this to keep a window of
+        gets in flight; the base just streams (prefetching then happens
+        only at the batch level)."""
+        return self._block_iter()
+
     def iter_batches(
         self,
         *,
@@ -37,7 +44,16 @@ class DataIterator:
         local_shuffle_buffer_size: Optional[int] = None,
         local_shuffle_seed: Optional[int] = None,
     ) -> Iterator[Any]:
-        blocks = self._block_iter()
+        # prefetch overlaps at BOTH levels: block fetches are bound
+        # ahead with a window (the consumer no longer eats a store
+        # round-trip at every block boundary) and finished batches queue
+        # through a background fill thread so a computing consumer finds
+        # the next one ready
+        if prefetch_batches and prefetch_batches > 0:
+            blocks = self._block_iter_windowed(
+                max(2, int(prefetch_batches)))
+        else:
+            blocks = self._block_iter()
         if local_shuffle_buffer_size:
             blocks = _shuffle_blocks(blocks, local_shuffle_buffer_size,
                                      local_shuffle_seed)
@@ -182,6 +198,26 @@ class _BlockStreamIterator(DataIterator):
         for ref, _meta in self._ds._stream():
             yield ray_tpu.get(ref)
 
+    def _block_iter_windowed(self, window: int) -> Iterator[Block]:
+        """Bound-ahead block resolution: pull up to ``window`` refs from
+        the task stream (which also drives task submission ahead) and
+        resolve them in ONE batched get — the PR-2 batched-locate path
+        (one store_locate_batch RPC per node per window instead of a
+        locate round-trip per block). The per-block boundary stall the
+        synchronous pull paid collapses into one amortized wait per
+        window, hidden by the batch-level fill thread."""
+        from collections import deque
+
+        pend: deque = deque()
+        for ref, _meta in self._ds._stream():
+            pend.append(ref)
+            if len(pend) >= window:
+                for b in ray_tpu.get(list(pend)):
+                    yield b
+                pend.clear()
+        if pend:
+            yield from ray_tpu.get(list(pend))
+
 
 class _SplitCoordinator:
     """Actor: runs ONE streaming execution, hands blocks to n consumers
@@ -207,11 +243,18 @@ class _SplitCoordinator:
         # consumer sees the same block count (±1) — lockstep SPMD loops with
         # per-batch collectives need matching iteration counts.
         self._buffers: Dict[int, List[Any]] = {i: [] for i in range(n)}
-        # Handed-out refs are pinned here until the consumer acks having
-        # read the block — returning a ref from an actor method drops the
-        # actor's local reference, and without the pin the owner could GC
-        # the block before the consumer's get lands.
+        # Handed-out refs are pinned here (as (ref, generation)) until
+        # the consumer acks having read the block — returning a ref from
+        # an actor method drops the actor's local reference, and without
+        # the pin the owner could GC the block before the consumer's get
+        # lands. The generation tag lets requeue() drop a stale return.
         self._pinned = {}
+        # Blocks a consumer handed back unread (a prefetch lookahead
+        # abandoned on early exit): served to the next requester before
+        # the stream is pulled, so sibling ranks' epoch stays complete.
+        # Cleared on epoch restart — the fresh execution re-reads every
+        # block, so serving a stale one would duplicate its rows.
+        self._returned: List[Any] = []
         self._deal_idx = 0  # arrival index for equal-mode round-robin
         self._next_token = 0
 
@@ -229,12 +272,19 @@ class _SplitCoordinator:
             self._generation = epoch
             self._gen = execute_plan(self._ops, self._concurrency)
             self._done = False
+            # stranded returns belong to the superseded pass (every rank
+            # moved on, nobody will drain them) and the fresh execution
+            # re-produces those blocks — keeping them would either hang
+            # the restart or duplicate their rows into this epoch
+            self._returned.clear()
         if epoch > self._generation:
             # stream for this epoch not open yet (other ranks still on the
             # previous pass) — caller polls again
             return "PENDING"
         ref = None
-        if self._equal:
+        if self._returned:
+            ref = self._returned.pop(0)
+        elif self._equal:
             buf = self._buffers[rank % self._n]
             while not buf and not self._done:
                 try:
@@ -255,11 +305,22 @@ class _SplitCoordinator:
             return None
         token = self._next_token
         self._next_token += 1
-        self._pinned[token] = ref
+        self._pinned[token] = (ref, self._generation)
         return token, ref
 
     def release(self, token: int) -> None:
         self._pinned.pop(token, None)
+
+    def requeue(self, token: int) -> None:
+        """Hand an UNREAD block back (an abandoned prefetch lookahead):
+        it goes to the front of the stream for the next requester —
+        release() would silently drop its rows from the epoch. A return
+        landing after the stream restarted for a newer epoch is DROPPED:
+        the new execution re-reads that block, so serving the stale one
+        would duplicate its rows."""
+        entry = self._pinned.pop(token, None)
+        if entry is not None and entry[1] == self._generation:
+            self._returned.append(entry[0])
 
 
 class _StreamSplitIterator(DataIterator):
@@ -285,3 +346,44 @@ class _StreamSplitIterator(DataIterator):
             block = ray_tpu.get(ref)
             self._coord.release.remote(token)  # fire-and-forget unpin
             yield block
+
+    def _block_iter_windowed(self, window: int) -> Iterator[Block]:
+        """One-ahead pipelining of the coordinator round-trip: the NEXT
+        block assignment is requested before the current block is
+        fetched, so the two serial RPCs the synchronous pull paid per
+        block (assignment + get) overlap with the consumer. Assignment
+        order is unchanged — this rank just holds one extra block, which
+        is drained (and its pin released) if the consumer stops early."""
+        import time as _time
+
+        self._epoch += 1
+        epoch = self._epoch
+        fut = self._coord.next_block_ref.remote(self._rank, epoch)
+        try:
+            while True:
+                out = ray_tpu.get(fut)
+                fut = None
+                if out is None:
+                    return
+                if out == "PENDING":
+                    _time.sleep(0.02)
+                    fut = self._coord.next_block_ref.remote(
+                        self._rank, epoch)
+                    continue
+                token, ref = out
+                fut = self._coord.next_block_ref.remote(self._rank, epoch)
+                block = ray_tpu.get(ref)
+                self._coord.release.remote(token)  # fire-and-forget unpin
+                yield block
+        finally:
+            if fut is not None:
+                # drain the lookahead so an early-exiting consumer never
+                # strands its assigned block: requeue hands the UNREAD
+                # block back to the coordinator for a sibling rank
+                # (release would silently shrink the shared epoch)
+                try:
+                    out = ray_tpu.get(fut, timeout=30)
+                    if isinstance(out, tuple):
+                        self._coord.requeue.remote(out[0])
+                except Exception:
+                    pass

@@ -316,26 +316,9 @@ def _broadcast_tree_recv(col, b: Dict[str, Any], template_tree):
 # ------------------------------------------------------- actor-side loops
 
 
-def _open_local_factory(core):
-    """(open_local, local_dict, release_pins) triple over this process's
-    arena — the pipeline stage loop's pin/open bookkeeping, shared."""
-    local: Dict[bytes, _channels.LocalChannel] = {}
-
-    def open_local(spec: _channels.ChannelSpec) -> _channels.LocalChannel:
-        ch = local.get(spec.key())
-        if ch is None:
-            _channels._pin_local_channel(core, spec)
-            ch = _channels.LocalChannel(core.arena, spec)
-            local[spec.key()] = ch
-        return ch
-
-    def release_pins() -> None:
-        from ray_tpu._private.ids import ObjectID
-
-        for key in local:
-            core._schedule_unpin(ObjectID(key))
-
-    return open_local, local, release_pins
+# (open_local, local_dict, release_pins) run-loop bookkeeping — hoisted
+# into _private/channels.py, shared with the streaming data stages
+_open_local_factory = _channels.open_local_factory
 
 
 class _SebulbaRunnerImpl:
@@ -913,8 +896,6 @@ class SebulbaTopology:
                  timeout: float = 30) -> Dict[str, Any]:
         """Close every channel, drain the loops, release the pins,
         (optionally) kill the actors. Idempotent."""
-        from ray_tpu._private.core_worker import _m_pins
-
         self._dead = True
         with self._teardown_lock:
             if self._torn:
@@ -932,50 +913,14 @@ class SebulbaTopology:
             except Exception:
                 pass
 
-        async def close_all():
-            for spec in self._all_specs:
-                try:
-                    await core.clients.get(tuple(spec.node_addr)).call(
-                        "channel_close",
-                        {"channel_id": spec.channel_id}, timeout=10)
-                except Exception:
-                    logger.debug("channel_close failed", exc_info=True)
-
-        if self._all_specs:
-            try:
-                core._run(close_all(), timeout=30)
-            except Exception:
-                logger.debug("sebulba close fan-out failed", exc_info=True)
+        _channels.close_specs(core, self._all_specs)
         stats: Dict[str, Any] = {"loops": []}
         for ref in self._loop_refs:
             try:
                 stats["loops"].append(core.get([ref], timeout=timeout)[0])
             except Exception:
                 stats["loops"].append(None)
-
-        async def release_all():
-            for spec in self._all_specs:
-                client = core.clients.get(tuple(spec.node_addr))
-                try:
-                    await client.call(
-                        "store_free",
-                        {"object_ids": [spec.channel_id]}, timeout=10)
-                    await client.call(
-                        "store_unpin",
-                        {"object_id": spec.channel_id,
-                         "client": core._store_client_id}, timeout=10)
-                    _m_pins.dec()
-                except Exception:
-                    logger.debug(
-                        "channel pin release failed (reclaimed by the "
-                        "supervisor's dead-client sweep)", exc_info=True)
-
-        if self._all_specs:
-            try:
-                core._run(release_all(), timeout=60)
-            except Exception:
-                logger.debug("sebulba release fan-out failed",
-                             exc_info=True)
+        _channels.free_and_unpin_specs(core, self._all_specs)
         if kill_actors:
             import ray_tpu
 

@@ -805,6 +805,161 @@ def run_pipeline_chaos(
         chaos.reset()
 
 
+def _data_chaos_transform(b):
+    """Module-level so the chaos workload's map chain pickles cleanly
+    into reader/transform actors and remote tasks alike."""
+    return {"id": b["id"] * 3 + 1}
+
+
+def run_data_chaos(
+    seed: int,
+    *,
+    drop_prob: float = 0.02,
+    dup_prob: float = 0.05,
+    delay_prob: float = 0.05,
+    delay_max_ms: int = 20,
+    kills: bool = True,
+) -> None:
+    """One seeded chaos run against the streaming data plane.
+
+    Builds a 2-node cluster and places the ingest stages ALTERNATING
+    across it (readers and the batcher opposite the driver, transforms
+    on the driver's node), so every reader->transform->batcher->consumer
+    hop is a cross-node mirror push — chunked small so each block/batch
+    streams several attacked ``channel_write_chunk`` + ``channel_commit``
+    frames. Two full epochs (shuffled) must match the task-based
+    loader's batches EXACTLY at the same seed — chaos may cost retries,
+    never a wrong or reordered batch (absolute slot-ring versions make
+    dropped/duplicated push frames converge). With ``kills``, a reader
+    is then hard-killed mid-epoch: the consumer must surface a clean
+    ChannelClosedError/ActorDiedError (never a hang, never a silently
+    truncated epoch) and the driver's channel pins must return to
+    baseline.
+    """
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private import chaos
+    from ray_tpu._private.chaos import FaultController
+    from ray_tpu._private.config import Config
+    from ray_tpu.cluster_utils import Cluster
+
+    cfg = Config.from_env()
+    cfg.chaos_seed = seed
+    cfg.chaos_drop_prob = drop_prob
+    cfg.chaos_dup_prob = dup_prob
+    cfg.chaos_delay_prob = delay_prob
+    cfg.chaos_delay_max_ms = delay_max_ms
+    cfg.chaos_methods = CHAOS_METHODS
+    # blocks/batches stream as several chunk frames per push
+    cfg.object_transfer_chunk_bytes = 2048
+
+    cluster = Cluster(config=cfg)
+    try:
+        cluster.add_node(num_cpus=4, resources={"n0": 100})
+        cluster.add_node(num_cpus=4, resources={"n1": 100})
+        cluster.wait_for_nodes(2)
+        ray_tpu.init(address=cluster.address)
+        chaos.set_fault_controller(FaultController(
+            seed=seed, drop_prob=drop_prob, dup_prob=dup_prob,
+            delay_prob=delay_prob, delay_max_ms=delay_max_ms,
+            methods=CHAOS_METHODS))
+
+        from ray_tpu import data as rd
+        from ray_tpu._private import api as _api
+        from ray_tpu._private.exceptions import (ActorDiedError,
+                                                 ChannelClosedError,
+                                                 TaskError)
+        from ray_tpu.data._internal import streaming as dstream
+
+        # which resource tag is the driver's node? (stage placement
+        # alternates against it so every hop crosses the wire)
+        @ray_tpu.remote
+        def _where():
+            from ray_tpu._private import api
+
+            return tuple(api._core.supervisor_addr)
+
+        core = _api._core
+        n0_addr = ray_tpu.get(
+            _where.options(resources={"n0": 1}).remote(), timeout=60)
+        here = "n0" if tuple(core.supervisor_addr) == n0_addr else "n1"
+        there = "n1" if here == "n0" else "n0"
+
+        def store_pins():
+            stats = core._run(core.clients.get(core.supervisor_addr).call(
+                "store_stats", timeout=60))
+            return stats["pins_total"]
+
+        d = rd.range(600, parallelism=12).map_batches(
+            _data_chaos_transform)
+        R = 2
+        base_seed = 100 + seed
+        stage_kw = dict(
+            reader_options=[{"resources": {there: 1}}] * R,
+            transform_options=[{"resources": {here: 1}}] * R,
+            batcher_options={"resources": {there: 1}})
+
+        pins_before = store_pins()
+        ex = dstream.StreamingExecutor(
+            d._ops, batch_size=40, epochs=2, seed=base_seed,
+            shuffle_buffer=96, num_readers=R, **stage_kw)
+        assert ex.is_channel_backed and ex.channel_depth > 1, (
+            "data chaos run is not on the slot-ring channel substrate")
+        got = [[], []]
+        for b in ex.batches():
+            got[len(ex.epoch_stats)].append(b)
+        for epoch, act in enumerate(got, start=1):
+            exp = list(dstream.task_epoch_batches(
+                d._ops, batch_size=40, epoch=epoch, seed=base_seed,
+                shuffle_buffer=96))
+            assert len(exp) == len(act), (
+                f"epoch {epoch}: {len(act)} streamed batches != "
+                f"{len(exp)} from the task loader")
+            for i, (e, a) in enumerate(zip(exp, act)):
+                for k in e:
+                    assert np.array_equal(e[k], a[k]), (
+                        f"epoch {epoch} batch {i} column {k}: streaming "
+                        f"diverged from the task loader — chaos "
+                        f"corrupted the stream")
+        ex.shutdown()
+        _drain_pins_to_baseline(pins_before)
+
+        if kills:
+            # reader hard-kill MID-EPOCH: the in-flight epoch must fail
+            # clean — a partially-consumed epoch raises, never truncates
+            ex = dstream.StreamingExecutor(
+                d._ops, batch_size=10, epochs=3, seed=base_seed,
+                num_readers=R, depth=2, **stage_kw)
+            it = ex.batches()
+            for _ in range(3):
+                next(it)
+            ray_tpu.kill(ex._readers[seed % R])
+            try:
+                deadline = time.monotonic() + 120
+                while time.monotonic() < deadline:
+                    next(it)
+                raise AssertionError(
+                    "stream kept yielding past a dead reader")
+            except (ChannelClosedError, ActorDiedError, TaskError) as e:
+                msg = str(e).lower()
+                assert ("closed" in msg or "dead" in msg or "died" in msg
+                        or isinstance(e, (ActorDiedError, TaskError))), (
+                    f"unclean error after reader kill: {e!r}")
+            except StopIteration:
+                raise AssertionError(
+                    "stream ended silently after a mid-epoch reader kill")
+            ex.shutdown()
+            _drain_pins_to_baseline(pins_before)
+    finally:
+        chaos.set_fault_controller(None)  # calm teardown
+        _maybe_flight_dump()  # before shutdown, while dumps exist
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        cluster.shutdown()
+        chaos.reset()
+
+
 def run_podracer_chaos(
     seed: int,
     *,
@@ -1644,6 +1799,12 @@ def _run_one(seed: int, args) -> None:
             drop_prob=args.drop, dup_prob=args.dup, delay_prob=args.delay,
             delay_max_ms=args.delay_max_ms, kills=not args.no_kills)
         return
+    if args.data:
+        run_data_chaos(
+            seed,
+            drop_prob=args.drop, dup_prob=args.dup, delay_prob=args.delay,
+            delay_max_ms=args.delay_max_ms, kills=not args.no_kills)
+        return
     if args.collective_overlap:
         run_collective_overlap_chaos(
             seed,
@@ -1694,6 +1855,13 @@ def main() -> int:
                              "frames) under drop/dup/delay must train to "
                              "EXACT reference losses; a mid-flush stage "
                              "kill must fail clean and unwind")
+    parser.add_argument("--data", action="store_true",
+                        help="attack the streaming data plane: every "
+                             "reader->transform->batcher->consumer hop a "
+                             "cross-node chunked push under drop/dup/delay; "
+                             "two shuffled epochs must match the task-based "
+                             "loader's batches EXACTLY, a mid-epoch reader "
+                             "kill must fail clean and unwind pins")
     parser.add_argument("--flight-dump", default="",
                         help="directory for a merged flight-recorder "
                              "timeline (Perfetto JSON) per seed; a red "

@@ -346,15 +346,7 @@ def _run_stage_loop(rt: _StageRuntime, plan: _StagePlan) -> dict:
     if core is None:
         raise RuntimeError("pipeline stage loop outside a worker process")
 
-    local: Dict[bytes, _channels.LocalChannel] = {}
-
-    def open_local(spec: _channels.ChannelSpec) -> _channels.LocalChannel:
-        ch = local.get(spec.key())
-        if ch is None:
-            _channels._pin_local_channel(core, spec)
-            ch = _channels.LocalChannel(core.arena, spec)
-            local[spec.key()] = ch
-        return ch
+    open_local, local, release_pins = _channels.open_local_factory(core)
 
     def open_reader(spec) -> Optional[_channels.LocalChannel]:
         return open_local(spec) if spec is not None else None
@@ -368,12 +360,6 @@ def _run_stage_loop(rt: _StageRuntime, plan: _StagePlan) -> dict:
         if w._mirror is not None:
             remote_specs.append(spec)
         return w
-
-    def release_pins() -> None:
-        from ray_tpu._private.ids import ObjectID
-
-        for key in local:
-            core._schedule_unpin(ObjectID(key))
 
     s, S, M = rt.stage, rt.S, rt.M
     stage_label = {"stage": str(s)}
@@ -973,8 +959,6 @@ class PipelineTrainer:
                  timeout: float = 30) -> Dict[str, Any]:
         """Close every channel, stop the stage loops, release the pins,
         (optionally) kill the stage actors. Idempotent."""
-        from ray_tpu._private.core_worker import _m_pins
-
         self._dead = True
         # only the FIRST call may run the release: after it frees the
         # channel ranges they can be recycled to a NEWER trainer/graph,
@@ -997,50 +981,14 @@ class PipelineTrainer:
             except Exception:
                 pass
 
-        async def close_all():
-            for spec in self._all_specs:
-                try:
-                    await core.clients.get(tuple(spec.node_addr)).call(
-                        "channel_close",
-                        {"channel_id": spec.channel_id}, timeout=10)
-                except Exception:
-                    logger.debug("channel_close failed", exc_info=True)
-
-        if self._all_specs:
-            try:
-                core._run(close_all(), timeout=30)
-            except Exception:
-                logger.debug("pipeline close fan-out failed", exc_info=True)
+        _channels.close_specs(core, self._all_specs)
         stats: Dict[str, Any] = {"loops": []}
         for ref in self._loop_refs:
             try:
                 stats["loops"].append(core.get([ref], timeout=timeout)[0])
             except Exception:
                 stats["loops"].append(None)
-
-        async def release_all():
-            for spec in self._all_specs:
-                client = core.clients.get(tuple(spec.node_addr))
-                try:
-                    await client.call(
-                        "store_free",
-                        {"object_ids": [spec.channel_id]}, timeout=10)
-                    await client.call(
-                        "store_unpin",
-                        {"object_id": spec.channel_id,
-                         "client": core._store_client_id}, timeout=10)
-                    _m_pins.dec()
-                except Exception:
-                    logger.debug(
-                        "channel pin release failed (reclaimed by the "
-                        "supervisor's dead-client sweep)", exc_info=True)
-
-        if self._all_specs:
-            try:
-                core._run(release_all(), timeout=60)
-            except Exception:
-                logger.debug("pipeline release fan-out failed",
-                             exc_info=True)
+        _channels.free_and_unpin_specs(core, self._all_specs)
         if kill_actors:
             import ray_tpu
 
