@@ -194,6 +194,140 @@ def child_main() -> None:
                   file=sys.stderr)
 
 
+def _feed_tokens_batch(vocab: int, seq: int, delay_s: float, b):
+    """Streaming-feed transform (module-level so it pickles into the
+    transform actors): ids -> a [rows, seq] int32 token block, with an
+    optional per-block sleep that makes the LOADER the bottleneck (the
+    input-bound regime — a stand-in for slow storage/decode)."""
+    import numpy as np
+
+    if delay_s:
+        time.sleep(delay_s)
+    ids = np.asarray(b["id"])
+    rng = np.random.default_rng(1234 + int(ids[0]))
+    return {"tokens": rng.integers(
+        0, vocab, (len(ids), seq)).astype(np.int32)}
+
+
+def data_regime_main(regime: str) -> None:
+    """The input-bound-vs-compute-bound knob, wired through the REAL
+    gpt2s trainer: the train step consumes batches from a streaming
+    `ray_tpu.data` pipeline via ``StreamingExecutor.feed()`` (read-only
+    arena views, acked after each step), and the record reports the
+    measured consumer stall fraction — ~0 when compute-bound (the
+    stream keeps the trainer fed), large when ``input_bound`` throttles
+    the loader below the trainer's demand. One provenance-stamped JSON
+    record, same shape as the MFU record.
+
+        python bench.py --data-regime compute_bound
+        python bench.py --data-regime input_bound
+    """
+    import functools
+
+    log = lambda m: print(f"bench: {m}", file=sys.stderr)  # noqa: E731
+    if regime not in ("compute_bound", "input_bound"):
+        raise SystemExit(
+            f"--data-regime must be compute_bound or input_bound, "
+            f"got {regime!r}")
+    prov = probe_provenance(log)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.data._internal.streaming import StreamingExecutor
+    from ray_tpu.models import gpt2_small
+    from ray_tpu.models.training import (OptimizerConfig, init_train_state,
+                                         make_train_step)
+
+    on_tpu = prov.get("device") == "tpu"
+    if on_tpu:
+        cfg = gpt2_small()
+        batch, seq, steps = 8, 1024, 24
+    else:  # the CPU-smoke shape child_main uses
+        cfg = gpt2_small(num_layers=2, embed_dim=128, num_heads=4,
+                         vocab_size=1024, dtype=jnp.float32)
+        batch, seq, steps = 4, 128, 24
+    ocfg = OptimizerConfig(warmup_steps=10, decay_steps=1000)
+    state, tx = init_train_state(cfg, ocfg, jax.random.PRNGKey(0))
+    step = make_train_step(cfg, tx, log_grad_norm=False)
+
+    # calibrate the bare step (compile + 3 timed steps) so the
+    # input-bound throttle is sized off the MEASURED trainer demand
+    tokens = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    state, m = step(state, {"tokens": tokens})
+    float(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(3):
+        state, m = step(state, {"tokens": tokens})
+    float(m["loss"])
+    step_dt = (time.perf_counter() - t0) / 3
+    # one reader/transform lane: a 2x-the-step-time block delay starves
+    # the trainer by construction (expected stall fraction ~0.5)
+    delay = 2.0 * step_dt if regime == "input_bound" else 0.0
+    log(f"bare step {step_dt * 1e3:.1f} ms; regime={regime} "
+        f"block delay {delay * 1e3:.1f} ms")
+
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    try:
+        ds = ray_tpu.data.range(
+            steps * batch, parallelism=steps).map_batches(
+            functools.partial(_feed_tokens_batch, cfg.vocab_size, seq,
+                              delay))
+        ex = StreamingExecutor(ds._ops, batch_size=batch, epochs=3,
+                               seed=0, num_readers=1)
+        stall = [0.0]
+        last_end = [None]
+        n_steps = [0]
+        state_box = [state]
+
+        def train_step(b):
+            now = time.perf_counter()
+            if last_end[0] is not None:
+                stall[0] += now - last_end[0]
+            s2, met = step(state_box[0],
+                           {"tokens": np.asarray(b["tokens"])})
+            float(met["loss"])  # block: the step really ran
+            state_box[0] = s2
+            n_steps[0] += 1
+            last_end[0] = time.perf_counter()
+
+        t_first_end = None
+        try:
+            for _ in ex.feed(train_step):
+                if t_first_end is None:
+                    # first step absorbs executor spin-up + compile
+                    # reuse; the stall window starts here
+                    t_first_end = last_end[0]
+                    stall[0] = 0.0
+                if n_steps[0] >= steps:
+                    break
+        finally:
+            ex.shutdown()
+        total = max(last_end[0] - t_first_end, 1e-9)
+        stall_frac = stall[0] / total
+        measured = n_steps[0] - 1  # steps inside the stall window
+        rec = {
+            "metric": "gpt2s_streamfeed_stall_fraction",
+            "value": round(stall_frac, 3),
+            "unit": "fraction",
+            "detail": {
+                "regime": regime,
+                "feed": "StreamingExecutor.feed",
+                "steps_per_sec": round(measured / total, 2),
+                "bare_step_ms": round(step_dt * 1e3, 2),
+                "block_delay_ms": round(delay * 1e3, 2),
+                "steps": measured,
+                "batch": batch, "seq": seq,
+                **prov,
+            },
+        }
+        print(json.dumps(rec))
+    finally:
+        ray_tpu.shutdown()
+
+
 def acquire_tpu(log) -> tuple:
     """Robust TPU acquisition (the r03/r05 flaky-blind fix): up to
     ``PROBE_ATTEMPTS`` probe rounds with exponential backoff, and a
@@ -326,5 +460,11 @@ def main() -> None:
 if __name__ == "__main__":
     if "--child" in sys.argv:
         child_main()
+    elif "--data-regime" in sys.argv:
+        idx = sys.argv.index("--data-regime")
+        if idx + 1 >= len(sys.argv):
+            raise SystemExit(
+                "--data-regime needs a value: compute_bound | input_bound")
+        data_regime_main(sys.argv[idx + 1])
     else:
         main()

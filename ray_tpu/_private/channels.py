@@ -438,6 +438,17 @@ class LocalChannel:
             v = self._slot_version(_slot_of(version, self.depth))
         return v >= version and v % 2 == 0
 
+    def writable(self, version: int) -> bool:
+        """Non-blocking probe: would ``write(version)`` commit without
+        blocking (every reader acked the slot's previous occupant)?
+        True on a closed channel so the caller's write observes the
+        close and raises instead of treating it as backpressure. What
+        the interleaved 1F1B scheduler keys on: an actor multiplexing V
+        chunks must never park in one chunk's blocked write while
+        another chunk has ready work (single writer + monotonic acks, so
+        a True can only stay True until this writer writes)."""
+        return self.closed or readers_ready_view(self._view, version)
+
     def ack(self, slot: int, version: int) -> None:
         """Release the writer: this reader is done with ``version``."""
         if not 0 <= slot < MAX_READERS:
@@ -557,6 +568,17 @@ class VersionedWriter:
             self._local.write(payload, version)
         else:
             self._mirror.push(payload, version)
+
+    def writable(self, version: int) -> bool:
+        """Non-blocking probe: may ``version`` be written now without
+        blocking? Mirror writers answer True — remote reader acks are
+        not observable without an RPC, and the push's remote commit
+        carries the flow control instead (the interleaved scheduler
+        treats a mirror edge as always eligible and accepts the bounded
+        block, exactly like the PR-8 chain did)."""
+        if self._local is not None:
+            return self._local.writable(version)
+        return True
 
 
 # ------------------------------------------------- driver-side shared plumbing

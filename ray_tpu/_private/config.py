@@ -103,6 +103,15 @@ class Config:
     # training (train.PipelineTrainer) needs > 1 so a stage can run
     # microbatches ahead of its consumer (1F1B)
     channel_depth: int = 1
+    # ---- pipeline-parallel training (train.PipelineTrainer) ----
+    # interleaved 1F1B virtual stages: each of the S stage actors owns
+    # this many NON-CONTIGUOUS model chunks (stage s owns blocks
+    # s, s+S, s+2S, ...), shrinking the pipeline bubble roughly by 1/V
+    # at fixed (S, M) — the multi-chunk-per-stage trick from
+    # arXiv:2412.14374. 1 (default) is the PR-8 one-chunk-per-stage
+    # schedule bit-for-bit. Explicit zeros are REJECTED at build (env or
+    # argument — the falsy-zero lesson): 0 never silently means 1
+    pipeline_virtual_stages: int = 1
     # ---- serve: continuous (iteration-level) batching ----
     # KV-arena sequence slots per LLM replica: the fixed batch width of the
     # jitted decode step (serve/_private/continuous.py). More slots = more

@@ -88,9 +88,12 @@ def moe_debug(**overrides) -> TransformerConfig:
 # embedding (+ learned positions), the last stage owns the final norm +
 # lm_head + loss, and the blocks spread as evenly as possible (the
 # remainder lands on the EARLIEST stages, which also carry the lighter
-# embed/no-head ends). Every callable here is a module-level function
-# under functools.partial, so stage specs pickle cleanly into the stage
-# actors.
+# embed/no-head ends). With ``virtual_stages=V`` > 1 the split is into
+# S*V NON-CONTIGUOUS chunks for the interleaved 1F1B schedule: stage s
+# owns chunks s, s+S, s+2S, ... (arXiv:2412.14374's multi-chunk-per-
+# stage trick — the trainer's bubble shrinks roughly by 1/V). Every
+# callable here is a module-level function under functools.partial, so
+# stage specs pickle cleanly into the stage actors.
 
 
 def pipeline_splits(num_layers: int, num_stages: int):
@@ -110,28 +113,75 @@ def pipeline_splits(num_layers: int, num_stages: int):
 
 
 def _check_pipeline_cfg(cfg) -> None:
+    # name the offending CONFIG FIELD and the fix: these raise from deep
+    # inside trainer/stage-def builds, where "pipeline stages need X"
+    # without the field left users grepping for which knob to flip
     if cfg.tie_embeddings:
         raise ValueError(
-            "pipeline stages need tie_embeddings=False: the embedding "
-            "table lives on stage 0 and the lm_head on the last stage — "
-            "a tied table would need its gradient summed across both "
-            "ends every flush")
+            "pipeline_stage_defs: cfg.tie_embeddings=True is unsupported "
+            "— the embedding table lives on stage 0 and the lm_head on "
+            "the last stage, so a tied table's gradient would need "
+            "summing across both ends every flush. Build the config with "
+            "tie_embeddings=False (e.g. "
+            "presets.gpt2_small(tie_embeddings=False))")
     if cfg.mlp == "moe":
         raise ValueError(
-            "pipeline stages do not support mlp='moe' yet (the routing "
-            "aux loss would need summing across stages)")
+            "pipeline_stage_defs: cfg.mlp='moe' is unsupported — the "
+            "router's load-balancing aux loss would need summing across "
+            "stages every microbatch. Use a dense mlp ('gelu'/'swiglu'), "
+            "or train MoE configs with the SPMD expert-parallel path")
 
 
-def partition_pipeline_params(cfg, params, num_stages: int):
-    """Slice a full init_params() tree into per-stage shards (parity
+def _resolve_virtual_stages(virtual_stages, num_stages: int,
+                            num_layers: int) -> int:
+    """Validate + default the interleaved-1F1B chunk multiplier.
+    ``None`` takes the ``RAY_TPU_PIPELINE_VIRTUAL_STAGES`` knob (default
+    1); an explicit 0 — argument or env — RAISES instead of silently
+    meaning 1 (the falsy-zero lesson), and V beyond blocks-per-stage
+    raises with the actionable count."""
+    if virtual_stages is None:
+        from ray_tpu._private.config import global_config
+
+        virtual_stages = global_config().pipeline_virtual_stages
+        source = "RAY_TPU_PIPELINE_VIRTUAL_STAGES"
+    else:
+        source = "virtual_stages"
+    v = int(virtual_stages)
+    if v < 1:
+        raise ValueError(
+            f"{source}={virtual_stages} is invalid: virtual_stages must "
+            f"be >= 1 (1 = the plain one-chunk-per-stage 1F1B schedule; "
+            f"0 does not mean 'default')")
+    per_stage = num_layers // num_stages
+    if per_stage < 1:
+        raise ValueError(
+            f"cannot split cfg.num_layers={num_layers} blocks into "
+            f"num_stages={num_stages} stages: every stage needs at "
+            f"least one block")
+    if v > per_stage:
+        raise ValueError(
+            f"virtual_stages={v} exceeds blocks-per-stage: "
+            f"cfg.num_layers={num_layers} over num_stages={num_stages} "
+            f"gives {per_stage} block(s) per stage, and every virtual "
+            f"chunk needs at least one block — use virtual_stages <= "
+            f"{per_stage} (or a deeper config)")
+    return v
+
+
+def partition_pipeline_params(cfg, params, num_stages: int,
+                              virtual_stages: int = 1):
+    """Slice a full init_params() tree into per-CHUNK shards, in
+    pipeline order — ``num_stages * virtual_stages`` of them (parity
     tests init once and compare the assembled pipeline to the
-    single-process model bit-for-bit)."""
+    single-process model bit-for-bit; the trainer hands chunk c to
+    stage actor c % num_stages)."""
     import jax
 
     _check_pipeline_cfg(cfg)
-    splits = pipeline_splits(cfg.num_layers, num_stages)
+    chunks = num_stages * int(virtual_stages)
+    splits = pipeline_splits(cfg.num_layers, chunks)
     shards = []
-    for s, (lo, hi) in enumerate(splits):
+    for c, (lo, hi) in enumerate(splits):
         shard = {}
         if cfg.scan_layers:
             shard["blocks"] = jax.tree.map(
@@ -140,32 +190,33 @@ def partition_pipeline_params(cfg, params, num_stages: int):
             shard["blocks"] = {
                 str(i - lo): params["blocks"][str(i)]
                 for i in range(lo, hi)}
-        if s == 0:
+        if c == 0:
             shard["embed"] = params["embed"]
             if cfg.pos == "learned":
                 shard["pos_embed"] = params["pos_embed"]
-        if s == num_stages - 1:
+        if c == chunks - 1:
             shard["final_norm"] = params["final_norm"]
             shard["lm_head"] = params["lm_head"]
         shards.append(shard)
     return shards
 
 
-def _stage_init(cfg, seed: int, num_stages: int, stage: int):
-    """Stage shard init, bit-identical to slicing ``init_params(cfg,
+def _stage_init(cfg, seed: int, num_chunks: int, chunk: int):
+    """Chunk shard init, bit-identical to slicing ``init_params(cfg,
     PRNGKey(seed))`` WITHOUT materializing the full model on every stage
     actor (that spike would defeat the memory motive of pipelining a
     model that doesn't fit one host): init_params consumes one split key
     per parameter group (embed=keys[0], pos=keys[1], lm_head=keys[2],
-    block i=keys[3+i]), so building only this stage's groups from the
-    same key layout reproduces the exact tensors."""
+    block i=keys[3+i]), so building only this chunk's groups from the
+    same key layout reproduces the exact tensors. ``num_chunks`` counts
+    the whole pipeline's chunks (num_stages * virtual_stages)."""
     import jax
     import jax.numpy as jnp
 
     from ray_tpu.models.transformer import _block_params, _norm_params
 
     _check_pipeline_cfg(cfg)
-    lo, hi = pipeline_splits(cfg.num_layers, num_stages)[stage]
+    lo, hi = pipeline_splits(cfg.num_layers, num_chunks)[chunk]
     keys = jax.random.split(jax.random.PRNGKey(seed), cfg.num_layers + 3)
     init = jax.nn.initializers.normal(0.02, cfg.param_dtype)
     blocks = [_block_params(cfg, keys[3 + i]) for i in range(lo, hi)]
@@ -175,13 +226,13 @@ def _stage_init(cfg, seed: int, num_stages: int, stage: int):
             lambda *xs: jnp.stack(xs, axis=0), *blocks)
     else:
         shard["blocks"] = {str(i): b for i, b in enumerate(blocks)}
-    if stage == 0:
+    if chunk == 0:
         shard["embed"] = {
             "table": init(keys[0], (cfg.vocab_size, cfg.embed_dim))}
         if cfg.pos == "learned":
             shard["pos_embed"] = {
                 "table": init(keys[1], (cfg.max_seq_len, cfg.embed_dim))}
-    if stage == num_stages - 1:
+    if chunk == num_chunks - 1:
         shard["final_norm"] = _norm_params(cfg, cfg.embed_dim)
         shard["lm_head"] = {
             "kernel": init(keys[2], (cfg.embed_dim, cfg.vocab_size))}
@@ -262,25 +313,33 @@ def _stage_loss(cfg, lo: int, hi: int, params, x, tokens):
     return loss
 
 
-def pipeline_stage_defs(cfg, num_stages: int, *, seed: int = 0):
-    """Partition ``cfg`` into ``num_stages`` stage specs for
+def pipeline_stage_defs(cfg, num_stages: int, *, virtual_stages=None,
+                        seed: int = 0):
+    """Partition ``cfg`` into pipeline chunk specs for
     ``ray_tpu.train.PipelineTrainer``: uniform block split, embedding on
-    stage 0, final-norm + lm_head + loss on the last stage. Each spec is
-    a dict of picklable callables ({"init", "fwd"} / {"init", "loss"});
-    init runs ON the stage actor and re-derives the full model's
-    deterministic init before slicing, so an assembled pipeline matches
-    ``init_params(cfg, PRNGKey(seed))`` exactly."""
+    the first chunk, final-norm + lm_head + loss on the last. With
+    ``virtual_stages=V`` (None = the ``RAY_TPU_PIPELINE_VIRTUAL_STAGES``
+    knob, default 1) the list holds ``num_stages * V`` chunk specs in
+    pipeline order — pass the SAME V to the trainer, which hands chunk c
+    to stage actor ``c % num_stages`` (the interleaved 1F1B layout).
+    Each spec is a dict of picklable callables ({"init", "fwd"} /
+    {"init", "loss"}); init runs ON the stage actor and re-derives the
+    full model's deterministic init before slicing, so an assembled
+    pipeline matches ``init_params(cfg, PRNGKey(seed))`` exactly."""
     import functools
 
     _check_pipeline_cfg(cfg)
-    splits = pipeline_splits(cfg.num_layers, num_stages)
+    v = _resolve_virtual_stages(virtual_stages, num_stages,
+                                cfg.num_layers)
+    chunks = num_stages * v
+    splits = pipeline_splits(cfg.num_layers, chunks)
     defs = []
-    for s, (lo, hi) in enumerate(splits):
+    for c, (lo, hi) in enumerate(splits):
         d = {"init": functools.partial(
-            _stage_init, cfg, seed, num_stages, s)}
-        if s == num_stages - 1:
+            _stage_init, cfg, seed, chunks, c)}
+        if c == chunks - 1:
             d["loss"] = functools.partial(_stage_loss, cfg, lo, hi)
         else:
-            d["fwd"] = functools.partial(_stage_fwd, cfg, lo, hi, s == 0)
+            d["fwd"] = functools.partial(_stage_fwd, cfg, lo, hi, c == 0)
         defs.append(d)
     return defs

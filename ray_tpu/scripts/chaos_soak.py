@@ -629,6 +629,7 @@ def run_pipeline_chaos(
     delay_prob: float = 0.05,
     delay_max_ms: int = 20,
     kills: bool = True,
+    virtual_stages: int = 1,
 ) -> None:
     """One seeded chaos run against the MPMD pipeline trainer.
 
@@ -639,6 +640,9 @@ def run_pipeline_chaos(
     steps: every step's loss must MATCH a single-process reference to
     fp32 tolerance — chaos may cost retries, never a wrong loss (absolute
     slot-ring versions make dropped/duplicated push frames converge).
+    With ``virtual_stages=2`` the same two actors run the INTERLEAVED
+    four-chunk schedule, so every per-chunk act/grad hop — twice as many
+    of them — is a cross-node chunked push under the same attack.
     With ``kills``, a stage actor is then hard-killed mid-flush: the
     in-flight step must surface a clean ChannelClosedError/ActorDiedError
     (never a hang, never a silently wrong loss), teardown must unwind,
@@ -661,8 +665,9 @@ def run_pipeline_chaos(
     from ray_tpu.models import presets
     from ray_tpu.models.transformer import init_params, loss_fn
 
+    V = int(virtual_stages)
     mcfg = presets.llama_debug(
-        num_layers=2, vocab_size=128, max_seq_len=32, embed_dim=32,
+        num_layers=2 * V, vocab_size=128, max_seq_len=32, embed_dim=32,
         num_heads=2, num_kv_heads=1, mlp_dim=64)
     batch = np.random.default_rng(0).integers(
         0, 128, (16, 16)).astype(np.int32)
@@ -722,12 +727,16 @@ def run_pipeline_chaos(
 
         pins_before = store_pins()
         trainer = PipelineTrainer(
-            presets.pipeline_stage_defs(mcfg, 2, seed=0),
-            num_microbatches=M, optimizer=("sgd", 0.05),
+            presets.pipeline_stage_defs(mcfg, 2, virtual_stages=V,
+                                        seed=0),
+            num_microbatches=M, virtual_stages=V, optimizer=("sgd", 0.05),
             stage_options=[{"resources": {"left": 1}},
                            {"resources": {"right": 1}}])
         assert trainer.is_channel_backed and trainer.channel_depth > 1, (
             "pipeline chaos run is not on the slot-ring channel substrate")
+        assert trainer.virtual_stages == V, (
+            "pipeline chaos run is not on the requested interleaved "
+            "schedule")
         for step in range(3):
             out = trainer.step(batch)
             assert abs(out["loss"] - ref_losses[step]) < 1e-4, (
@@ -1794,10 +1803,16 @@ def _run_one(seed: int, args) -> None:
             delay_max_ms=args.delay_max_ms, kills=not args.no_kills)
         return
     if args.pipeline:
-        run_pipeline_chaos(
-            seed,
-            drop_prob=args.drop, dup_prob=args.dup, delay_prob=args.delay,
-            delay_max_ms=args.delay_max_ms, kills=not args.no_kills)
+        # both schedules per seed: the PR-8 one-chunk chain, then the
+        # interleaved V=2 variant (twice the cross-node act/grad hops,
+        # same actors) under the identical fault schedule
+        for v in (1, 2):
+            run_pipeline_chaos(
+                seed,
+                drop_prob=args.drop, dup_prob=args.dup,
+                delay_prob=args.delay,
+                delay_max_ms=args.delay_max_ms, kills=not args.no_kills,
+                virtual_stages=v)
         return
     if args.data:
         run_data_chaos(
@@ -1850,7 +1865,9 @@ def main() -> int:
                              "with out-of-order waits under drop/dup/delay "
                              "+ a participant kill mid-flight")
     parser.add_argument("--pipeline", action="store_true",
-                        help="attack the MPMD pipeline trainer: cross-node "
+                        help="attack the MPMD pipeline trainer (both the "
+                             "plain and the V=2 interleaved schedules): "
+                             "cross-node "
                              "1F1B microbatch pushes (chunked channel "
                              "frames) under drop/dup/delay must train to "
                              "EXACT reference losses; a mid-flush stage "

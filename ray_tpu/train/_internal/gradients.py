@@ -101,22 +101,44 @@ class GradientAverager:
                 collective.init_collective_group(
                     world_size, rank, backend="host", group_name=group_name)
 
-    def begin(self, grads: Any) -> _TreeWork:
+    def begin(self, grads: Any, on_bucket=None) -> _TreeWork:
         """Start the overlapped average of a gradient pytree; returns a
         handle whose ``wait_tree()`` yields the averaged tree. Device
         leaves are handed to the runner untouched — the device->host
-        transfers are part of what overlaps."""
+        transfers are part of what overlaps. ``on_bucket(indices,
+        arrays)`` (optional, flat-leaf indices in ``jax.tree.flatten``
+        order) fires per coalesced bucket as its reduce lands, on the
+        runner's reducer thread — the hook the fused in-bucket optimizer
+        rides so a bucket's update overlaps the remaining buckets'
+        rounds. NOTE: with on_bucket, the arrays alias this averager's
+        persistent landing buffers — consume them inside the callback
+        (e.g. dispatch the jitted apply), do not stash references past
+        the next step."""
         import jax
 
         from ray_tpu.util import collective
         from ray_tpu.util.collective import ReduceOp
-        from ray_tpu.util.collective.async_work import _CompletedWork
+        from ray_tpu.util.collective.async_work import (_CompletedWork,
+                                                        validate_on_bucket)
 
+        validate_on_bucket(on_bucket)
         flat, tree = jax.tree.flatten(grads)
         if self.world_size <= 1:
+            leaves = [np.asarray(f) for f in flat]
+            if on_bucket is not None and leaves:
+                # the solo fallback still honors the per-bucket contract
+                # (fire_on_bucket IS the contract — same-dtype buckets,
+                # runner order) so caller state machines keyed on bucket
+                # completion see identical sequences at every world size
+                from ray_tpu.util.collective.async_work import \
+                    fire_on_bucket
+                from ray_tpu.util.collective.collective import \
+                    _default_bucket_bytes
+
+                fire_on_bucket(leaves, _default_bucket_bytes(), leaves,
+                               on_bucket)
             return _TreeWork(
-                _CompletedWork(self.group_name,
-                               [np.asarray(f) for f in flat]),
+                _CompletedWork(self.group_name, leaves),
                 tree, as_device=True)
         # (shape, dtype) signature, not leaf count: a same-arity tree
         # with one resized leaf must reallocate the landing buffers
@@ -126,7 +148,8 @@ class GradientAverager:
             self._sig = sig
         work = collective.allreduce_coalesced_async(
             flat, group_name=self.group_name, op=ReduceOp.MEAN,
-            timeout_ms=self.timeout_ms, out=self._out)
+            timeout_ms=self.timeout_ms, out=self._out,
+            on_bucket=on_bucket)
         return _TreeWork(work, tree, as_device=True)
 
     def average(self, grads: Any) -> Any:

@@ -1,18 +1,37 @@
-"""MPMD pipeline-parallel training: 1F1B microbatches over channels.
+"""MPMD pipeline-parallel training: interleaved 1F1B over channels.
 
 Reproduces the topology of "Scaling Deep Learning Training with MPMD
 Pipeline Parallelism" (arXiv:2412.14374) on this framework's fast-path
-substrate: S stage actors each own ONE model shard, forward activations
-and backward gradients flow stage-to-stage through compiled-graph
-channels (`_private/channels.py` — pin-backed seqlock slot rings, NOT the
-object store), and each stage's long-running run loop executes an EAGER
-1F1B microbatch schedule: backward as soon as its gradient is committed
-(gradients still accumulate in microbatch order, so numerics are
-deterministic), otherwise forwards ahead bounded by the channel depth —
-so roughly S - s (at most depth) microbatches of activation stash live
-on stage s. Optional intra-stage data parallelism rides the p2p
-collective layer: dp replicas of every stage sync their accumulated
-gradients with one `allreduce_coalesced_async(op=MEAN)` at flush.
+substrate: S stage actors own model shards, forward activations and
+backward gradients flow stage-to-stage through compiled-graph channels
+(`_private/channels.py` — pin-backed seqlock slot rings, NOT the object
+store), and each stage's long-running run loop executes an EAGER 1F1B
+microbatch schedule: backward as soon as its gradient is committed
+(gradients still accumulate per chunk in microbatch order, so numerics
+are deterministic), otherwise forwards ahead bounded by the channel
+depth. Optional intra-stage data parallelism rides the p2p collective
+layer: dp replicas of every stage sync their accumulated gradients with
+one ``allreduce_coalesced_async(op=MEAN)`` at flush.
+
+Interleaved virtual stages (``virtual_stages=V`` > 1): each stage actor
+owns V NON-CONTIGUOUS model chunks — stage s owns chunks s, s+S, s+2S,
+... of the S*V-chunk pipeline — and the channel plan grows per-chunk
+act/grad edges between the SAME S actors (the existing depth-k slot
+rings; no new protocol). The 1F1B bubble scales as (S-1)/(V*M) instead
+of (S-1)/M: while a one-chunk stage idles waiting for the pipeline to
+fill or drain, an interleaved stage has V-1 other chunks' microbatches
+to run. V=1 executes the PR-8 schedule byte-for-byte (same code path).
+
+Fused in-bucket optimizer (``fused_flush``, default on, dp > 1): the
+flush's coalesced-mean allreduce carries an ``on_bucket`` completion
+callback, and each stage applies a JITTED per-bucket optax update
+(against pre-split per-bucket opt state) the moment that bucket's
+reduce lands — overlapped with the remaining buckets' device_get +
+reduce rounds — instead of waiting for the full tree and unpacking
+through host numpy. Per-bucket apply is exact for leafwise optimizers
+(sgd/adam families); pass ``fused_flush=False`` for optimizers with
+cross-leaf state (e.g. ``optax.clip_by_global_norm`` chains), which is
+also the measured unfused baseline.
 
 The steady-state cost model is the whole point: one microbatch hop is a
 channel write + a channel read (same-node: two shared-memory seqlock
@@ -26,10 +45,10 @@ processes/hosts, which is what the MPMD paper is about.
 
 Channel depth: 1F1B needs capacity for several in-flight microbatches
 per edge, so the trainer compiles its channels at depth
-``max(2, min(S + 1, M))`` by default (the PR-8 slot ring). Depth 1 would
-still be deadlock-free — the schedule degenerates to lockstep — but
-serializes the pipeline; the microbenchmark guard asserts depth > 1 so
-an accidental fallback can't vacuously pass.
+``max(2, min(S*V + 1, M))`` by default (the PR-8 slot ring). Depth 1
+would still be deadlock-free — the schedule degenerates to lockstep —
+but serializes the pipeline; the microbenchmark guard asserts depth > 1
+so an accidental fallback can't vacuously pass.
 
 Failure semantics match compiled DAGs: teardown or any participant's
 death closes every channel (supervisor participant registry + a
@@ -38,8 +57,8 @@ driver-side actor-state subscription), blocked peers raise
 state is discarded — a broken pipeline can produce an error, never a
 wrong loss.
 
-``mode="tasks"`` runs the SAME stage math as dynamic actor tasks through
-the object store (per-microbatch per-stage `.remote()` calls) — the
+``mode="tasks"`` runs the SAME chunk math as dynamic actor tasks through
+the object store (per-microbatch per-chunk `.remote()` calls) — the
 baseline `pipeline_task_per_stage_step` microbenchmark probe and a
 debugging aid, not a fallback: channel compilation failures raise.
 """
@@ -72,7 +91,8 @@ _F_BUBBLE = flight.intern("pipe.bubble_bp")
 
 _m_microbatches = Counter(
     "ray_tpu_pipeline_microbatches_total",
-    "Pipeline microbatches processed, by stage rank")
+    "Pipeline chunk-microbatches processed (M per chunk per flush, so "
+    "M*V per flush at virtual_stages=V), by stage rank")
 _m_flushes = Counter(
     "ray_tpu_pipeline_flushes_total",
     "Pipeline flushes (optimizer steps) completed, by stage rank")
@@ -83,13 +103,18 @@ _m_bubble = Gauge(
     "ray_tpu_pipeline_bubble_fraction",
     "Fraction of the last flush a stage spent blocked on channel "
     "waits (the pipeline bubble, measured not estimated)")
+_m_fused_applies = Counter(
+    "ray_tpu_pipeline_fused_bucket_applies_total",
+    "Fused in-bucket optimizer applies (one jitted update per landed "
+    "allreduce bucket, overlapped with the remaining buckets' rounds), "
+    "by stage rank")
 
 
 @dataclasses.dataclass
 class StageSpec:
-    """One pipeline stage's model shard as pure, PICKLABLE callables
+    """One pipeline chunk's model shard as pure, PICKLABLE callables
     (module-level functions / functools.partial — they ship to the stage
-    actor). Stages 0..S-2 define ``fwd``; the last stage defines
+    actor). Chunks 0..C-2 define ``fwd``; the last chunk defines
     ``loss``.
 
       init()                  -> params pytree (this shard only)
@@ -113,49 +138,43 @@ def _as_stage_spec(obj) -> StageSpec:
 
 @dataclasses.dataclass
 class _StagePlan:
-    """Wire-shippable channel plan for one stage actor's run loop."""
+    """Wire-shippable channel plan for one stage actor's run loop. The
+    act/grad entries are PER LOCAL CHUNK (index v, global chunk
+    s + v*S): act_in[v] is None for global chunk 0 (which reads
+    ``in_spec``), act_out[v]/grad_in[v] are None for the last global
+    chunk (loss — nothing downstream), grad_out[v] is None for global
+    chunk 0 (raw data upstream). At virtual_stages=1 every list is one
+    entry and the plan is exactly the PR-8 shape."""
 
     in_spec: Optional[_channels.ChannelSpec]  # driver -> stage 0
     label_spec: Optional[_channels.ChannelSpec]  # driver -> last stage
-    act_in: Optional[_channels.ChannelSpec]  # stage s-1 -> s
-    act_out: Optional[_channels.ChannelSpec]  # stage s -> s+1
-    grad_in: Optional[_channels.ChannelSpec]  # stage s+1 -> s
-    grad_out: Optional[_channels.ChannelSpec]  # stage s -> s-1
+    act_in: List[Optional[_channels.ChannelSpec]]  # chunk c-1 -> c
+    act_out: List[Optional[_channels.ChannelSpec]]  # chunk c -> c+1
+    grad_in: List[Optional[_channels.ChannelSpec]]  # chunk c+1 -> c
+    grad_out: List[Optional[_channels.ChannelSpec]]  # chunk c -> c-1
     report: _channels.ChannelSpec  # stage s -> driver, one per flush
 
 
 # --------------------------------------------------------------- stage math
 
 
-class _StageRuntime:
-    """One stage's compute state: the shard params, jitted fwd/bwd (bwd
-    recomputes the stage forward from the stashed INPUT activation —
-    full-remat 1F1B, so the stash is one input per in-flight microbatch,
-    never the whole residual tree), gradient accumulator, optimizer."""
+class _ChunkRuntime:
+    """One model chunk's compute state: the shard params, jitted fwd/bwd
+    (bwd recomputes the chunk forward from the stashed INPUT activation
+    — full-remat 1F1B, so the stash is one input per in-flight
+    microbatch, never the whole residual tree), gradient accumulator."""
 
-    def __init__(self, spec: StageSpec, stage: int, num_stages: int,
-                 num_microbatches: int, optimizer, dp: int, dp_rank: int,
-                 group_name: str):
+    def __init__(self, spec: StageSpec, chunk: int, num_chunks: int):
         import jax
 
         self.spec = spec
-        self.stage = int(stage)
-        self.S = int(num_stages)
-        self.M = int(num_microbatches)
-        self.first = self.stage == 0
-        self.last = self.stage == self.S - 1
-        self.dp = int(dp)
-        self.dp_rank = int(dp_rank)
-        self.group_name = group_name
-        self._group_ready = False
+        self.chunk = int(chunk)
+        self.first = self.chunk == 0
+        self.last = self.chunk == int(num_chunks) - 1
         self.params = spec.init()
         self._stash: Dict[int, Any] = {}
-        self._acc = None
-        self._losses: List[float] = []
-        self._optimizer = optimizer
-        self._opt = None
-        self._opt_state = None
-        self._update = None
+        self.acc = None
+        self.losses: List[float] = []
 
         def tree_add(a, b):
             return jax.tree.map(lambda x, y: x + y, a, b)
@@ -167,7 +186,7 @@ class _StageRuntime:
         if self.last:
             if spec.loss is None:
                 raise ValueError(
-                    f"stage {stage} is the last of {num_stages} and needs "
+                    f"chunk {chunk} is the last of {num_chunks} and needs "
                     f"a loss callable")
             lg = jax.value_and_grad(spec.loss, argnums=(0, 1))
 
@@ -183,7 +202,7 @@ class _StageRuntime:
             self._lg_acc = jax.jit(_lg_acc, donate_argnums=3)
         else:
             if spec.fwd is None:
-                raise ValueError(f"stage {stage} needs a fwd callable")
+                raise ValueError(f"chunk {chunk} needs a fwd callable")
             self._fwd = jax.jit(spec.fwd)
             fwd = spec.fwd
             if self.first:
@@ -210,37 +229,79 @@ class _StageRuntime:
             self._bwd_first = jax.jit(_bwd_first)
             self._bwd_acc = jax.jit(_bwd_acc, donate_argnums=3)
 
-    # -- per-microbatch
-
     def forward(self, m: int, x) -> Any:
-        """Non-last stages: y = fwd(params, x); stash x for the backward
+        """Non-last chunks: y = fwd(params, x); stash x for the backward
         recompute."""
         y = self._fwd(self.params, x)
         self._stash[m] = x
         return y
 
     def loss_backward(self, x, labels) -> Tuple[float, Any]:
-        """Last stage only: loss + grads (+ fused accumulation) in one
-        jit call (fwd and bwd of the last stage are adjacent in 1F1B, so
+        """Last chunk only: loss + grads (+ fused accumulation) in one
+        jit call (fwd and bwd of the last chunk are adjacent in 1F1B, so
         there is nothing to stash)."""
-        if self._acc is None:
-            loss, gx, self._acc = self._lg_first(self.params, x, labels)
+        if self.acc is None:
+            loss, gx, self.acc = self._lg_first(self.params, x, labels)
         else:
-            loss, gx, self._acc = self._lg_acc(
-                self.params, x, labels, self._acc)
-        self._losses.append(float(loss))
+            loss, gx, self.acc = self._lg_acc(
+                self.params, x, labels, self.acc)
+        self.losses.append(float(loss))
         return float(loss), gx
 
     def backward(self, m: int, gy) -> Any:
-        """Recompute this stage's forward from the stashed input, apply
+        """Recompute this chunk's forward from the stashed input, apply
         the vjp, fold the param grads into the accumulator; returns the
-        input gradient (None at stage 0)."""
+        input gradient (None at chunk 0)."""
         x = self._stash.pop(m)
-        if self._acc is None:
-            gx, self._acc = self._bwd_first(self.params, x, gy)
+        if self.acc is None:
+            gx, self.acc = self._bwd_first(self.params, x, gy)
         else:
-            gx, self._acc = self._bwd_acc(self.params, x, gy, self._acc)
+            gx, self.acc = self._bwd_acc(self.params, x, gy, self.acc)
         return gx
+
+
+class _StageRuntime:
+    """One stage actor's compute state: V chunk runtimes (local index v
+    owns global chunk stage + v*S), the optimizer, and the flush."""
+
+    def __init__(self, specs: Sequence[StageSpec], stage: int,
+                 num_stages: int, virtual_stages: int,
+                 num_microbatches: int, optimizer, dp: int, dp_rank: int,
+                 group_name: str, fused_flush: bool = True,
+                 flush_bucket_bytes: Optional[int] = None):
+        self.stage = int(stage)
+        self.S = int(num_stages)
+        self.V = int(virtual_stages)
+        self.M = int(num_microbatches)
+        self.dp = int(dp)
+        self.dp_rank = int(dp_rank)
+        self.group_name = group_name
+        self._group_ready = False
+        C = self.S * self.V
+        self.chunks = [
+            _ChunkRuntime(spec, self.stage + v * self.S, C)
+            for v, spec in enumerate(specs)]
+        self.first = self.chunks[0].first  # global chunk 0 lives here
+        self.last = self.chunks[-1].last  # the loss chunk lives here
+        self._optimizer = optimizer
+        self._fused = bool(fused_flush)
+        self._bucket_bytes = flush_bucket_bytes
+        self._opt = None
+        self._opt_state = None
+        self._update = None
+        self._fused_buckets: Optional[Dict[tuple, dict]] = None
+        self._fused_applies = 0  # lifetime count; reports carry deltas
+
+    # -- per-microbatch (chunk-indexed)
+
+    def forward(self, v: int, m: int, x) -> Any:
+        return self.chunks[v].forward(m, x)
+
+    def loss_backward(self, v: int, x, labels) -> Tuple[float, Any]:
+        return self.chunks[v].loss_backward(x, labels)
+
+    def backward(self, v: int, m: int, gy) -> Any:
+        return self.chunks[v].backward(m, gy)
 
     # -- flush
 
@@ -253,21 +314,26 @@ class _StageRuntime:
                 group_name=self.group_name)
             self._group_ready = True
 
+    def _make_opt(self):
+        import optax
+
+        if callable(self._optimizer):
+            return self._optimizer()
+        kind, lr = self._optimizer
+        if kind != "sgd":
+            raise ValueError(f"unknown optimizer {kind!r}")
+        return optax.sgd(lr)
+
     def _ensure_opt(self) -> None:
         if self._opt is not None:
             return
         import jax
         import optax
 
-        if callable(self._optimizer):
-            opt = self._optimizer()
-        else:
-            kind, lr = self._optimizer
-            if kind != "sgd":
-                raise ValueError(f"unknown optimizer {kind!r}")
-            opt = optax.sgd(lr)
+        opt = self._make_opt()
         self._opt = opt
-        self._opt_state = opt.init(self.params)
+        params = tuple(ck.params for ck in self.chunks)
+        self._opt_state = opt.init(params)
 
         def update(params, opt_state, grads):
             updates, new_state = opt.update(grads, opt_state, params)
@@ -275,19 +341,138 @@ class _StageRuntime:
 
         self._update = jax.jit(update)
 
+    def _resolved_bucket_bytes(self) -> int:
+        if self._bucket_bytes is not None:
+            return int(self._bucket_bytes)
+        from ray_tpu.util.collective.collective import _default_bucket_bytes
+
+        return _default_bucket_bytes()
+
+    def _ensure_fused_opt(self, grad_leaves: List[Any]) -> None:
+        """Pre-split the optimizer per coalesced bucket: the layout is a
+        pure function of the (fixed) gradient tree + bucket size, so
+        this runs once — one optax instance + opt state + jitted apply
+        per bucket, each over just that bucket's param leaves."""
+        if self._fused_buckets is not None:
+            return
+        import jax
+        import optax
+
+        from ray_tpu.util.collective.async_work import bucket_layout
+
+        params_leaves = jax.tree.leaves(
+            tuple(ck.params for ck in self.chunks))
+        buckets = bucket_layout(grad_leaves, self._resolved_bucket_bytes())
+        table: Dict[tuple, dict] = {}
+        for bucket in buckets:
+            opt = self._make_opt()
+            plist = [params_leaves[i] for i in bucket]
+
+            def update(params_list, opt_state, grads_list, _opt=opt):
+                updates, new_state = _opt.update(
+                    grads_list, opt_state, params_list)
+                return optax.apply_updates(params_list, updates), new_state
+
+            table[tuple(bucket)] = {
+                "state": opt.init(plist),
+                "update": jax.jit(update),
+            }
+        self._fused_buckets = table
+
+    def _fused_reduce_apply(self, leaves: List[Any],
+                            timeout_ms: int) -> List[Any]:
+        """dp allreduce with the optimizer FUSED into the buckets: the
+        per-bucket completion callback hands each landed bucket to a
+        dedicated apply thread, which dispatches that bucket's jitted
+        optax apply while the runner reduces the remaining buckets — so
+        the full-tree wait + host-numpy unpack + whole-tree update
+        round-trip is gone. The handoff is a queue put, NOT the apply
+        itself: the callback runs on the collective reducer thread,
+        which is in lockstep with the peer ranks' rounds — running the
+        apply there would serialize it into EVERY rank's reduce
+        critical path. Returns the new param leaves (grad-leaf
+        order)."""
+        import queue as _queue
+
+        import jax
+
+        from ray_tpu.util import collective as col
+        from ray_tpu.util.collective.types import ReduceOp
+
+        self._ensure_fused_opt(leaves)
+        params_leaves = jax.tree.leaves(
+            tuple(ck.params for ck in self.chunks))
+        new_leaves: List[Any] = [None] * len(leaves)
+        table = self._fused_buckets
+        stage_label = {"stage": str(self.stage)}
+        handoff: "_queue.Queue" = _queue.Queue()
+
+        def on_bucket(indices, arrays):
+            # arrays are the runner's fresh per-bucket copies (no out=),
+            # safe to hand across threads
+            handoff.put((list(indices), list(arrays)))
+
+        work = col.allreduce_coalesced_async(
+            leaves, group_name=self.group_name, op=ReduceOp.MEAN,
+            timeout_ms=timeout_ms,
+            bucket_bytes=self._resolved_bucket_bytes(),
+            on_bucket=on_bucket)
+        # Drain + apply ON THIS THREAD, which would otherwise park in
+        # wait(): each landed bucket's jitted apply runs while the
+        # runner reduces the remaining buckets. The callback itself only
+        # enqueues — it fires on the collective reducer thread, which is
+        # in lockstep with the peer ranks' rounds, so running the apply
+        # there would serialize it into EVERY rank's reduce critical
+        # path.
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        applied = 0
+        while applied < len(table):
+            try:
+                indices, arrays = handoff.get(timeout=0.05)
+            except _queue.Empty:
+                if work.done() and work.exception() is not None:
+                    raise work.exception()
+                if time.monotonic() > deadline:
+                    work.wait(0)  # surfaces the collective's own error
+                    raise TimeoutError(
+                        f"stage {self.stage}: fused flush timed out with "
+                        f"{len(table) - applied} buckets unapplied")
+                continue
+            entry = table[tuple(indices)]
+            plist = [params_leaves[i] for i in indices]
+            upd, entry["state"] = entry["update"](
+                plist, entry["state"], arrays)
+            for i, p in zip(indices, upd):
+                new_leaves[i] = p
+            applied += 1
+            self._fused_applies += 1
+            _m_fused_applies.inc(labels=stage_label)
+        work.wait(timeout_ms)  # instant: every bucket already landed
+        if any(p is None for p in new_leaves):
+            raise RuntimeError(
+                "fused flush finished with unapplied buckets "
+                "(bucket-layout mismatch between ranks?)")
+        return new_leaves
+
     def flush(self, timeout_ms: int = 120_000) -> Dict[str, Any]:
         """Average the accumulated grads over M microbatches (and the dp
         replica group when dp > 1), apply the optimizer, reset."""
         import jax
 
-        if self._stash:
-            raise RuntimeError(
-                f"stage {self.stage}: flush with {len(self._stash)} "
-                f"unconsumed activation stashes (schedule bug)")
-        grads = self._acc
-        self._acc = None
-        if grads is None:
-            raise RuntimeError(f"stage {self.stage}: flush with no grads")
+        applies_before = self._fused_applies
+        for ck in self.chunks:
+            if ck._stash:
+                raise RuntimeError(
+                    f"stage {self.stage} chunk {ck.chunk}: flush with "
+                    f"{len(ck._stash)} unconsumed activation stashes "
+                    f"(schedule bug)")
+            if ck.acc is None:
+                raise RuntimeError(
+                    f"stage {self.stage} chunk {ck.chunk}: flush with "
+                    f"no grads")
+        grads = tuple(ck.acc for ck in self.chunks)
+        for ck in self.chunks:
+            ck.acc = None
         scale = 1.0 / self.M
         grads = jax.tree.map(lambda g: g * scale, grads)
         if self.dp > 1:
@@ -297,18 +482,39 @@ class _StageRuntime:
             self._ensure_group()
             leaves, treedef = jax.tree.flatten(grads)
             t0 = flight.now()
+            if self._fused:
+                new_leaves = self._fused_reduce_apply(leaves, timeout_ms)
+                flight.span_since(_F_DP, t0)
+                new_params = jax.tree.unflatten(treedef, new_leaves)
+                for ck, p in zip(self.chunks, new_params):
+                    ck.params = p
+                return self._flush_stats(applies_before)
+            # same bucket granularity as the fused path, so the two
+            # flush modes differ ONLY in where the optimizer runs
             work = col.allreduce_coalesced_async(
                 leaves, group_name=self.group_name, op=ReduceOp.MEAN,
-                timeout_ms=timeout_ms)
+                timeout_ms=timeout_ms,
+                bucket_bytes=self._resolved_bucket_bytes())
             reduced = work.wait(timeout_ms)
             flight.span_since(_F_DP, t0)
             grads = jax.tree.unflatten(treedef, reduced)
         self._ensure_opt()
-        self.params, self._opt_state = self._update(
-            self.params, self._opt_state, grads)
-        losses, self._losses = self._losses, []
+        params = tuple(ck.params for ck in self.chunks)
+        new_params, self._opt_state = self._update(
+            params, self._opt_state, grads)
+        for ck, p in zip(self.chunks, new_params):
+            ck.params = p
+        return self._flush_stats(applies_before)
+
+    def _flush_stats(self, applies_before: int) -> Dict[str, Any]:
+        losses: List[float] = []
+        for ck in self.chunks:
+            losses.extend(ck.losses)
+            ck.losses = []
         return {"loss_sum": float(np.sum(losses)) if losses else 0.0,
-                "microbatches": self.M}
+                "microbatches": self.M,
+                "fused_bucket_applies":
+                    self._fused_applies - applies_before}
 
 
 # ----------------------------------------------------- worker-side run loop
@@ -336,7 +542,8 @@ def _run_stage_loop(rt: _StageRuntime, plan: _StagePlan) -> dict:
     """The per-actor eager-1F1B run loop (occupies the stage actor until
     its channels close): per flush, run backwards the moment their
     gradients are committed and forwards ahead up to the channel-depth
-    in-flight bound, then the optimizer flush and one report write.
+    in-flight bound — interleaving across this stage's V chunks when
+    virtual_stages > 1 — then the optimizer flush and one report write.
     Steady flushes touch channels and local compute only — the per-flush
     report carries this rank's observed
     ``ray_tpu_rpc_client_calls_total`` delta as proof."""
@@ -361,15 +568,15 @@ def _run_stage_loop(rt: _StageRuntime, plan: _StagePlan) -> dict:
             remote_specs.append(spec)
         return w
 
-    s, S, M = rt.stage, rt.S, rt.M
+    s, S, M, V = rt.stage, rt.S, rt.M, rt.V
     stage_label = {"stage": str(s)}
     try:
         in_ch = open_reader(plan.in_spec)
         label_ch = open_reader(plan.label_spec)
-        act_in = open_reader(plan.act_in)
-        grad_in = open_reader(plan.grad_in)
-        act_out = writer(plan.act_out)
-        grad_out = writer(plan.grad_out)
+        act_in = [open_reader(sp) for sp in plan.act_in]
+        grad_in = [open_reader(sp) for sp in plan.grad_in]
+        act_out = [writer(sp) for sp in plan.act_out]
+        grad_out = [writer(sp) for sp in plan.grad_out]
         report_w = writer(plan.report)
     except BaseException:
         release_pins()
@@ -404,6 +611,175 @@ def _run_stage_loop(rt: _StageRuntime, plan: _StagePlan) -> dict:
         w.write(payload, version)
         wait_box[0] += time.perf_counter() - t0
 
+    depth = (plan.act_out[0] or plan.grad_out[0] or plan.report).depth
+    limit = max(1, min(M, depth))
+
+    def run_flush_v1(vbase: int) -> None:
+        """The PR-8 one-chunk-per-stage eager 1F1B schedule, verbatim —
+        virtual_stages=1 must execute it byte-for-byte."""
+        fwd_m, bwd_m = [0], [0]
+        a_in, g_in = act_in[0], grad_in[0]
+        a_out, g_out = act_out[0], grad_out[0]
+
+        def forward():
+            t_mb = flight.now()
+            m = fwd_m[0]
+            fwd_m[0] += 1
+            v = vbase + 2 * m
+            x = read_value(in_ch if rt.first else a_in, v)
+            if rt.last:
+                labels = read_value(label_ch, v)
+                _, gx = rt.loss_backward(0, x, labels)
+                write_value(g_out, gx, v)
+            else:
+                write_value(a_out, rt.forward(0, m, x), v)
+            _m_microbatches.inc(labels=stage_label)
+            flight.span_since(_F_FWD, t_mb)
+
+        def backward():
+            m = bwd_m[0]
+            bwd_m[0] += 1
+            if rt.last:
+                return  # folded into forward (fwd/bwd adjacent)
+            t_mb = flight.now()
+            v = vbase + 2 * m
+            gy = read_value(g_in, v)
+            gx = rt.backward(0, m, gy)
+            if not rt.first:
+                write_value(g_out, gx, v)
+            flight.span_since(_F_BWD, t_mb)
+
+        # Eager 1F1B: backward-first whenever the grad is already
+        # committed (it frees a stash slot and feeds upstream),
+        # otherwise run forwards ahead up to the channel-depth
+        # in-flight bound. Strict 1F1B's fwd/bwd lockstep costs a
+        # full pipeline round-trip of blocking per steady pair; the
+        # eager order is the same math (backwards still run in
+        # microbatch order, so accumulation is deterministic) under
+        # the same memory bound — it just never parks while useful
+        # work is ready. When nothing is ready, block on the edge
+        # that must deliver next.
+        fwd_src = in_ch if rt.first else a_in
+        while bwd_m[0] < M:
+            progressed = False
+            if fwd_m[0] < M and fwd_m[0] - bwd_m[0] < limit \
+                    and fwd_src.ready(vbase + 2 * fwd_m[0]):
+                forward()
+                progressed = True
+            if bwd_m[0] < fwd_m[0] and (
+                    rt.last or g_in.ready(vbase + 2 * bwd_m[0])):
+                backward()
+                progressed = True
+            if progressed:
+                continue
+            # nothing committed yet: park on the required edge
+            if bwd_m[0] < fwd_m[0] and (
+                    fwd_m[0] == M or fwd_m[0] - bwd_m[0] >= limit):
+                backward()
+            else:
+                forward()
+
+    def run_flush_interleaved(vbase: int) -> None:
+        """The interleaved multi-chunk schedule (virtual_stages > 1):
+        eager over this stage's V chunks — deepest ready backward first
+        (it feeds upstream soonest), else SHALLOWEST ready forward
+        (earliest chunks feed everything downstream, so filling them
+        first keeps every stage's deeper chunks supplied; measured
+        better than deepest-first on the bubble probe), else an idle
+        poll that IS the measured bubble. An op is "ready" only when
+        its input is committed AND its local output slot is writable,
+        so the actor never parks in one chunk's blocked write while
+        another chunk has work (mirror edges can't be probed without an
+        RPC and stay blocking, like the PR-8 chain)."""
+        chs = rt.chunks
+        fwd_m = [0] * V
+        bwd_m = [0] * V
+
+        def fwd_src(v):
+            return in_ch if chs[v].first else act_in[v]
+
+        def do_forward(v: int) -> None:
+            t_mb = flight.now()
+            m = fwd_m[v]
+            fwd_m[v] += 1
+            ver = vbase + 2 * m
+            x = read_value(fwd_src(v), ver)
+            if chs[v].last:
+                labels = read_value(label_ch, ver)
+                _, gx = rt.loss_backward(v, x, labels)
+                write_value(grad_out[v], gx, ver)
+                bwd_m[v] += 1  # fwd/bwd fused on the loss chunk
+            else:
+                write_value(act_out[v], rt.forward(v, m, x), ver)
+            _m_microbatches.inc(labels=stage_label)
+            flight.span_since(_F_FWD, t_mb)
+
+        def do_backward(v: int) -> None:
+            t_mb = flight.now()
+            m = bwd_m[v]
+            bwd_m[v] += 1
+            ver = vbase + 2 * m
+            gy = read_value(grad_in[v], ver)
+            gx = rt.backward(v, m, gy)
+            if not chs[v].first:
+                write_value(grad_out[v], gx, ver)
+            flight.span_since(_F_BWD, t_mb)
+
+        def bwd_ready(v: int) -> bool:
+            if chs[v].last or bwd_m[v] >= fwd_m[v]:
+                return False
+            ver = vbase + 2 * bwd_m[v]
+            if not grad_in[v].ready(ver):
+                return False
+            w = grad_out[v]
+            return w is None or w.writable(ver)
+
+        def fwd_ready(v: int) -> bool:
+            if fwd_m[v] >= M or fwd_m[v] - bwd_m[v] >= limit:
+                return False
+            ver = vbase + 2 * fwd_m[v]
+            if not fwd_src(v).ready(ver):
+                return False
+            if chs[v].last:
+                if not label_ch.ready(ver):
+                    return False
+                w = grad_out[v]
+            else:
+                w = act_out[v]
+            return w is None or w.writable(ver)
+
+        total = M * V
+        idle = [0, 5e-5]  # spins, escalating delay (the _wait shape)
+        while sum(bwd_m) < total:
+            progressed = False
+            for v in reversed(range(V)):
+                if bwd_ready(v):
+                    do_backward(v)
+                    progressed = True
+                    break
+            if not progressed:
+                for v in range(V):
+                    if fwd_ready(v):
+                        do_forward(v)
+                        progressed = True
+                        break
+            if progressed:
+                idle[0], idle[1] = 0, 5e-5
+                continue
+            # nothing ready on any chunk's edges: the pipeline bubble.
+            # Poll with the channel-wait backoff — a close flips the
+            # probes true (ready()/writable() return True on closed),
+            # so the next pick raises instead of spinning forever.
+            t0 = time.perf_counter()
+            if idle[0] < 100:
+                time.sleep(0)
+            else:
+                time.sleep(idle[1])
+                idle[1] = min(idle[1] * 1.5, 0.002)
+            idle[0] += 1
+            if not first_read[0]:
+                wait_box[0] += time.perf_counter() - t0
+
     flush_idx = 0
     microbatches = 0
     try:
@@ -416,69 +792,13 @@ def _run_stage_loop(rt: _StageRuntime, plan: _StagePlan) -> dict:
             first_read[0] = True
             rpc_before = rpc._m_client_calls.total()
             vbase = 2 * (flush_idx * M + 1)
-            fwd_m, bwd_m = [0], [0]
 
-            def forward():
-                t_mb = flight.now()
-                m = fwd_m[0]
-                fwd_m[0] += 1
-                v = vbase + 2 * m
-                x = read_value(in_ch if rt.first else act_in, v)
-                if rt.last:
-                    labels = read_value(label_ch, v)
-                    _, gx = rt.loss_backward(x, labels)
-                    write_value(grad_out, gx, v)
-                else:
-                    write_value(act_out, rt.forward(m, x), v)
-                _m_microbatches.inc(labels=stage_label)
-                flight.span_since(_F_FWD, t_mb)
+            if V == 1:
+                run_flush_v1(vbase)
+            else:
+                run_flush_interleaved(vbase)
 
-            def backward():
-                m = bwd_m[0]
-                bwd_m[0] += 1
-                if rt.last:
-                    return  # folded into forward (fwd/bwd adjacent)
-                t_mb = flight.now()
-                v = vbase + 2 * m
-                gy = read_value(grad_in, v)
-                gx = rt.backward(m, gy)
-                if not rt.first:
-                    write_value(grad_out, gx, v)
-                flight.span_since(_F_BWD, t_mb)
-
-            # Eager 1F1B: backward-first whenever the grad is already
-            # committed (it frees a stash slot and feeds upstream),
-            # otherwise run forwards ahead up to the channel-depth
-            # in-flight bound. Strict 1F1B's fwd/bwd lockstep costs a
-            # full pipeline round-trip of blocking per steady pair; the
-            # eager order is the same math (backwards still run in
-            # microbatch order, so accumulation is deterministic) under
-            # the same memory bound — it just never parks while useful
-            # work is ready. When nothing is ready, block on the edge
-            # that must deliver next.
-            limit = max(1, min(
-                M, (plan.act_out or plan.grad_out or plan.report).depth))
-            fwd_src = in_ch if rt.first else act_in
-            while bwd_m[0] < M:
-                progressed = False
-                if fwd_m[0] < M and fwd_m[0] - bwd_m[0] < limit \
-                        and fwd_src.ready(vbase + 2 * fwd_m[0]):
-                    forward()
-                    progressed = True
-                if bwd_m[0] < fwd_m[0] and (
-                        rt.last or grad_in.ready(vbase + 2 * bwd_m[0])):
-                    backward()
-                    progressed = True
-                if progressed:
-                    continue
-                # nothing committed yet: park on the required edge
-                if bwd_m[0] < fwd_m[0] and (
-                        fwd_m[0] == M or fwd_m[0] - bwd_m[0] >= limit):
-                    backward()
-                else:
-                    forward()
-
-            microbatches += M
+            microbatches += M * V
             t_opt = flight.now()
             flush_stats = rt.flush()
             flight.span_since(_F_OPT, t_opt)
@@ -496,6 +816,9 @@ def _run_stage_loop(rt: _StageRuntime, plan: _StagePlan) -> dict:
                 "flush": flush_idx,
                 "loss_sum": flush_stats["loss_sum"],
                 "microbatches": M,
+                "virtual_stages": V,
+                "fused_bucket_applies":
+                    flush_stats["fused_bucket_applies"],
                 "rpc_calls": rpc._m_client_calls.total() - rpc_before,
                 "wait_s": wait_box[0],
                 "flush_s": total_s,
@@ -510,6 +833,8 @@ def _run_stage_loop(rt: _StageRuntime, plan: _StagePlan) -> dict:
                     "flushes_total": _m_flushes.value(labels=stage_label),
                     "stage_seconds_count":
                         _m_stage_seconds.count_total(),
+                    "fused_bucket_applies_total": _m_fused_applies.value(
+                        labels=stage_label),
                 },
             }
             report_w.write(serialization.pack(report), 2 * (flush_idx + 1))
@@ -545,22 +870,26 @@ def _run_stage_loop(rt: _StageRuntime, plan: _StagePlan) -> dict:
 # ------------------------------------------------------------- stage actor
 
 
-def _make_runtime(spec_blob, stage, num_stages, num_microbatches,
-                  optimizer, dp, dp_rank, group_name) -> _StageRuntime:
+def _make_runtime(spec_blobs, stage, num_stages, virtual_stages,
+                  num_microbatches, optimizer, dp, dp_rank, group_name,
+                  fused_flush, flush_bucket_bytes) -> _StageRuntime:
     return _StageRuntime(
-        _as_stage_spec(spec_blob), stage, num_stages, num_microbatches,
-        optimizer, dp, dp_rank, group_name)
+        [_as_stage_spec(b) for b in spec_blobs], stage, num_stages,
+        virtual_stages, num_microbatches, optimizer, dp, dp_rank,
+        group_name, fused_flush, flush_bucket_bytes)
 
 
 class _PipelineStageActorImpl:
     """Stage actor body (wrapped by ray_tpu.remote at trainer build so
     importing this module never requires an initialized runtime)."""
 
-    def __init__(self, spec_blob, stage, num_stages, num_microbatches,
-                 optimizer, dp, dp_rank, group_name):
-        self._rt = _make_runtime(spec_blob, stage, num_stages,
-                                 num_microbatches, optimizer, dp, dp_rank,
-                                 group_name)
+    def __init__(self, spec_blobs, stage, num_stages, virtual_stages,
+                 num_microbatches, optimizer, dp, dp_rank, group_name,
+                 fused_flush, flush_bucket_bytes):
+        self._rt = _make_runtime(spec_blobs, stage, num_stages,
+                                 virtual_stages, num_microbatches,
+                                 optimizer, dp, dp_rank, group_name,
+                                 fused_flush, flush_bucket_bytes)
 
     def ping(self):
         return "ok"
@@ -570,15 +899,16 @@ class _PipelineStageActorImpl:
 
     # -- dynamic task-per-stage path (microbenchmark baseline; same math)
 
-    def naive_fwd(self, m, x):
-        return np.asarray(self._rt.forward(m, np.asarray(x)))
+    def naive_fwd(self, v, m, x):
+        return np.asarray(self._rt.forward(v, m, np.asarray(x)))
 
-    def naive_loss_bwd(self, m, x, labels):
-        _, gx = self._rt.loss_backward(np.asarray(x), np.asarray(labels))
+    def naive_loss_bwd(self, v, m, x, labels):
+        _, gx = self._rt.loss_backward(v, np.asarray(x),
+                                       np.asarray(labels))
         return np.asarray(gx)
 
-    def naive_bwd(self, m, gy):
-        gx = self._rt.backward(m, np.asarray(gy))
+    def naive_bwd(self, v, m, gy):
+        gx = self._rt.backward(v, m, np.asarray(gy))
         return None if gx is None else np.asarray(gx)
 
     def naive_flush(self):
@@ -586,10 +916,15 @@ class _PipelineStageActorImpl:
 
     # -- introspection (valid before the loop starts or after it exits)
 
-    def fetch_params(self):
+    def fetch_params(self, chunk: Optional[int] = None):
         import jax
 
-        return jax.tree.map(np.asarray, self._rt.params)
+        if chunk is not None:
+            return jax.tree.map(np.asarray, self._rt.chunks[chunk].params)
+        if self._rt.V == 1:
+            return jax.tree.map(np.asarray, self._rt.chunks[0].params)
+        return [jax.tree.map(np.asarray, ck.params)
+                for ck in self._rt.chunks]
 
 
 _stage_actor_cls = None
@@ -608,26 +943,38 @@ def _stage_actor():
 
 
 class PipelineTrainer:
-    """Train a model sharded over S pipeline stages with 1F1B microbatch
-    scheduling over compiled-graph channels (module docstring has the
-    design; `ray_tpu.models.presets.pipeline_stage_defs` partitions the
-    transformer family into stage specs).
+    """Train a model sharded over S pipeline stages with (interleaved)
+    1F1B microbatch scheduling over compiled-graph channels (module
+    docstring has the design;
+    `ray_tpu.models.presets.pipeline_stage_defs` partitions the
+    transformer family into chunk specs).
 
-        stages = presets.pipeline_stage_defs(cfg, num_stages=4)
-        trainer = PipelineTrainer(stages, num_microbatches=8)
+        stages = presets.pipeline_stage_defs(cfg, num_stages=4,
+                                             virtual_stages=2)
+        trainer = PipelineTrainer(stages, num_microbatches=8,
+                                  virtual_stages=2)
         for batch in data:                # {"tokens": [B, L] int32}
             out = trainer.step(batch)    # {"loss": ..., "reports": [...]}
         trainer.shutdown()
 
-    ``dp`` replicates every stage; replicas sync gradients at flush with
-    one coalesced-mean p2p allreduce per stage group. ``mode="tasks"``
-    runs the same stage math as dynamic actor tasks through the object
-    store (the microbenchmark baseline).
+    ``stages`` holds ``S * virtual_stages`` chunk specs in pipeline
+    order; chunk c runs on stage actor ``c % S`` (stage s owns chunks
+    s, s+S, ... — the interleaved layout that shrinks the 1F1B bubble
+    roughly by 1/V). ``dp`` replicates every stage; replicas sync
+    gradients at flush with one coalesced-mean p2p allreduce per stage
+    group — ``fused_flush`` (default) applies the optimizer per bucket
+    as each reduce lands (leafwise optimizers only; pass False for
+    cross-leaf optimizers, which is also the measured unfused
+    baseline). ``mode="tasks"`` runs the same chunk math as dynamic
+    actor tasks through the object store (the microbenchmark baseline).
     """
 
     def __init__(self, stages: Sequence[Any], *, num_microbatches: int,
                  dp: int = 1, mode: str = "channels",
                  optimizer: Any = ("sgd", 0.1),
+                 virtual_stages: Optional[int] = None,
+                 fused_flush: bool = True,
+                 flush_bucket_bytes: Optional[int] = None,
                  channel_depth: Optional[int] = None,
                  buffer_bytes: Optional[int] = None,
                  stage_options: Optional[Sequence[dict]] = None,
@@ -637,7 +984,30 @@ class PipelineTrainer:
         if mode not in ("channels", "tasks"):
             raise ValueError(f"unknown mode {mode!r}")
         self._specs = [_as_stage_spec(s) for s in stages]
-        self._S = len(self._specs)
+        core = api._require_core()
+        self._core = core
+        # interleaved virtual stages: None takes the env knob; an
+        # explicit 0 (argument or RAY_TPU_PIPELINE_VIRTUAL_STAGES=0)
+        # RAISES instead of silently meaning 1 (the falsy-zero lesson)
+        if virtual_stages is None:
+            v = int(core.config.pipeline_virtual_stages)
+            v_source = "RAY_TPU_PIPELINE_VIRTUAL_STAGES"
+        else:
+            v = int(virtual_stages)
+            v_source = "virtual_stages"
+        if v < 1:
+            raise ValueError(
+                f"{v_source}={v} is invalid: virtual_stages must be >= 1 "
+                f"(1 = one chunk per stage; 0 does not mean 'default')")
+        self._V = v
+        n = len(self._specs)
+        if n % self._V != 0:
+            raise ValueError(
+                f"{n} chunk specs do not divide into virtual_stages="
+                f"{self._V} chunks per stage — build them with "
+                f"pipeline_stage_defs(cfg, S, virtual_stages={self._V}) "
+                f"so len(stages) == S * {self._V}")
+        self._S = n // self._V
         if self._S < 2:
             raise ValueError(
                 "PipelineTrainer needs >= 2 stages (single-stage training "
@@ -645,17 +1015,22 @@ class PipelineTrainer:
         self._M = int(num_microbatches)
         if self._M < 1:
             raise ValueError("num_microbatches must be >= 1")
+        if flush_bucket_bytes is not None and int(flush_bucket_bytes) < 1:
+            raise ValueError(
+                f"flush_bucket_bytes={flush_bucket_bytes} is invalid: "
+                f"pass None for the RAY_TPU_COLLECTIVE_COALESCE_BYTES "
+                f"default (0 does not mean 'default')")
         self._dp = int(dp)
         self._mode = mode
         self._name = name
-        core = api._require_core()
-        self._core = core
+        self._fused = bool(fused_flush)
         self._buffer = int(buffer_bytes or core.config.channel_buffer_bytes)
         cfg_depth = int(core.config.channel_depth or 1)
-        # 1F1B wants room for the in-flight microbatch differential; the
-        # config knob only wins when the operator raised it higher
+        # 1F1B wants room for the in-flight microbatch differential
+        # (S*V chunks deep when interleaved); the config knob only wins
+        # when the operator raised it higher
         self._depth = int(channel_depth) if channel_depth is not None \
-            else max(2, min(self._S + 1, self._M), cfg_depth)
+            else max(2, min(self._S * self._V + 1, self._M), cfg_depth)
         if self._depth < 1:
             raise ValueError("channel_depth must be >= 1")
         self._flush = 0
@@ -679,12 +1054,15 @@ class PipelineTrainer:
         self._actors: List[List[Any]] = []
         for r in range(self._dp):
             row = []
-            for s, spec in enumerate(self._specs):
+            for s in range(self._S):
                 acls = cls.options(**opts[s]) if s < len(opts) and opts[s] \
                     else cls
+                chunk_specs = [self._specs[s + u * self._S]
+                               for u in range(self._V)]
                 row.append(acls.remote(
-                    spec, s, self._S, self._M, optimizer, self._dp, r,
-                    f"{name}.{token}.stage{s}"))
+                    chunk_specs, s, self._S, self._V, self._M, optimizer,
+                    self._dp, r, f"{name}.{token}.stage{s}",
+                    self._fused, flush_bucket_bytes))
             self._actors.append(row)
         import ray_tpu
 
@@ -715,6 +1093,10 @@ class PipelineTrainer:
     @property
     def num_stages(self) -> int:
         return self._S
+
+    @property
+    def virtual_stages(self) -> int:
+        return self._V
 
     # -- build
 
@@ -763,37 +1145,49 @@ class PipelineTrainer:
             return self._actor_info[
                 self._actors[r][s]._actor_id.hex()]["node_addr"]
 
+        S, V = self._S, self._V
+        C = S * V  # total pipeline chunks
         self._in_specs, self._label_specs = [], []
         self._report_readers: List[List[_channels.LocalChannel]] = []
         plans: List[List[_StagePlan]] = []
         for r in range(self._dp):
             in_spec = self._create_channel(node_of(r, 0), 1, participants)
             label_spec = self._create_channel(
-                node_of(r, self._S - 1), 1, participants)
-            act = [self._create_channel(node_of(r, s + 1), 1, participants)
-                   for s in range(self._S - 1)]
-            grad = [self._create_channel(node_of(r, s), 1, participants)
-                    for s in range(self._S - 1)]
+                node_of(r, S - 1), 1, participants)
+            # per-chunk edges between the SAME S actors: chunk c runs on
+            # actor c % S, so edge c -> c+1 lands on actor (c+1) % S's
+            # node (channels live on the READER's node). V=1 reduces to
+            # the PR-8 neighbor-chain plan exactly
+            act = [self._create_channel(
+                node_of(r, (c + 1) % S), 1, participants)
+                for c in range(C - 1)]
+            grad = [self._create_channel(node_of(r, c % S), 1, participants)
+                    for c in range(C - 1)]
             # reports carry one small stats dict per flush, and the
             # driver acks flush t before scattering t+1 — depth 1 and a
             # small buffer, not S+1 slots of activation-sized pinned
             # arena each
             reports = [self._create_channel(driver_node, 1, participants,
                                             depth=1, buffer=64 * 1024)
-                       for _ in range(self._S)]
+                       for _ in range(S)]
             self._in_specs.append(in_spec)
             self._label_specs.append(label_spec)
             self._report_readers.append(
                 [self._local_channels[sp.key()] for sp in reports])
-            plans.append([_StagePlan(
-                in_spec=in_spec if s == 0 else None,
-                label_spec=label_spec if s == self._S - 1 else None,
-                act_in=act[s - 1] if s > 0 else None,
-                act_out=act[s] if s < self._S - 1 else None,
-                grad_in=grad[s] if s < self._S - 1 else None,
-                grad_out=grad[s - 1] if s > 0 else None,
-                report=reports[s],
-            ) for s in range(self._S)])
+
+            def stage_plan(s: int) -> _StagePlan:
+                cs = [s + u * S for u in range(V)]  # this stage's chunks
+                return _StagePlan(
+                    in_spec=in_spec if s == 0 else None,
+                    label_spec=label_spec if s == S - 1 else None,
+                    act_in=[act[c - 1] if c > 0 else None for c in cs],
+                    act_out=[act[c] if c < C - 1 else None for c in cs],
+                    grad_in=[grad[c] if c < C - 1 else None for c in cs],
+                    grad_out=[grad[c - 1] if c > 0 else None for c in cs],
+                    report=reports[s],
+                )
+
+            plans.append([stage_plan(s) for s in range(S)])
 
         # driver-side input writers (local write or mirror push)
         def driver_writer(spec):
@@ -924,16 +1318,21 @@ class PipelineTrainer:
         import ray_tpu
 
         mbs = self._split(batch)
-        barriers, loss_refs = [], []
+        S, V = self._S, self._V
+        C = S * V
+        barriers = []
         for r in range(self._dp):
             row = self._actors[r]
             for m, mb in enumerate(mbs[r]):
-                ref = row[0].naive_fwd.remote(m, mb)
-                for s in range(1, self._S - 1):
-                    ref = row[s].naive_fwd.remote(m, ref)
-                gref = row[self._S - 1].naive_loss_bwd.remote(m, ref, mb)
-                for s in range(self._S - 2, -1, -1):
-                    gref = row[s].naive_bwd.remote(m, gref)
+                # chunk c runs on actor c % S as local chunk c // S —
+                # the same interleaved layout the channel loops execute
+                ref = row[0].naive_fwd.remote(0, m, mb)
+                for c in range(1, C - 1):
+                    ref = row[c % S].naive_fwd.remote(c // S, m, ref)
+                gref = row[(C - 1) % S].naive_loss_bwd.remote(
+                    (C - 1) // S, m, ref, mb)
+                for c in range(C - 2, -1, -1):
+                    gref = row[c % S].naive_bwd.remote(c // S, m, gref)
                 barriers.append(gref)
         ray_tpu.get(barriers, timeout=600)
         stats = ray_tpu.get(
@@ -947,13 +1346,18 @@ class PipelineTrainer:
 
     # -- introspection / teardown
 
-    def fetch_params(self, stage: int, dp_rank: int = 0):
+    def fetch_params(self, stage: int, dp_rank: int = 0,
+                     chunk: Optional[int] = None):
         """Stage shard params (tasks mode anytime; channels mode after
-        shutdown — the run loop dedicates the actor)."""
+        shutdown — the run loop dedicates the actor). At
+        virtual_stages=1 returns the stage's single chunk tree; at V > 1
+        a list of the stage's V chunk trees (or one tree with
+        ``chunk=`` the local index)."""
         import ray_tpu
 
         return ray_tpu.get(
-            self._actors[dp_rank][stage].fetch_params.remote(), timeout=120)
+            self._actors[dp_rank][stage].fetch_params.remote(chunk),
+            timeout=120)
 
     def shutdown(self, kill_actors: bool = True,
                  timeout: float = 30) -> Dict[str, Any]:

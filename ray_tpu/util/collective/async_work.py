@@ -25,6 +25,12 @@ once the device plane is fast:
     allocates nothing (``ray_tpu_collective_staging_bytes`` goes flat
     after warmup), and a MEAN is pre-scaled into the pack copy so no
     post-reduce divide pass exists anywhere.
+  * ``on_bucket(indices, arrays)`` (optional) fires on the reducer
+    thread the moment each bucket's reduce lands — the hook the
+    pipeline trainer's fused in-bucket optimizer rides, so a bucket's
+    jitted apply overlaps the remaining buckets' rounds. The sync
+    fallback still fires it once per bucket on the caller's thread; a
+    callback exception poisons the group like any mid-round failure.
 
 Failure semantics match the synchronous path exactly: ANY exception
 escaping a round poisons the group (a retried collective could otherwise
@@ -204,6 +210,33 @@ def bucket_layout(arrs: Sequence[Any], bucket_bytes: int) -> List[List[int]]:
     return buckets
 
 
+def validate_on_bucket(on_bucket) -> None:
+    """Fail a bad ``on_bucket=`` LOUDLY on the caller's thread, at
+    construction: inside the runner a non-callable would poison the
+    whole group on the first bucket (and a falsy-but-wrong value — 0,
+    "", an awaited coroutine — would silently mean "no callback", the
+    falsy-zero class of bug)."""
+    if on_bucket is None or callable(on_bucket):
+        return
+    raise TypeError(
+        f"on_bucket must be a callable (indices, arrays) -> None, got "
+        f"{type(on_bucket).__name__}: {on_bucket!r}")
+
+
+def fire_on_bucket(leaves: Sequence[Any], bucket_bytes: int,
+                   results: Sequence[np.ndarray], on_bucket) -> None:
+    """Replay the runner's per-bucket callback contract over already-
+    reduced ``results``: same-dtype buckets laid out from the INPUT
+    leaves (an integer MEAN widens its results to float, which would
+    regroup), fired in the runner's reverse-flatten order, each leaf
+    exactly once. The ONE encoding of the contract every synchronous
+    fallback (BaseGroup, solo GradientAverager) replays — bucket_layout
+    only touches .dtype/.size, so device-array leaves cost no
+    materialization here."""
+    for bucket in reversed(bucket_layout(leaves, bucket_bytes)):
+        on_bucket(list(bucket), [results[i] for i in bucket])
+
+
 def validate_out(leaves: Sequence[Any], op: ReduceOp,
                  out: Optional[Sequence[np.ndarray]],
                  world_size: int) -> None:
@@ -246,17 +279,19 @@ def _materialize(leaves: List[Any]) -> List[np.ndarray]:
 
 class _Submission:
     __slots__ = ("work", "leaves", "op", "timeout_ms", "bucket_bytes",
-                 "out", "results", "remaining")
+                 "out", "results", "remaining", "on_bucket")
 
     def __init__(self, work: CollectiveWork, leaves: List[Any],
                  op: ReduceOp, timeout_ms: int, bucket_bytes: int,
-                 out: Optional[Sequence[np.ndarray]]):
+                 out: Optional[Sequence[np.ndarray]],
+                 on_bucket=None):
         self.work = work
         self.leaves = leaves
         self.op = op
         self.timeout_ms = timeout_ms
         self.bucket_bytes = bucket_bytes
         self.out = out
+        self.on_bucket = on_bucket  # per-bucket completion callback
         self.results: List[Optional[np.ndarray]] = [None] * len(leaves)
         self.remaining = 0  # buckets still to reduce (set by the mover)
 
@@ -310,7 +345,9 @@ class AsyncRunner:
 
     def submit(self, tensors: Sequence[Any], op: ReduceOp, timeout_ms: int,
                bucket_bytes: int,
-               out: Optional[Sequence[np.ndarray]]) -> CollectiveWork:
+               out: Optional[Sequence[np.ndarray]],
+               on_bucket=None) -> CollectiveWork:
+        validate_on_bucket(on_bucket)
         work = CollectiveWork(self._group._public_name)
         if not len(tensors):
             work._finish([])
@@ -318,7 +355,8 @@ class AsyncRunner:
         leaves = [t if hasattr(t, "dtype") and hasattr(t, "size")
                   else np.asarray(t) for t in tensors]
         validate_out(leaves, op, out, self._group.world_size)
-        sub = _Submission(work, leaves, op, timeout_ms, bucket_bytes, out)
+        sub = _Submission(work, leaves, op, timeout_ms, bucket_bytes, out,
+                          on_bucket=on_bucket)
         with self._lock:
             if self._dead is not None:
                 raise CollectiveError(
@@ -466,6 +504,18 @@ class AsyncRunner:
                     else:
                         sub.results[i] = seg.reshape(shape).copy()
                     off += size
+                if sub.on_bucket is not None:
+                    # per-bucket completion callback, ON THIS THREAD —
+                    # the caller's per-bucket work (e.g. a jitted
+                    # optimizer apply) overlaps the remaining buckets'
+                    # device_get + reduce rounds. Runs BEFORE the
+                    # staging release: a raise falls to the handler
+                    # below, which releases once and poisons the group
+                    # (callback state may be mid-update — same invariant
+                    # as a failed round)
+                    sub.on_bucket(
+                        [i for i, _, _ in task.meta],
+                        [sub.results[i] for i, _, _ in task.meta])
                 self.pool.release(task.staging)
                 flight.span_since(_F_REDUCE, t0)
                 self._finish_bucket(sub)

@@ -192,18 +192,33 @@ class BaseGroup:
         bucket_bytes: Optional[int] = None,
         out: Optional[Sequence[Any]] = None,
         overlap: Optional[bool] = None,
+        on_bucket=None,
     ):
         """Async-handle form of :meth:`allreduce_coalesced`. The base
         implementation (xla backend, and the explicit
         ``RAY_TPU_COLLECTIVE_OVERLAP=0`` fallback on the host backend)
         runs synchronously and returns an already-completed handle —
-        callers write one code path and the knob decides."""
-        from ray_tpu.util.collective.async_work import _CompletedWork
+        callers write one code path and the knob decides. ``on_bucket``
+        (when given) still fires exactly once per bucket, on the
+        caller's thread, in the runner's reverse-flatten order — the
+        per-bucket contract holds on every path, only the overlap is
+        lost."""
+        from ray_tpu.util.collective.async_work import (_CompletedWork,
+                                                        fire_on_bucket,
+                                                        validate_on_bucket)
 
-        return _CompletedWork(
-            self._public_name,
-            self.allreduce_coalesced(tensors, op, timeout_ms, bucket_bytes,
-                                     out=out))
+        validate_on_bucket(on_bucket)
+        results = self.allreduce_coalesced(tensors, op, timeout_ms,
+                                           bucket_bytes, out=out)
+        if on_bucket is not None and len(results):
+            leaves = [t if hasattr(t, "dtype") and hasattr(t, "size")
+                      else np.asarray(t) for t in tensors]
+            fire_on_bucket(
+                leaves,
+                bucket_bytes if bucket_bytes is not None
+                else _default_bucket_bytes(),
+                results, on_bucket)
+        return _CompletedWork(self._public_name, results)
 
     def _raise_if_stale(self) -> None:
         """After a timeout/peer failure on a declaratively-created group,
@@ -595,17 +610,25 @@ class HostGroup(BaseGroup):
         bucket_bytes: Optional[int] = None,
         out: Optional[Sequence[Any]] = None,
         overlap: Optional[bool] = None,
+        on_bucket=None,
     ):
         """Overlapped coalesced allreduce: returns a ``CollectiveWork``
         immediately; the group's runner pipelines per-bucket device->host
-        transfers against shm/ring reduce rounds. ``overlap=False`` (or
-        ``RAY_TPU_COLLECTIVE_OVERLAP=0``) takes the synchronous path and
-        returns an already-completed handle."""
+        transfers against shm/ring reduce rounds. ``on_bucket(indices,
+        arrays)`` fires on the runner's reducer thread the moment each
+        bucket's reduce lands — per-bucket downstream work (e.g. a
+        fused optimizer apply) overlaps the remaining buckets' rounds.
+        ``overlap=False`` (or ``RAY_TPU_COLLECTIVE_OVERLAP=0``) takes
+        the synchronous path and returns an already-completed handle."""
         if overlap is None:
             overlap = _overlap_enabled()
         if not overlap or self.world_size == 1:
             return super().allreduce_coalesced_async(
-                tensors, op, timeout_ms, bucket_bytes, out=out)
+                tensors, op, timeout_ms, bucket_bytes, out=out,
+                on_bucket=on_bucket)
+        from ray_tpu.util.collective.async_work import validate_on_bucket
+
+        validate_on_bucket(on_bucket)
         if self._poisoned is not None:
             # same staleness-first remedy as the sync path: a driver
             # re-create of this declarative group drops the cached member
@@ -617,7 +640,7 @@ class HostGroup(BaseGroup):
         if bucket_bytes is None:
             bucket_bytes = _default_bucket_bytes()
         return self._ensure_runner().submit(
-            tensors, op, timeout_ms, bucket_bytes, out)
+            tensors, op, timeout_ms, bucket_bytes, out, on_bucket=on_bucket)
 
     # ----- delegated ops (stale-generation check on the failure path)
 
@@ -1061,6 +1084,7 @@ def allreduce_coalesced_async(
     bucket_bytes: Optional[int] = None,
     out: Optional[Sequence[Any]] = None,
     overlap: Optional[bool] = None,
+    on_bucket=None,
 ):
     """Overlapped coalesced allreduce — returns a ``CollectiveWork``
     handle (``.wait()``/``.done()``) immediately and hides the host-side
@@ -1069,11 +1093,24 @@ def allreduce_coalesced_async(
     backward order) and pipelines their shm/ring reduce rounds. Device
     arrays are accepted directly — do NOT ``np.asarray`` the leaves
     first, that would serialize the transfers this API exists to
-    overlap. ``overlap`` forces the path (None = the
+    overlap. ``on_bucket(indices, arrays)`` (optional) fires exactly
+    once per coalesced bucket THE MOMENT its reduce lands — on the
+    runner's reducer thread — with the input indices of the bucket's
+    tensors and their reduced arrays, so per-bucket downstream work
+    (the pipeline trainer's fused optimizer apply) overlaps the
+    remaining buckets' rounds; a callback exception poisons the group
+    like any mid-round failure. ``overlap`` forces the path (None = the
     ``RAY_TPU_COLLECTIVE_OVERLAP`` knob); the sync fallback returns an
-    already-completed handle, so call sites stay identical."""
+    already-completed handle and still fires ``on_bucket`` per bucket
+    on the caller's thread, so call sites stay identical."""
+    from ray_tpu.util.collective.async_work import validate_on_bucket
+
+    # misuse fails HERE, before group resolution: a bad callback must
+    # raise at the call site, not poison the group from the runner
+    validate_on_bucket(on_bucket)
     return _resolve_group(group_name).allreduce_coalesced_async(
-        tensors, op, timeout_ms, bucket_bytes, out=out, overlap=overlap)
+        tensors, op, timeout_ms, bucket_bytes, out=out, overlap=overlap,
+        on_bucket=on_bucket)
 
 
 def reduce(

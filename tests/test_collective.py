@@ -104,6 +104,27 @@ class Worker:
                 [np.asarray(a) for a in work.wait(60000)],
                 work.overlapped)
 
+    def overlap_on_bucket(self, name="default"):
+        """Async-runner on_bucket contract: one callback per coalesced
+        bucket, fired as its reduce lands, covering every leaf exactly
+        once. Returns (covered indices, n calls, first element of each
+        reduced leaf)."""
+        from ray_tpu.util import collective as col
+
+        calls = []
+
+        def cb(indices, arrays):
+            calls.append(list(indices))
+
+        tensors = [np.full(8, 1.0, np.float32),
+                   np.full(4, 2.0, np.float32),
+                   np.full(6, 3.0, np.float64)]
+        work = col.allreduce_coalesced_async(
+            tensors, group_name=name, overlap=True, on_bucket=cb)
+        res = work.wait(60000)
+        covered = sorted(i for ind in calls for i in ind)
+        return covered, len(calls), [float(np.asarray(r)[0]) for r in res]
+
     def overlap_out_of_order(self, name="default"):
         from ray_tpu.util import collective as col
 
@@ -545,6 +566,91 @@ class TestOverlapWorld4:
             np.testing.assert_allclose(o, [4.0])
         for w in workers:
             ray_tpu.kill(w)
+
+
+class TestOnBucket:
+    """`on_bucket=` per-bucket completion callbacks (the fused in-bucket
+    optimizer hook): exactly one call per coalesced bucket on every
+    path, misuse rejected at the call site."""
+
+    def test_misuse_raises_before_group_resolution(self):
+        from ray_tpu.util import collective as col
+
+        # a non-callable must fail AT THE CALL SITE (TypeError naming
+        # the param), not poison a group from the runner thread — and
+        # before group resolution, so no group needs to exist
+        with pytest.raises(TypeError, match="on_bucket"):
+            col.allreduce_coalesced_async(
+                [np.ones(4)], group_name="no_such_group_ob", on_bucket=42)
+
+    def test_solo_group_fires_per_bucket(self, ray_init):
+        """world_size=1 (and the overlap=0 sync fallback generally)
+        still honors the contract: same-dtype buckets, every leaf
+        covered exactly once, results identical."""
+        from ray_tpu.util import collective as col
+
+        col.init_collective_group(1, 0, backend="host",
+                                  group_name="solo_ob")
+        try:
+            tensors = [np.full(8, 2.0, np.float32),
+                       np.full(4, 3.0, np.float32),
+                       np.full(6, 5.0, np.float64)]
+            calls = []
+
+            def cb(indices, arrays):
+                calls.append((list(indices),
+                              [np.dtype(a.dtype) for a in arrays]))
+
+            work = col.allreduce_coalesced_async(
+                tensors, group_name="solo_ob", on_bucket=cb)
+            res = work.wait(5000)
+            covered = sorted(i for ind, _ in calls for i in ind)
+            assert covered == [0, 1, 2], calls
+            for _, dtypes in calls:
+                assert len(set(dtypes)) == 1, (
+                    "a bucket mixed dtypes", calls)
+            for r, t in zip(res, tensors):
+                np.testing.assert_allclose(r, t)
+        finally:
+            col.destroy_collective_group("solo_ob")
+
+    def test_gradient_averager_threads_on_bucket(self):
+        """GradientAverager.begin(on_bucket=) — the train-loop surface
+        of the hook — honors the per-bucket contract on the solo
+        fallback too (same-dtype buckets, every leaf exactly once), and
+        rejects misuse at the call site."""
+        import jax
+
+        from ray_tpu.train._internal.gradients import GradientAverager
+
+        avg = GradientAverager(group_name="ga_ob", world_size=1, rank=0,
+                               init_group=False)
+        grads = {"a": np.full((4, 4), 2.0, np.float32),
+                 "b": np.full(8, 3.0, np.float32),
+                 "c": np.full(6, 5.0, np.float64)}
+        calls = []
+        work = avg.begin(grads, on_bucket=lambda i, a: calls.append(
+            (list(i), [np.dtype(x.dtype) for x in a])))
+        out = work.wait_tree(5000)
+        covered = sorted(i for ind, _ in calls for i in ind)
+        assert covered == [0, 1, 2], calls
+        for _, dts in calls:
+            assert len(set(dts)) == 1, ("a bucket mixed dtypes", calls)
+        for g, o in zip(jax.tree.leaves(grads), jax.tree.leaves(out)):
+            np.testing.assert_allclose(np.asarray(o), g)
+        with pytest.raises(TypeError, match="on_bucket"):
+            avg.begin(grads, on_bucket="nope")
+
+    def test_world4_runner_fires_per_bucket(self, quad):
+        outs = ray_tpu.get(
+            [w.overlap_on_bucket.remote("quad") for w in quad])
+        for covered, n_calls, firsts in outs:
+            assert covered == [0, 1, 2], outs
+            # two f32 leaves coalesce into one bucket; the f64 leaf
+            # buckets alone — a single whole-tree call would hide the
+            # per-bucket overlap the fused optimizer rides
+            assert n_calls == 2, outs
+            np.testing.assert_allclose(firsts, [4.0, 8.0, 12.0])
 
 
 class TestRingForced:

@@ -273,6 +273,328 @@ class TestPipelineContracts:
             trainer.shutdown()
 
 
+class TestInterleavedVirtualStages:
+    def test_v2_interleaved_matches_local_training(self, ray_init):
+        """S=2, V=2: the four-chunk interleaved schedule (stage 0 owns
+        chunks 0,2; stage 1 owns 1,3) must reproduce the fused
+        single-process trajectory to fp32 tolerance every step."""
+        from ray_tpu.models import presets
+        from ray_tpu.train import PipelineTrainer
+
+        cfg = _tiny_cfg(num_layers=4)
+        batch = _batch()
+        ref = _local_losses(cfg, batch, num_microbatches=4, steps=3)
+        trainer = PipelineTrainer(
+            presets.pipeline_stage_defs(cfg, 2, virtual_stages=2, seed=0),
+            num_microbatches=4, virtual_stages=2, optimizer=("sgd", 0.05))
+        try:
+            assert trainer.is_channel_backed
+            assert trainer.channel_depth > 1
+            assert trainer.virtual_stages == 2
+            assert trainer.num_stages == 2
+            got = []
+            for _ in range(3):
+                out = trainer.step(batch)
+                got.append(out["loss"])
+                for rep in out["reports"]:
+                    assert rep["virtual_stages"] == 2
+        finally:
+            trainer.shutdown()
+        assert np.allclose(got, ref, atol=1e-5), (got, ref)
+        assert got[-1] < got[0], "no training progress on a fixed batch"
+
+    def test_v1_bit_parity_with_default_schedule(self, ray_init):
+        """virtual_stages=1 must run the PR-8 schedule byte-for-byte:
+        an explicit V=1 trainer and a default trainer on the same model
+        produce BIT-IDENTICAL losses (not merely close)."""
+        from ray_tpu.models import presets
+        from ray_tpu.train import PipelineTrainer
+
+        cfg = _tiny_cfg()
+        batch = _batch()
+
+        def run(**kw):
+            t = PipelineTrainer(
+                presets.pipeline_stage_defs(cfg, 2, seed=0),
+                num_microbatches=2, optimizer=("sgd", 0.05), **kw)
+            try:
+                assert t.virtual_stages == 1
+                return [t.step(batch)["loss"] for _ in range(2)]
+            finally:
+                t.shutdown()
+
+        explicit = run(virtual_stages=1)
+        default = run()
+        assert explicit == default, (explicit, default)
+
+    @pytest.mark.perf
+    def test_zero_rpcs_and_metrics_under_interleaving(self, ray_init):
+        """The zero-control-plane-RPC flush contract re-asserted at
+        V=2: steady interleaved flushes cost channel ops and local
+        compute only, and the chunk-microbatch counter moves M*V per
+        flush."""
+        from ray_tpu._private.rpc import _m_client_calls
+        from ray_tpu.models import presets
+        from ray_tpu.train import PipelineTrainer
+
+        cfg = _tiny_cfg(num_layers=4)
+        batch = _batch()
+        trainer = PipelineTrainer(
+            presets.pipeline_stage_defs(cfg, 2, virtual_stages=2, seed=0),
+            num_microbatches=4, virtual_stages=2, optimizer=("sgd", 0.05))
+        try:
+            trainer.step(batch)  # warm: jits compiled, pins taken
+            driver_before = _m_client_calls.total()
+            out = None
+            for _ in range(2):
+                out = trainer.step(batch)
+                for rep in out["reports"]:
+                    assert rep["rpc_calls"] == 0, (
+                        f"stage {rep['stage']} issued "
+                        f"{rep['rpc_calls']} control-plane RPCs in a "
+                        f"steady interleaved flush")
+            assert _m_client_calls.total() == driver_before
+            for rep in out["reports"]:
+                m = rep["metrics"]
+                # 3 flushes x M=4 microbatches x V=2 chunks per stage
+                assert m["microbatches_total"] == 3 * 4 * 2
+                assert m["flushes_total"] == 3
+                assert 0.0 <= rep["bubble_fraction"] <= 1.0
+                assert rep["fused_bucket_applies"] == 0  # dp=1: no reduce
+        finally:
+            trainer.shutdown()
+
+    def test_teardown_and_stage_death_at_v2(self, ray_init):
+        """Interleaved teardown returns every pin (twice the per-chunk
+        channels of V=1), and a stage kill mid-training still surfaces
+        a clean ChannelClosedError/ActorDiedError — never a hang."""
+        import gc
+
+        from ray_tpu._private import api
+        from ray_tpu._private.exceptions import ActorDiedError
+        from ray_tpu.models import presets
+        from ray_tpu.train import PipelineTrainer
+
+        core = api._core
+        gc.collect()
+        time.sleep(0.3)
+        pins_before = _store_pins(core)
+        cfg = _tiny_cfg(num_layers=4)
+        batch = _batch()
+        defs = presets.pipeline_stage_defs(cfg, 2, virtual_stages=2,
+                                           seed=0)
+        trainer = PipelineTrainer(
+            defs, num_microbatches=2, virtual_stages=2,
+            optimizer=("sgd", 0.05))
+        trainer.step(batch)
+        assert _store_pins(core) > pins_before
+        trainer.shutdown()
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if _store_pins(core) == pins_before:
+                break
+            time.sleep(0.2)
+        assert _store_pins(core) == pins_before, (
+            "interleaved pipeline leaked pins")
+        with pytest.raises(ChannelClosedError):
+            trainer.step(batch)
+
+        trainer = PipelineTrainer(
+            defs, num_microbatches=2, virtual_stages=2,
+            optimizer=("sgd", 0.05))
+        trainer.step(batch)
+        ray_tpu.kill(trainer._actors[0][1])
+        with pytest.raises((ChannelClosedError, ActorDiedError)):
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                trainer.step(batch)
+        trainer.shutdown()
+
+    @pytest.mark.slow
+    def test_dp2_v2_interleaved_matches_local(self, ray_init):
+        """dp=2 x V=2: interleaved chunks AND the flush-time coalesced
+        allreduce together must still reproduce the single-replica
+        trajectory exactly."""
+        from ray_tpu.models import presets
+        from ray_tpu.train import PipelineTrainer
+
+        cfg = _tiny_cfg(num_layers=4)
+        batch = _batch()
+        ref = _local_losses(cfg, batch, num_microbatches=2, steps=2)
+        trainer = PipelineTrainer(
+            presets.pipeline_stage_defs(cfg, 2, virtual_stages=2, seed=0),
+            num_microbatches=2, dp=2, virtual_stages=2,
+            optimizer=("sgd", 0.05))
+        try:
+            both = np.concatenate([batch, batch])
+            got = [trainer.step(both)["loss"] for _ in range(2)]
+        finally:
+            trainer.shutdown()
+        assert np.allclose(got, ref, atol=1e-5), (got, ref)
+
+
+class TestFusedFlush:
+    def test_fused_reduce_apply_unit(self, ray_init):
+        """The fused in-bucket machinery in one process: a dp-flagged
+        stage runtime over a WORLD-1 collective group (mean over one
+        rank = identity) must produce the exact plain-SGD update while
+        applying per bucket — buckets cover every leaf once and the
+        apply counter moves once per bucket."""
+        import jax
+        import optax
+
+        from ray_tpu.models import presets
+        from ray_tpu.train._internal import pipeline as pl
+        from ray_tpu.util import collective as col
+
+        cfg = _tiny_cfg()
+        defs = presets.pipeline_stage_defs(cfg, 2, seed=0)
+        col.init_collective_group(1, 0, backend="host",
+                                  group_name="fused_unit")
+        try:
+            rt = pl._StageRuntime(
+                [pl._as_stage_spec(defs[0])], 0, 2, 1, 2,
+                ("sgd", 0.05), dp=2, dp_rank=0, group_name="fused_unit",
+                fused_flush=True, flush_bucket_bytes=2048)
+            rt._group_ready = True  # ride the world-1 group directly
+            params0 = jax.tree.map(np.asarray, rt.chunks[0].params)
+            grads = jax.tree.map(
+                lambda p: np.ones_like(p), rt.chunks[0].params)
+            rt.chunks[0].acc = grads
+            stats = rt.flush()
+            # >1 buckets actually landed (2KB buckets over a multi-leaf
+            # tree) and each applied once
+            assert stats["fused_bucket_applies"] > 1
+            assert rt._fused_applies == stats["fused_bucket_applies"]
+            # reference: one optax.sgd step on grads/M
+            opt = optax.sgd(0.05)
+            ref_grads = jax.tree.map(lambda g: g / rt.M, grads)
+            upd, _ = opt.update(ref_grads, opt.init(params0), params0)
+            ref = optax.apply_updates(params0, upd)
+            got = jax.tree.map(np.asarray, rt.chunks[0].params)
+            leaves_ref = jax.tree.leaves(ref)
+            leaves_got = jax.tree.leaves(got)
+            assert len(leaves_ref) == len(leaves_got)
+            for a, b in zip(leaves_got, leaves_ref):
+                np.testing.assert_allclose(a, b, atol=1e-7)
+        finally:
+            col.destroy_collective_group("fused_unit")
+
+    @pytest.mark.slow
+    def test_fused_matches_unfused_dp2(self, ray_init):
+        """dp=2: the fused in-bucket flush (per-bucket jitted applies
+        overlapped with the remaining reduces) must match the unfused
+        full-tree flush AND the local reference — and the engagement
+        counters must prove which path ran."""
+        from ray_tpu.models import presets
+        from ray_tpu.train import PipelineTrainer
+
+        cfg = _tiny_cfg()
+        batch = _batch()
+        ref = _local_losses(cfg, batch, num_microbatches=2, steps=2)
+        both = np.concatenate([batch, batch])
+
+        def run(fused):
+            t = PipelineTrainer(
+                presets.pipeline_stage_defs(cfg, 2, seed=0),
+                num_microbatches=2, dp=2, optimizer=("sgd", 0.05),
+                fused_flush=fused, flush_bucket_bytes=4096)
+            losses, applies = [], []
+            try:
+                for _ in range(2):
+                    out = t.step(both)
+                    losses.append(out["loss"])
+                    applies.extend(r["fused_bucket_applies"]
+                                   for r in out["reports"])
+            finally:
+                t.shutdown()
+            return losses, applies
+
+        fused_losses, fused_applies = run(True)
+        unfused_losses, unfused_applies = run(False)
+        assert np.allclose(fused_losses, ref, atol=1e-5)
+        assert np.allclose(unfused_losses, ref, atol=1e-5)
+        assert all(a > 1 for a in fused_applies), (
+            "fused flush never applied per bucket", fused_applies)
+        assert all(a == 0 for a in unfused_applies), unfused_applies
+
+
+class TestVirtualStageValidation:
+    def test_trainer_rejects_zero_and_mismatch(self, ray_init):
+        from ray_tpu._private import api
+        from ray_tpu.models import presets
+        from ray_tpu.train import PipelineTrainer
+
+        cfg = _tiny_cfg(num_layers=3)
+        defs3 = presets.pipeline_stage_defs(cfg, 3, seed=0)
+        with pytest.raises(ValueError, match="virtual_stages"):
+            PipelineTrainer(defs3, num_microbatches=2, virtual_stages=0)
+        with pytest.raises(ValueError, match="divide"):
+            PipelineTrainer(defs3, num_microbatches=2, virtual_stages=2)
+        with pytest.raises(ValueError, match="flush_bucket_bytes"):
+            PipelineTrainer(defs3, num_microbatches=2,
+                            flush_bucket_bytes=0)
+        # the env knob path: an explicit RAY_TPU_PIPELINE_VIRTUAL_STAGES=0
+        # raises naming the env var, never silently meaning 1
+        core = api._require_core()
+        old = core.config.pipeline_virtual_stages
+        core.config.pipeline_virtual_stages = 0
+        try:
+            with pytest.raises(ValueError,
+                               match="RAY_TPU_PIPELINE_VIRTUAL_STAGES"):
+                PipelineTrainer(defs3, num_microbatches=2)
+        finally:
+            core.config.pipeline_virtual_stages = old
+
+    def test_stage_defs_rejects_zero_and_env_zero(self):
+        from ray_tpu._private import config as cfgmod
+        from ray_tpu.models import presets
+
+        cfg = _tiny_cfg(num_layers=4)
+        with pytest.raises(ValueError, match="virtual_stages"):
+            presets.pipeline_stage_defs(cfg, 2, virtual_stages=0)
+        old = cfgmod._global_config
+        zero = cfgmod.Config()
+        zero.pipeline_virtual_stages = 0
+        cfgmod.set_global_config(zero)
+        try:
+            with pytest.raises(ValueError,
+                               match="RAY_TPU_PIPELINE_VIRTUAL_STAGES"):
+                presets.pipeline_stage_defs(cfg, 2)
+        finally:
+            cfgmod.set_global_config(old)
+
+    def test_v_exceeds_blocks_per_stage_actionable(self):
+        """The rejection must carry the counts a user needs: the config
+        field, the per-stage block budget, and the fix."""
+        from ray_tpu.models import presets
+
+        cfg = _tiny_cfg(num_layers=2)
+        with pytest.raises(ValueError) as ei:
+            presets.pipeline_stage_defs(cfg, 2, virtual_stages=2)
+        msg = str(ei.value)
+        assert "blocks-per-stage" in msg
+        assert "num_layers=2" in msg
+        assert "virtual_stages <= 1" in msg
+
+    def test_partition_errors_name_config_fields(self):
+        """The tied-embeddings / MoE rejections name the offending
+        config FIELD and the fix (they used to read as generic pipeline
+        complaints)."""
+        from ray_tpu.models import presets
+
+        tied = presets.llama_debug(num_layers=2, tie_embeddings=True)
+        with pytest.raises(ValueError) as ei:
+            presets.pipeline_stage_defs(tied, 2)
+        assert "cfg.tie_embeddings=True" in str(ei.value)
+        assert "tie_embeddings=False" in str(ei.value)
+        moe = presets.moe_debug()
+        with pytest.raises(ValueError) as ei:
+            presets.pipeline_stage_defs(moe, 2)
+        assert "cfg.mlp='moe'" in str(ei.value)
+        assert "gelu" in str(ei.value)
+
+
 class TestStagePartition:
     def test_splits_are_uniform_and_cover(self):
         from ray_tpu.models.presets import pipeline_splits
@@ -322,3 +644,40 @@ class TestStagePartition:
         assert abs(float(loss) - float(ref)) < 1e-5
         full = count_params(init_params(cfg, jax.random.PRNGKey(0)))
         assert sum(count_params(s) for s in shards) == full
+
+    def test_v2_chunk_composition_matches_fused_model(self):
+        """Pure-jax parity at virtual_stages=2: composing the 4 chunk
+        fns in pipeline order reproduces the fused loss, the shards
+        cover the full tree, and partition_pipeline_params slices the
+        same chunk layout."""
+        import jax
+
+        from ray_tpu.models import presets
+        from ray_tpu.models.transformer import (count_params, init_params,
+                                                loss_fn)
+
+        cfg = _tiny_cfg(num_layers=4)
+        defs = presets.pipeline_stage_defs(cfg, 2, virtual_stages=2,
+                                           seed=0)
+        assert len(defs) == 4  # S * V chunk specs in pipeline order
+        shards = [d["init"]() for d in defs]
+        tokens = _batch(4, 16)
+        x = tokens
+        for d, p in zip(defs[:-1], shards[:-1]):
+            x = d["fwd"](p, x)
+        loss = defs[-1]["loss"](shards[-1], x, tokens)
+        full_params = init_params(cfg, jax.random.PRNGKey(0))
+        ref, _ = loss_fn(cfg, full_params, {"tokens": tokens})
+        assert abs(float(loss) - float(ref)) < 1e-5
+        assert sum(count_params(s) for s in shards) == \
+            count_params(full_params)
+        sliced = presets.partition_pipeline_params(
+            cfg, full_params, 2, virtual_stages=2)
+        assert len(sliced) == 4
+        for init_shard, slice_shard in zip(shards, sliced):
+            a = jax.tree.leaves(init_shard)
+            b = jax.tree.leaves(slice_shard)
+            assert len(a) == len(b)
+            for x1, x2 in zip(a, b):
+                np.testing.assert_array_equal(np.asarray(x1),
+                                              np.asarray(x2))
