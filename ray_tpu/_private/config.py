@@ -165,6 +165,18 @@ class Config:
     # budget for one device-to-device parameter broadcast round over the
     # learner+runners collective group (shm on one node, ring across)
     podracer_bcast_timeout_s: float = 120.0
+    # ---- elastic membership (util/collective/resizable.py, _private/elastic.py) ----
+    # max respawns PER SLOT (dp row / runner index) over a workload's
+    # lifetime before a departure is treated as terminal. Explicit zeros
+    # are REJECTED at build (the PR-8/9/13 falsy-zero lesson): 0 never
+    # silently means "no elasticity" — pass elastic=False for that
+    elastic_respawn_budget: int = 3
+    # base backoff between respawn attempts on the same slot; attempt n
+    # waits backoff * 2**(n-1) seconds (capped at 30s)
+    elastic_backoff_s: float = 1.0
+    # budget for the post-resize first operation: survivor re-rendezvous
+    # at the new generation + joiner param sync over broadcast
+    elastic_resize_timeout_s: float = 120.0
     # ---- OOM defense (≈ memory_monitor.h:52) ----
     # kill the newest leased worker when host memory use crosses this
     # fraction; <= 0 disables the monitor

@@ -64,6 +64,9 @@ class NodeRecord:
     labels: Dict[str, str] = dataclasses.field(default_factory=dict)
     last_seen: float = 0.0
     missed_health_checks: int = 0
+    # why a dead node died ("drained" = deliberate rpc_node_drain
+    # retirement — peers skip the crash debounce and reap immediately)
+    death_reason: str = ""
     store_stats: Dict[str, float] = dataclasses.field(default_factory=dict)
     # queued-but-unserved demand gossiped by the supervisor; the
     # autoscaler bin-packs this into node launches
@@ -925,6 +928,7 @@ class Controller:
                 "available": dict(r.available),
                 "alive": r.alive,
                 "labels": r.labels,
+                "drained": (not r.alive) and r.death_reason == "drained",
             }
             for r in self.nodes.values()
         ]
@@ -969,6 +973,7 @@ class Controller:
         if rec is None or not rec.alive:
             return
         rec.alive = False
+        rec.death_reason = reason
         logger.warning("node %s dead: %s", node_hex[:8], reason)
         self._ghost_nodes.pop(node_hex, None)
         self.events.emit("NODE_DEAD", f"node {node_hex[:8]}: {reason}",
@@ -980,7 +985,12 @@ class Controller:
         # worker_failed notifications itself)
         await self._publish("nodes", {"event": "DEAD",
                                       "node_id_hex": node_hex,
-                                      "address": list(rec.address)})
+                                      "address": list(rec.address),
+                                      # drain vs crash travels with the
+                                      # fan-out: a deliberate retirement
+                                      # is a handoff, not an outage
+                                      "reason": reason,
+                                      "drained": reason == "drained"})
         # tombstone the WAL "node" frame AFTER the fan-out went out: the
         # next incarnation's ghost reconcile must not re-declare a
         # handled death on every restart, but a crash BEFORE the publish

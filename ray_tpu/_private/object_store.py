@@ -230,9 +230,15 @@ class NodeObjectStore:
         self.spill_storage = spill_storage
         self.num_spilled = 0
         self.num_restored = 0
-        # reverse index: client id -> object ids it currently pins (release
-        # path for dead clients; see release_client_pins)
+        # reverse index: pin key -> object ids it currently pins (release
+        # path for dead clients; see release_client_pins). Keys are the
+        # client id suffixed with its incarnation epoch (plain id at
+        # epoch 0): reusable client ids ("node:<hex>") bump their epoch
+        # when a released client comes back, so a DELAYED bulk release
+        # scheduled for the old incarnation can never reclaim pins the
+        # new incarnation just took
         self._client_pins: Dict[str, set] = {}
+        self._client_epoch: Dict[str, int] = {}
 
     # ---- creation ----
 
@@ -267,10 +273,42 @@ class NodeObjectStore:
         meta = self._objects[object_id]
         meta.state = IN_MEMORY
         meta.pins += 1
-        meta.pin_clients[client] = meta.pin_clients.get(client, 0) + 1
-        self._client_pins.setdefault(client, set()).add(object_id)
+        key = self._pin_key(client)
+        meta.pin_clients[key] = meta.pin_clients.get(key, 0) + 1
+        self._client_pins.setdefault(key, set()).add(object_id)
         meta.last_access = time.monotonic()
         return offset
+
+    # ---- incarnation-keyed pin accounting ----
+
+    def _pin_key(self, client: str) -> str:
+        """Effective accounting key for ``client``'s CURRENT incarnation
+        (plain id at epoch 0 — the common never-bumped case)."""
+        e = self._client_epoch.get(client, 0)
+        return client if e == 0 else f"{client}#e{e}"
+
+    @staticmethod
+    def _pin_key_client(key: str) -> str:
+        return key.rsplit("#e", 1)[0] if "#e" in key else key
+
+    @staticmethod
+    def _pin_key_epoch(key: str) -> int:
+        if "#e" in key:
+            tail = key.rsplit("#e", 1)[1]
+            if tail.isdigit():
+                return int(tail)
+        return 0
+
+    def client_epoch(self, client: str) -> int:
+        return self._client_epoch.get(client, 0)
+
+    def bump_client_epoch(self, client: str) -> int:
+        """A previously-released client id is back (node flap, same
+        ``node:<hex>`` id): start a fresh incarnation so its new pins are
+        keyed apart from any still-pending bulk release of the old one."""
+        e = self._client_epoch.get(client, 0) + 1
+        self._client_epoch[client] = e
+        return e
 
     def seal(self, object_id: ObjectID) -> None:
         meta = self._objects.get(object_id)
@@ -309,13 +347,15 @@ class NodeObjectStore:
         meta.last_access = time.monotonic()
         if pin:
             meta.pins += 1
-            meta.pin_clients[client] = meta.pin_clients.get(client, 0) + 1
-            self._client_pins.setdefault(client, set()).add(object_id)
+            key = self._pin_key(client)
+            meta.pin_clients[key] = meta.pin_clients.get(key, 0) + 1
+            self._client_pins.setdefault(key, set()).add(object_id)
         return (meta.offset, meta.size)
 
     def pinned_clients(self) -> List[str]:
-        """Client ids currently holding pins (liveness-sweep input)."""
-        return list(self._client_pins.keys())
+        """Client ids currently holding pins (liveness-sweep input) —
+        raw ids, every incarnation folded together."""
+        return sorted({self._pin_key_client(k) for k in self._client_pins})
 
     def unpin(self, object_id: ObjectID, client: str = "") -> bool:
         """Release one pin held by ``client``. An unpin with no matching
@@ -323,41 +363,67 @@ class NodeObjectStore:
         object) and raises — bulk reclamation for dead/departing clients
         goes through release_client_pins() instead."""
         meta = self._objects.get(object_id)
-        if meta is None or meta.pins <= 0 \
-                or meta.pin_clients.get(client, 0) <= 0:
+        key = None
+        if meta is not None:
+            # current incarnation first; a pin taken under an older
+            # epoch (owner outlived a flap-back bump) still matches
+            cur = self._pin_key(client)
+            if meta.pin_clients.get(cur, 0) > 0:
+                key = cur
+            else:
+                for k in meta.pin_clients:
+                    if (self._pin_key_client(k) == client
+                            and meta.pin_clients[k] > 0):
+                        key = k
+                        break
+        if meta is None or meta.pins <= 0 or key is None:
             raise ValueError(
                 f"unpin without matching pin: object="
                 f"{object_id.hex()[:16]} client={client!r} "
                 f"(double-unpin or unpin of a never-pinned object)")
         meta.pins -= 1
-        remaining = meta.pin_clients[client] - 1
+        remaining = meta.pin_clients[key] - 1
         if remaining > 0:
-            meta.pin_clients[client] = remaining
+            meta.pin_clients[key] = remaining
         else:
-            del meta.pin_clients[client]
-            held = self._client_pins.get(client)
+            del meta.pin_clients[key]
+            held = self._client_pins.get(key)
             if held is not None:
                 held.discard(object_id)
                 if not held:
-                    self._client_pins.pop(client, None)
+                    self._client_pins.pop(key, None)
         if meta.freed and meta.pins == 0:
             self.free(object_id)
         return True
 
-    def release_client_pins(self, client: str) -> int:
+    def release_client_pins(self, client: str,
+                            before_epoch: Optional[int] = None) -> int:
         """Drop every pin held by ``client`` (it died without unpinning).
         Returns the number of pins released; deferred frees fire for
-        objects whose last pin this was."""
+        objects whose last pin this was.
+
+        ``before_epoch`` bounds the release to incarnations BELOW that
+        epoch: the dead-client sweep captures ``client_epoch() + 1`` when
+        the death is observed, so a release that runs late — after the
+        same client id re-registered and was epoch-bumped — reclaims
+        only the dead incarnation's pins, never the pins the new
+        incarnation just took. ``None`` releases every incarnation (the
+        graceful departing-client path)."""
         released = 0
-        for object_id in self._client_pins.pop(client, set()):
-            meta = self._objects.get(object_id)
-            if meta is None:
-                continue
-            count = meta.pin_clients.pop(client, 0)
-            meta.pins = max(0, meta.pins - count)
-            released += count
-            if meta.freed and meta.pins == 0:
-                self.free(object_id)
+        keys = [k for k in self._client_pins
+                if self._pin_key_client(k) == client
+                and (before_epoch is None
+                     or self._pin_key_epoch(k) < before_epoch)]
+        for key in keys:
+            for object_id in self._client_pins.pop(key, set()):
+                meta = self._objects.get(object_id)
+                if meta is None:
+                    continue
+                count = meta.pin_clients.pop(key, 0)
+                meta.pins = max(0, meta.pins - count)
+                released += count
+                if meta.freed and meta.pins == 0:
+                    self.free(object_id)
         return released
 
     def read_chunk(self, object_id: ObjectID, offset: int, length: int) -> bytes:

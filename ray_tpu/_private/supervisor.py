@@ -247,6 +247,11 @@ class Supervisor:
         # (distinct from present-but-dead): drives the recovery-window
         # debounce in _sync_loop
         self._node_missing_since: Dict[str, float] = {}
+        # nodes the controller tagged as DELIBERATELY drained
+        # (rpc_node_drain): a drained node that later vanishes from the
+        # view is reaped immediately — handoff, not crash, so no
+        # recovery-grace debounce (ISSUE 16)
+        self._drained_node_hexes: Set[str] = set()
         # pin-holding clients that are neither our workers nor nodes
         # (drivers attached to this cluster): last known RPC address and
         # consecutive probe failures, for the liveness sweep that
@@ -486,10 +491,23 @@ class Supervisor:
                              if v.alive}
                 dead_now = {v.node_id_hex for v in self.cluster_view
                             if not v.alive}
+                # remember the drain tag while the dead record is still
+                # served: once a controller restart tombstones it out of
+                # the view, "missing + was-drained" must still reap
+                # immediately instead of riding the crash debounce
+                self._drained_node_hexes.update(
+                    v["node_id_hex"] for v in views if v.get("drained"))
                 for back in alive_now - self._alive_node_hexes:
                     # a flapped node re-registered: let its pulls pin
-                    # again (fresh pins; the released ones stay released)
-                    self._released_clients.pop(f"node:{back}", None)
+                    # again (fresh pins; the released ones stay released).
+                    # The bump starts a fresh pin-accounting incarnation
+                    # BEFORE pins are re-admitted, so a still-pending
+                    # release of the old incarnation cannot reclaim them
+                    if f"node:{back}" in self._released_clients:
+                        await self._store_op(
+                            self.store.bump_client_epoch, f"node:{back}")
+                        self._released_clients.pop(f"node:{back}", None)
+                    self._drained_node_hexes.discard(back)
                 for gone in self._node_liveness_reap(
                         alive_now, dead_now, time.monotonic()):
                     await self._release_dead_client_pins(
@@ -516,7 +534,12 @@ class Supervisor:
         for gone in self._alive_node_hexes - alive_now:
             if gone == self.node_id.hex():
                 continue
-            if gone in dead_now:
+            if gone in dead_now or gone in self._drained_node_hexes:
+                # authoritative death — or a DELIBERATE drain
+                # (rpc_node_drain) whose record already left the view:
+                # a drained node handed its channels/pins off on
+                # purpose, so peers reap immediately, never debounced
+                # like an indeterminate crash
                 to_reap.add(gone)
                 continue
             first = self._node_missing_since.setdefault(gone, now)
@@ -526,6 +549,7 @@ class Supervisor:
             self._node_missing_since.pop(back, None)
         for gone in to_reap:
             self._node_missing_since.pop(gone, None)
+            self._drained_node_hexes.discard(gone)
         self._alive_node_hexes = (
             (self._alive_node_hexes | alive_now) - to_reap - dead_now)
         return to_reap
@@ -1022,12 +1046,19 @@ class Supervisor:
 
     async def _release_dead_client_pins(self, client: str, what: str) -> None:
         """A pinning client died: reclaim its pins so spill/free unblock
-        (a leaked pin would otherwise block spilling that object forever)."""
+        (a leaked pin would otherwise block spilling that object forever).
+
+        The release is epoch-bounded to the incarnation that was current
+        when THIS death was observed: closing channels below awaits peer
+        RPCs, and a reusable client id ("node:<hex>") can flap back and
+        re-pin (under a bumped epoch) before the release store-op runs —
+        the bound keeps the late release off the new incarnation's pins."""
+        dead_epoch = self.store.client_epoch(client)
         self._close_client_channels(client, cause="participant_death")
         self._mark_client_released(client)
         try:
             released = await self._store_op(
-                self.store.release_client_pins, client)
+                self.store.release_client_pins, client, dead_epoch + 1)
         except Exception:
             logger.exception("pin release for dead %s %s failed", what, client)
             return

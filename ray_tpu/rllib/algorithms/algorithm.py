@@ -76,7 +76,8 @@ class Algorithm:
             self.learner_group = None
             self.learner_groups = None
             self._podracer = SebulbaTopology(
-                config, self._podracer_program())
+                config, self._podracer_program(),
+                elastic=bool(getattr(config, "elastic", False)))
             return
         if config.is_multi_agent:
             if (config.env_to_module_connector is not None

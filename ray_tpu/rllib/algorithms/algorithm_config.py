@@ -42,6 +42,11 @@ class AlgorithmConfig:
         # in rollout batches); None reads RAY_TPU_PODRACER_CHANNEL_DEPTH.
         # Explicit zeros are rejected, never silently defaulted.
         self.podracer_channel_depth: Optional[int] = None
+        # elastic membership (sebulba only): a killed env-runner is
+        # respawned under the RAY_TPU_ELASTIC_* budget/backoff policy and
+        # rejoins over the next param broadcast; learner loss stays a
+        # clean terminal error (_private/elastic.py)
+        self.elastic: bool = False
         # training
         self.gamma: float = 0.99
         self.lr: float = 5e-4
@@ -102,7 +107,8 @@ class AlgorithmConfig:
 
     def learners(self, *, num_learners: Optional[int] = None,
                  topology: Optional[str] = None,
-                 podracer_channel_depth: Optional[int] = None
+                 podracer_channel_depth: Optional[int] = None,
+                 elastic: Optional[bool] = None
                  ) -> "AlgorithmConfig":
         if topology not in (None, "dynamic", "sebulba"):
             raise ValueError(
@@ -117,7 +123,8 @@ class AlgorithmConfig:
                 f" never silently defaulted)")
         return self._apply(dict(
             num_learners=num_learners, topology=topology,
-            podracer_channel_depth=podracer_channel_depth))
+            podracer_channel_depth=podracer_channel_depth,
+            elastic=elastic))
 
     def training(self, **kwargs) -> "AlgorithmConfig":
         return self._apply(kwargs)

@@ -619,6 +619,7 @@ class SebulbaTopology:
     def __init__(self, config, program: _SebulbaProgram, *,
                  runner_options: Optional[Sequence[dict]] = None,
                  learner_options: Optional[Sequence[dict]] = None,
+                 elastic: bool = False,
                  name: str = "sebulba"):
         import ray_tpu
         from ray_tpu._private import api
@@ -663,6 +664,9 @@ class SebulbaTopology:
         self._iters_per_step = require_positive(
             "iterations_per_step", program.iterations_per_step(R))
         self._it = 0
+        # channel-version iteration counter: tracks self._it except that
+        # an elastic heal resets it with the rebuilt channels
+        self._vit = 0
         self._dead = False
         self._torn = False
         self._teardown_lock = threading.Lock()
@@ -670,8 +674,28 @@ class SebulbaTopology:
         self._local_channels: Dict[bytes, _channels.LocalChannel] = {}
         self._loop_refs: List[Any] = []
         self._actor_info: Dict[str, dict] = {}
+        self._actor_subs: Dict[str, Any] = {}
+        self._slot_of_hex: Dict[str, tuple] = {}
         self._runners: List[Any] = []
         self._learners: List[Any] = []
+        self._name = name
+        self._cfg = config
+        self._runner_options = runner_options
+
+        # ---- elastic membership (ISSUE 16): env-runners respawn and
+        # rejoin over the interval broadcast; learner loss stays terminal
+        # (a learner's optimizer state is not replayable)
+        self._elastic = bool(elastic)
+        self._note_lock = threading.Lock()
+        self._lost_hexes: set = set()
+        self._heal_pending = False
+        self._heal_t0 = 0.0
+        self._epoch = 0
+        self._sup = None
+        if self._elastic:
+            from ray_tpu._private.elastic import ElasticSupervisor
+
+            self._sup = ElasticSupervisor(name=name)
 
         # per-topology token: two concurrently-live topologies must never
         # meet in collective rendezvous (the pipeline trainer's rule)
@@ -688,23 +712,20 @@ class SebulbaTopology:
             return cls.options(**o)
 
         spec = program.spec
+        self._spec = spec
         # everything past this point can strand live actors on failure
         # (ActorHandles have no GC-kill), so ANY mid-build error unwinds
         # through shutdown() — which kills whatever was already created
         try:
-            self._runners = [
-                options_for(runner_cls, runner_options, i).remote(
-                    config.env, spec, config.num_envs_per_env_runner,
-                    # seed + 1000*i: the EnvRunnerGroup actor seeding, so
-                    # runner i samples the same stream as the dynamic
-                    # loop's
-                    config.seed + 1000 * i, config.env_config,
-                    config.env_to_module_connector)
-                for i in range(R)]
+            self._runners = [self._spawn_runner(i) for i in range(R)]
             self._learners = [
                 options_for(learner_cls, learner_options, i).remote(
                     program, i, L, config.seed, grad_group)
                 for i in range(L)]
+            for i, a in enumerate(self._runners):
+                self._slot_of_hex[a._actor_id.hex()] = ("runner", i)
+            for l, a in enumerate(self._learners):
+                self._slot_of_hex[a._actor_id.hex()] = ("learner", l)
             ray_tpu.get([a.ping.remote()
                          for a in self._runners + self._learners],
                         timeout=180)
@@ -742,6 +763,29 @@ class SebulbaTopology:
         return self._L
 
     # -- build
+
+    def _spawn_runner(self, i: int):
+        """Create env-runner i — build and elastic-respawn share the
+        exact spawn (seed + 1000*i keeps the replacement on the SAME
+        sample stream slot as the runner it replaces)."""
+        cls = _runner_actor()
+        opts = self._runner_options
+        o = dict(opts[i]) if opts and i < len(opts) and opts[i] else {}
+        o.setdefault("num_cpus", 1)
+        cfg = self._cfg
+        return cls.options(**o).remote(
+            cfg.env, self._spec, cfg.num_envs_per_env_runner,
+            cfg.seed + 1000 * i, cfg.env_config,
+            cfg.env_to_module_connector)
+
+    def _bcast_name(self) -> str:
+        """The bcast group's wire name for the current elastic epoch: a
+        killed member never destroys its imperative rendezvous state, so
+        each heal moves the whole world to a fresh name instead of
+        re-initializing over the old generation's leftovers."""
+        if self._epoch == 0:
+            return self._bcast_group
+        return f"{self._bcast_group}.e{self._epoch}"
 
     def _create_channel(self, node_addr, participants, *, depth: int,
                         buffer: int) -> _channels.ChannelSpec:
@@ -782,7 +826,7 @@ class SebulbaTopology:
         world = self._L + self._R
 
         def bcast(rank):
-            return {"group": self._bcast_group, "world": world,
+            return {"group": self._bcast_name(), "world": world,
                     "rank": rank, "root": 0, "interval": self._interval,
                     "timeout_ms": self._bcast_timeout_ms}
 
@@ -805,7 +849,9 @@ class SebulbaTopology:
             self._local_channels[sp.key()] for sp in reports]
 
         for hexid in self._actor_info:
-            core.subscribe("actor:" + hexid, self._on_actor_update)
+            cb = self._make_actor_cb(hexid)
+            self._actor_subs[hexid] = cb
+            core.subscribe("actor:" + hexid, cb)
 
         rollout = int(config.rollout_fragment_length)
         for r, actor in enumerate(self._runners):
@@ -819,11 +865,30 @@ class SebulbaTopology:
 
     # -- failure fan-out (the pipeline trainer's shape)
 
-    def _on_actor_update(self, message) -> None:
-        if self._dead or not isinstance(message, dict):
-            return
-        if message.get("state") in ("DEAD", "RESTARTING"):
+    def _make_actor_cb(self, hexid: str):
+        def cb(message) -> None:
+            if self._torn or not isinstance(message, dict):
+                return
+            if message.get("state") in ("DEAD", "RESTARTING"):
+                self._note_death(hexid)
+        return cb
+
+    def _note_death(self, hexid: str) -> None:
+        if not self._elastic:
+            if self._dead:
+                return
             self._close_for_failure()
+            return
+        with self._note_lock:
+            if not self._heal_pending:
+                self._heal_pending = True
+                self._heal_t0 = time.monotonic()
+            self._lost_hexes.add(hexid)
+        if self._slot_of_hex.get(hexid):
+            from ray_tpu._private.elastic import m_departures
+
+            m_departures.inc(labels={"group": self._bcast_group})
+        self._close_for_failure()
 
     def _close_for_failure(self) -> None:
         self._dead = True
@@ -834,6 +899,93 @@ class SebulbaTopology:
         self._close_for_failure()
         _channels.surface_loop_failure(self._core, self._loop_refs, closed)
 
+    # -- elastic heal (the step() boundary, never mid-iteration)
+
+    def _heal(self) -> None:
+        while True:
+            with self._note_lock:
+                if not self._heal_pending:
+                    return
+                self._heal_pending = False
+                lost, self._lost_hexes = self._lost_hexes, set()
+            self._heal_once(lost)
+
+    def _heal_once(self, lost: set) -> None:
+        import ray_tpu
+
+        from ray_tpu._private.elastic import m_reshards
+
+        core = self._core
+        t0 = self._heal_t0
+        slots = sorted(self._slot_of_hex[h] for h in lost
+                       if h in self._slot_of_hex)
+        dead_learners = [i for (kind, i) in slots if kind == "learner"]
+        if dead_learners:
+            raise RuntimeError(
+                f"sebulba {self._name}: learner rank(s) {dead_learners} "
+                f"died — learner optimizer state is not replayable "
+                f"without a checkpoint; treating the outage as terminal")
+        dead_runners = [i for (kind, i) in slots if kind == "runner"]
+        logger.info("sebulba %s: healing after loss of runner(s) %s",
+                    self._name, dead_runners or sorted(lost))
+
+        # 1. drain the old world
+        for ch in self._local_channels.values():
+            try:
+                ch.close()
+            except Exception:
+                pass
+        for ref in self._loop_refs:
+            try:
+                core.get([ref], timeout=self._sup.resize_timeout_s)
+            except Exception:
+                pass
+        for hexid, cb in self._actor_subs.items():
+            try:
+                core.unsubscribe("actor:" + hexid, cb)
+            except Exception:
+                pass
+        self._actor_subs.clear()
+        try:
+            _channels.free_and_unpin_specs(core, self._all_specs)
+        except Exception:
+            logger.debug("elastic spec free failed", exc_info=True)
+        self._all_specs = []
+        self._local_channels = {}
+        self._loop_refs = []
+        self._actor_info = {}
+
+        # 2. respawn dead runners (budget + backoff per slot)
+        for i in dead_runners:
+            old_hex = self._runners[i]._actor_id.hex()
+            self._slot_of_hex.pop(old_hex, None)
+            a = self._sup.respawn(
+                ("runner", i), lambda i=i: self._spawn_runner(i))
+            self._runners[i] = a
+            self._slot_of_hex[a._actor_id.hex()] = ("runner", i)
+        if dead_runners:
+            ray_tpu.get([self._runners[i].ping.remote()
+                         for i in dead_runners], timeout=120)
+
+        # 3. move the whole world to the next broadcast epoch and
+        # restart the loops: iteration 0's param sync (learner rank 0 ->
+        # everyone) IS the replacement's rejoin — current weights over
+        # collective.broadcast, no checkpoint restore
+        self._epoch += 1
+        m_reshards.inc(labels={"group": self._bcast_group})
+        self._vit = 0
+        try:
+            self._build_channels(self._cfg)
+        except BaseException:
+            self._close_for_failure()
+            raise
+        with self._note_lock:
+            if not self._heal_pending:
+                self._dead = False
+        self._sup.rejoin_span(t0)
+        logger.info("sebulba %s: healed (%d respawn(s), epoch %d)",
+                    self._name, len(dead_runners), self._epoch)
+
     # -- stepping
 
     def step(self) -> Dict[str, Any]:
@@ -841,12 +993,14 @@ class SebulbaTopology:
         ``iterations_per_step`` iterations (shared-memory seqlock reads —
         the driver's entire steady-state cost) and merge. Raises cleanly
         if the topology died."""
+        if self._elastic and self._heal_pending and not self._torn:
+            self._heal()
         if self._dead:
             raise ChannelClosedError("sebulba topology was torn down")
         reports: List[dict] = []
         try:
             for _ in range(self._iters_per_step):
-                rv = 2 * (self._it + 1)
+                rv = 2 * (self._vit + 1)
                 for ch in self._report_readers:
                     view = ch.read(rv)
                     rep = serialization.unpack(bytes(view))
@@ -854,6 +1008,7 @@ class SebulbaTopology:
                     ch.ack(0, rv)
                     reports.append(rep)
                 self._it += 1
+                self._vit += 1
         except ChannelClosedError as e:
             self._surface_failure(e)
         env_steps = int(sum(r["env_steps"] for r in reports))
@@ -907,11 +1062,12 @@ class SebulbaTopology:
                 ch.close()
             except Exception:
                 pass
-        for hexid in self._actor_info:
+        for hexid, cb in self._actor_subs.items():
             try:
-                core.unsubscribe("actor:" + hexid, self._on_actor_update)
+                core.unsubscribe("actor:" + hexid, cb)
             except Exception:
                 pass
+        self._actor_subs = {}
 
         _channels.close_specs(core, self._all_specs)
         stats: Dict[str, Any] = {"loops": []}

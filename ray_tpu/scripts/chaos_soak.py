@@ -1779,6 +1779,316 @@ def run_controller_chaos(
         chaos.reset()
 
 
+def _preempt_pipeline(seed: int, cluster) -> None:
+    """A dp stage replica is hard-killed BETWEEN flushes (seeded victim +
+    timing); the elastic trainer respawns it, reshards the declarative dp
+    group at the next generation, and streams params + optimizer state to
+    the joiner over collective.broadcast — no checkpoint restore. Every
+    loss, including the step that healed, must match the single-process
+    reference EXACTLY (between-flush kills are replayable), the
+    steady-state zero-RPC counter must re-prove after the membership
+    change, and pins must return to baseline."""
+    import random
+
+    import jax
+    import numpy as np
+    import optax
+
+    from ray_tpu.models import presets
+    from ray_tpu.models.transformer import init_params, loss_fn
+    from ray_tpu.train import PipelineTrainer
+
+    rng = random.Random(seed)
+    mcfg = presets.llama_debug(
+        num_layers=2, vocab_size=128, max_seq_len=32, embed_dim=32,
+        num_heads=2, num_kv_heads=1, mlp_dim=64)
+    batch = np.random.default_rng(0).integers(
+        0, 128, (16, 16)).astype(np.int32)
+    M, STEPS = 4, 6
+
+    # single-process reference first: both dp rows see the SAME batch,
+    # so the MEAN-reduced dp trajectory equals the single-row one
+    params = init_params(mcfg, jax.random.PRNGKey(0))
+    opt = optax.sgd(0.05)
+    ost = opt.init(params)
+
+    def mb_loss(p, toks):
+        loss, _ = loss_fn(mcfg, p, {"tokens": toks})
+        return loss
+
+    gfn = jax.jit(jax.value_and_grad(mb_loss))
+    ref_losses = []
+    for _ in range(STEPS):
+        acc, losses = None, []
+        for m in range(M):
+            loss, g = gfn(params, batch[m * 4:(m + 1) * 4])
+            losses.append(float(loss))
+            acc = g if acc is None else jax.tree.map(
+                lambda a, b: a + b, acc, g)
+        grads = jax.tree.map(lambda g: g / M, acc)
+        upd, ost = opt.update(grads, ost, params)
+        params = optax.apply_updates(params, upd)
+        ref_losses.append(float(np.mean(losses)))
+
+    import ray_tpu
+    from ray_tpu._private import api as _api
+
+    core = _api._core
+    pins_before = core._run(core.clients.get(core.supervisor_addr).call(
+        "store_stats", timeout=60))["pins_total"]
+    trainer = PipelineTrainer(
+        presets.pipeline_stage_defs(mcfg, 2, seed=0),
+        num_microbatches=M, dp=2, optimizer=("sgd", 0.05), elastic=True,
+        stage_options=[{"resources": {"left": 1}},
+                       {"resources": {"right": 1}}])
+    both = np.concatenate([batch, batch])
+    try:
+        kill_after = rng.choice([1, 2])  # seeded preemption schedule
+        victim_r, victim_s = rng.randrange(2), rng.randrange(2)
+        got = []
+        for step in range(kill_after + 1):
+            got.append(trainer.step(both)["loss"])
+        victim = trainer._actors[victim_r][victim_s]
+        ray_tpu.kill(victim)
+        deadline = time.monotonic() + 60
+        while not trainer._heal_pending and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert trainer._heal_pending, \
+            "death fan-out never marked the elastic trainer for healing"
+        got.append(trainer.step(both)["loss"])   # heals, then steps
+        got.append(trainer.step(both)["loss"])   # warm post-heal flush
+        # zero-steady-state-RPC re-proven AFTER the membership change:
+        # only the mirror-push / collective frames may move on any rank
+        before = _worker_method_deltas(cluster)
+        got.append(trainer.step(both)["loss"])
+        _assert_outage_deltas_clean(before, _worker_method_deltas(cluster))
+        assert np.allclose(got, ref_losses, atol=1e-5), (
+            f"elastic dp losses diverged from the uninterrupted "
+            f"reference: {got} != {ref_losses}")
+    finally:
+        trainer.shutdown()
+
+    from ray_tpu._private.elastic import m_joins, m_reshards
+    assert m_joins.total() >= 1, "no elastic join was recorded"
+    assert m_reshards.total() >= 1, "no dp reshard was recorded"
+    _drain_pins_to_baseline(pins_before)
+
+
+def _preempt_sebulba(seed: int, cluster) -> None:
+    """An env-runner is hard-killed mid-run (seeded victim); the elastic
+    topology respawns it into the same seed slot and the replacement
+    rejoins over the next-epoch parameter broadcast (iteration-0
+    sync_params — no checkpoint restore). Runner kills are NOT exactly
+    replayable (live env state dies with the actor), so the contract is:
+    training continues with finite losses, iteration reports advance,
+    the steady-state zero-RPC counter re-proves after the membership
+    change, and pins return to baseline."""
+    import random
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private import api as _api
+    from ray_tpu.rllib import IMPALAConfig
+    from ray_tpu.rllib.algorithms.impala import IMPALA
+    from ray_tpu.rllib.podracer import (ImpalaSebulbaProgram,
+                                        SebulbaTopology)
+
+    rng = random.Random(seed)
+    config = (IMPALAConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=2,
+                           num_envs_per_env_runner=4,
+                           rollout_fragment_length=16)
+              .training(num_batches_per_iteration=1,
+                        broadcast_interval=1,
+                        model={"hiddens": (16,)})
+              .learners(topology="sebulba")
+              .debugging(seed=0))
+    spec = config.rl_module_spec()
+    program = ImpalaSebulbaProgram(
+        spec=spec, loss_fn=IMPALA.loss_fn,
+        loss_cfg={
+            "gamma": config.gamma,
+            "clip_rho": config.vtrace_clip_rho_threshold,
+            "clip_c": config.vtrace_clip_c_threshold,
+            "vf_loss_coeff": config.vf_loss_coeff,
+            "entropy_coeff": config.entropy_coeff,
+        },
+        opt_cfg={"lr": config.lr, "grad_clip": config.grad_clip},
+        broadcast_interval=1)
+
+    core = _api._core
+    pins_before = core._run(core.clients.get(core.supervisor_addr).call(
+        "store_stats", timeout=60))["pins_total"]
+    topo = SebulbaTopology(
+        config, program, elastic=True,
+        runner_options=[{"resources": {"left": 1}},
+                        {"resources": {"right": 1}}],
+        learner_options=[{"resources": {"right": 1}}])
+    try:
+        for _ in range(2):
+            out = topo.step()
+            assert np.isfinite(out["metrics"]["total_loss"])
+        it_before = out["reports"][0]["iteration"]
+        victim = topo._runners[rng.randrange(2)]
+        ray_tpu.kill(victim)
+        deadline = time.monotonic() + 60
+        while not topo._heal_pending and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert topo._heal_pending, \
+            "death fan-out never marked the elastic topology for healing"
+        out = topo.step()            # heals (runner respawn + epoch bump),
+        assert topo._epoch >= 1      # then streams the iteration
+        assert np.isfinite(out["metrics"]["total_loss"])
+        out = topo.step()            # warm post-heal iteration
+        assert np.isfinite(out["metrics"]["total_loss"])
+        # zero-steady-state-RPC re-proven AFTER the membership change
+        before = _worker_method_deltas(cluster)
+        out = topo.step()
+        _assert_outage_deltas_clean(before, _worker_method_deltas(cluster))
+        assert np.isfinite(out["metrics"]["total_loss"])
+        assert out["reports"][0]["iteration"] > it_before, (
+            "iterations did not advance across the runner preemption")
+    finally:
+        topo.shutdown()
+
+    from ray_tpu._private.elastic import m_joins
+    assert m_joins.total() >= 1, "no elastic join was recorded"
+    _drain_pins_to_baseline(pins_before)
+
+
+def _preempt_serve(seed: int, cluster) -> None:
+    """The serve autoscaler REALLY drains a node: a 2-replica fleet on
+    two dedicated pool nodes idles down to min_replicas=1, and with
+    ``drain_nodes`` set the scale-down issues the controller's
+    node_drain for the vacated node — which dies IMMEDIATELY (its
+    supervisor is still healthy, so only the drain can explain the
+    death; no health-grace debounce is involved) while the surviving
+    replica keeps serving."""
+    import ray_tpu
+    from ray_tpu import serve
+
+    @serve.deployment(
+        name="fleet", num_replicas=2,
+        ray_actor_options={"num_cpus": 0, "resources": {"pool": 1}},
+        autoscaling_config={"min_replicas": 1, "max_replicas": 2,
+                            "target_ongoing_requests": 2,
+                            "drain_nodes": True})
+    class Echo:
+        def __call__(self, x):
+            return {"echo": x}
+
+    h = serve.run(Echo.bind(), name="fleet", route_prefix="/fleet")
+    try:
+        assert h.remote({"n": 1}).result(timeout=120) == {
+            "echo": {"n": 1}}
+
+        def pool_nodes():
+            return [v for v in ray_tpu.nodes()
+                    if v.get("total", {}).get("pool")]
+
+        assert len([v for v in pool_nodes() if v["alive"]]) == 2
+
+        # idle fleet -> autoscaler targets min_replicas=1 -> the popped
+        # replica's node is vacated and must be DRAINED, not debounced
+        deadline = time.monotonic() + 60
+        drained = []
+        while time.monotonic() < deadline and not drained:
+            drained = [v for v in pool_nodes() if v.get("drained")]
+            time.sleep(0.25)
+        assert drained, (
+            "autoscaler scale-down never drained the vacated node "
+            f"(pool nodes: {pool_nodes()})")
+        assert len(drained) == 1, drained
+        alive = [v for v in pool_nodes() if v["alive"]]
+        assert len(alive) == 1, (
+            f"expected exactly one surviving pool node: {pool_nodes()}")
+        # the fleet still serves from the surviving replica
+        deadline = time.monotonic() + 60
+        ok = False
+        while time.monotonic() < deadline and not ok:
+            try:
+                ok = h.remote({"n": 2}).result(timeout=30) == {
+                    "echo": {"n": 2}}
+            except Exception:
+                time.sleep(0.5)
+        assert ok, "fleet stopped serving after the node drain"
+    finally:
+        serve.shutdown()
+
+
+def run_preempt_chaos(
+    seed: int,
+    *,
+    drop_prob: float = 0.02,
+    dup_prob: float = 0.05,
+    delay_prob: float = 0.05,
+    delay_max_ms: int = 20,
+) -> None:
+    """One seeded preemption run (ISSUE 16, elastic world membership).
+
+    Workers are killed and replaced on a seeded schedule mid-run —
+    ``seed % 3`` picks the workload: an elastic dp pipeline (0, losses
+    EXACT vs the uninterrupted reference), elastic Sebulba (1, runner
+    respawn + rejoin over broadcast, not replayable so finite-and-
+    advancing), or the serve fleet whose autoscaler really drains the
+    vacated node (2). The drop/dup/delay schedule keeps attacking every
+    control RPC throughout, INCLUDING the respawn/re-rendezvous/drain
+    machinery. Required end state per scenario: automatic respawn +
+    rejoin via broadcast with no checkpoint restore, the steady-state
+    zero-RPC counter re-proven after the membership change, pins and
+    gauges back to baseline.
+    """
+    import ray_tpu
+    from ray_tpu._private import chaos
+    from ray_tpu._private.chaos import FaultController
+    from ray_tpu._private.config import Config
+    from ray_tpu.cluster_utils import Cluster
+
+    scenario = seed % 3
+    cfg = Config.from_env()
+    cfg.chaos_seed = seed
+    cfg.chaos_drop_prob = drop_prob
+    cfg.chaos_dup_prob = dup_prob
+    cfg.chaos_delay_prob = delay_prob
+    cfg.chaos_delay_max_ms = delay_max_ms
+    cfg.chaos_methods = CHAOS_METHODS
+
+    cluster = Cluster(config=cfg)
+    try:
+        if scenario == 2:
+            # head holds the driver + serve controller; the two
+            # cpu-less pool nodes hold exactly one replica each, so the
+            # scale-down fully vacates (and may drain) one of them
+            cluster.add_node(num_cpus=6)
+            cluster.add_node(num_cpus=0, resources={"pool": 1})
+            cluster.add_node(num_cpus=0, resources={"pool": 1})
+            cluster.wait_for_nodes(3)
+        else:
+            cluster.add_node(num_cpus=4, resources={"left": 100})
+            cluster.add_node(num_cpus=4, resources={"right": 100})
+            cluster.wait_for_nodes(2)
+        ray_tpu.init(address=cluster.address)
+        chaos.set_fault_controller(FaultController(
+            seed=seed, drop_prob=drop_prob, dup_prob=dup_prob,
+            delay_prob=delay_prob, delay_max_ms=delay_max_ms,
+            methods=CHAOS_METHODS))
+        if scenario == 0:
+            _preempt_pipeline(seed, cluster)
+        elif scenario == 1:
+            _preempt_sebulba(seed, cluster)
+        else:
+            _preempt_serve(seed, cluster)
+    finally:
+        chaos.set_fault_controller(None)  # calm teardown
+        _maybe_flight_dump()  # before shutdown, while dumps exist
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        cluster.shutdown()
+        chaos.reset()
+
+
 def _run_one(seed: int, args) -> None:
     global _CURRENT_SEED
     _CURRENT_SEED = seed
@@ -1786,6 +2096,12 @@ def _run_one(seed: int, args) -> None:
         os.environ["RAY_TPU_CHAOS_FLIGHT_DUMP"] = args.flight_dump
     if args.controller:
         run_controller_chaos(
+            seed,
+            drop_prob=args.drop, dup_prob=args.dup, delay_prob=args.delay,
+            delay_max_ms=args.delay_max_ms)
+        return
+    if args.preempt:
+        run_preempt_chaos(
             seed,
             drop_prob=args.drop, dup_prob=args.dup, delay_prob=args.delay,
             delay_max_ms=args.delay_max_ms)
@@ -1840,6 +2156,14 @@ def _run_one(seed: int, args) -> None:
         # the DEFAULT sweep now also restarts the controller mid-run
         # (ISSUE 12): recovery is part of the baseline fault envelope
         controller_restart=not args.no_controller_restart)
+    if not args.no_preempt:
+        # preemption joined the default sweep (ISSUE 16): every default
+        # seed also runs one elastic-membership scenario (seed%3 picks
+        # pipeline-dp / Sebulba / serve-fleet drain)
+        run_preempt_chaos(
+            seed,
+            drop_prob=args.drop, dup_prob=args.dup, delay_prob=args.delay,
+            delay_max_ms=args.delay_max_ms)
 
 
 def main() -> int:
@@ -1898,6 +2222,21 @@ def main() -> int:
                         help="default workload only: skip the mid-run "
                              "controller kill+restart (it is part of "
                              "the default fault envelope since ISSUE 12)")
+    parser.add_argument("--preempt", action="store_true",
+                        help="elastic-membership mode (ISSUE 16): kill "
+                             "and replace workers on a seeded schedule "
+                             "mid-run — seed%%3 picks an elastic dp "
+                             "pipeline (exact losses vs the "
+                             "uninterrupted reference), elastic Sebulba "
+                             "(runner respawn + rejoin over broadcast), "
+                             "or the serve fleet whose autoscaler "
+                             "drains the vacated node; zero-RPC steady "
+                             "state re-proven after every membership "
+                             "change, pins back to baseline")
+    parser.add_argument("--no-preempt", action="store_true",
+                        help="default workload only: skip the elastic "
+                             "preemption scenario that joined the "
+                             "default sweep with ISSUE 16")
     parser.add_argument("--podracer", action="store_true",
                         help="attack the Sebulba RL topology: cross-node "
                              "trajectory-channel pushes + ring parameter "
@@ -1935,8 +2274,12 @@ def main() -> int:
             child.append("--no-train")
         if args.no_controller_restart:
             child.append("--no-controller-restart")
+        if args.no_preempt:
+            child.append("--no-preempt")
         if args.controller:
             child.append("--controller")
+        if args.preempt:
+            child.append("--preempt")
         if args.collective:
             child.append("--collective")
         if args.collective_overlap:

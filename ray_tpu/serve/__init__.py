@@ -38,6 +38,11 @@ class AutoscalingConfig:
     min_replicas: int = 1
     max_replicas: int = 1
     target_ongoing_requests: float = 2.0
+    # retire nodes fully vacated by an autoscaler scale-down via the
+    # controller's node_drain RPC (immediate channel/pin/lease handoff,
+    # no crash debounce). Opt-in: a drain takes the whole node, so this
+    # is only safe when the autoscaled replica pool owns its nodes.
+    drain_nodes: bool = False
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)

@@ -225,10 +225,22 @@ class ServeController:
             await self._scale_to_locked(st, n, ReplicaActor)
 
     async def _scale_to_locked(self, st, n, ReplicaActor):
+        # Node handoff on deliberate scale-down (opt-in via
+        # autoscaling_config["drain_nodes"]). Deletion/teardown (n == 0 on
+        # a deleted deployment) never drains: the app is going away, the
+        # cluster is not.
+        drain = (bool((st.spec.get("autoscaling_config") or {})
+                      .get("drain_nodes"))
+                 and not st.deleted and n >= 1)
+        vacated = set()
         while len(st.replicas) > n:
             holder = st.replicas.pop()
             st.version += 1
             self._notify_change()
+            if drain:
+                # resolve BEFORE the kill — a dead actor's record may be
+                # gone from the controller table by the time we ask
+                vacated.add(self._replica_node_hex(holder.handle))
             try:
                 await asyncio.wait_for(
                     holder.handle.prepare_for_shutdown.remote(), timeout=15)
@@ -238,6 +250,8 @@ class ServeController:
                 ray_tpu.kill(holder.handle)
             except Exception:
                 pass
+        if vacated:
+            self._drain_vacated_nodes(vacated)
         spec = st.spec
         while len(st.replicas) < n:
             actor_opts = dict(spec.get("ray_actor_options") or {})
@@ -252,6 +266,62 @@ class ServeController:
             st.replicas.append(_ReplicaHolder(handle))
             st.version += 1
             self._notify_change()
+
+    # ----------------------------------------------------- node drain
+
+    @staticmethod
+    def _replica_node_hex(handle) -> str:
+        """Which node hosts this replica, per the cluster controller's
+        actor table ("" if unknown)."""
+        from ray_tpu._private import api
+
+        core = api._core
+        if core is None:
+            return ""
+        for _ in range(3):  # actor_get is read-only; retries are free
+            try:
+                rec = core._run(core.clients.get(core.controller_addr).call(
+                    "actor_get",
+                    {"actor_id_hex": handle._actor_id.hex()}))
+                return (rec or {}).get("node_id_hex") or ""
+            except Exception:
+                continue
+        return ""
+
+    def _drain_vacated_nodes(self, candidates) -> None:
+        """Retire nodes vacated by a deliberate scale-down NOW, via the
+        cluster controller's node_drain RPC, so their channels, pins and
+        leases hand off immediately instead of waiting out the crash
+        debounce (the drain reason skips recovery_grace_s on peers).
+        Opt-in per deployment (autoscaling_config["drain_nodes"]) because
+        a drain takes the whole node — only safe when the autoscaled
+        replica pool has its nodes to itself. A node still hosting any
+        replica of any app, and the controller's own node, are never
+        drained."""
+        from ray_tpu._private import api
+
+        core = api._core
+        if core is None:
+            return
+        still_used = set()
+        for app in self._apps.values():
+            for st in app.values():
+                for holder in st.replicas:
+                    still_used.add(self._replica_node_hex(holder.handle))
+        for hexid in sorted(candidates):
+            if not hexid or hexid == core.node_id_hex or hexid in still_used:
+                continue
+            logger.info("draining vacated node %s after scale-down",
+                        hexid[:12])
+            for attempt in range(3):  # node_drain is idempotent
+                try:
+                    core._run(core.clients.get(core.controller_addr).call(
+                        "node_drain", {"node_id_hex": hexid}))
+                    break
+                except Exception:
+                    if attempt == 2:
+                        logger.exception("node_drain failed for %s",
+                                         hexid[:12])
 
     async def _autoscale(self):
         for app in self._apps.values():

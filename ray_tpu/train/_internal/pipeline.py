@@ -268,7 +268,8 @@ class _StageRuntime:
                  num_stages: int, virtual_stages: int,
                  num_microbatches: int, optimizer, dp: int, dp_rank: int,
                  group_name: str, fused_flush: bool = True,
-                 flush_bucket_bytes: Optional[int] = None):
+                 flush_bucket_bytes: Optional[int] = None,
+                 declarative_group: bool = False):
         self.stage = int(stage)
         self.S = int(num_stages)
         self.V = int(virtual_stages)
@@ -276,6 +277,11 @@ class _StageRuntime:
         self.dp = int(dp)
         self.dp_rank = int(dp_rank)
         self.group_name = group_name
+        # elastic trainers declare the dp group driver-side
+        # (util.collective.create_collective_group): members resolve
+        # their rank lazily on the first op and re-rendezvous at the new
+        # generation after a resize — no imperative init here
+        self._declarative = bool(declarative_group)
         self._group_ready = False
         C = self.S * self.V
         self.chunks = [
@@ -306,6 +312,10 @@ class _StageRuntime:
     # -- flush
 
     def _ensure_group(self) -> None:
+        if self._declarative:
+            # driver-declared group: ops resolve membership from the
+            # declarative KV record (current generation) on demand
+            return
         if self.dp > 1 and not self._group_ready:
             from ray_tpu.util import collective as col
 
@@ -515,6 +525,64 @@ class _StageRuntime:
                 "microbatches": self.M,
                 "fused_bucket_applies":
                     self._fused_applies - applies_before}
+
+    # -- elastic membership (driver-orchestrated, between flushes)
+
+    def reset_group(self, dp: int, dp_rank: int) -> None:
+        """Adopt a resized dp group: the driver re-declared it at a new
+        generation; drop this member's stale cached rendezvous so the
+        next collective call (the rejoin sync or the next flush) joins
+        the new world. The MEAN scale of the flush allreduce re-derives
+        from the live world size by construction."""
+        from ray_tpu.util.collective.resizable import refresh_membership
+
+        self.dp = int(dp)
+        self.dp_rank = int(dp_rank)
+        refresh_membership(self.group_name)
+
+    def sync_state(self, src_rank: int, timeout_ms: int) -> str:
+        """One leaf-wise param/optimizer broadcast over the (resized) dp
+        group: ``src_rank`` sends its live tree, everyone else installs
+        the received copy — the joiner's no-checkpoint rejoin path, and
+        a re-anchor for survivors whose mid-flush state may have
+        diverged (partial fused-bucket applies on a torn round)."""
+        from ray_tpu.util.collective.resizable import sync_tree
+
+        state = None
+        if self.dp_rank == src_rank:
+            state = {
+                "params": [ck.params for ck in self.chunks],
+                "opt": self._opt_state,
+                "fused": (
+                    {k: e["state"] for k, e in self._fused_buckets.items()}
+                    if self._fused_buckets is not None else None),
+            }
+        synced = sync_tree(state, self.group_name, src_rank=src_rank,
+                           timeout_ms=timeout_ms)
+        if self.dp_rank != src_rank:
+            self._install_state(synced)
+        return "ok"
+
+    def _install_state(self, state: Dict[str, Any]) -> None:
+        import jax
+
+        for ck, p in zip(self.chunks, state["params"]):
+            ck.params = p
+        if state["opt"] is not None:
+            self._ensure_opt()
+            self._opt_state = state["opt"]
+        if state["fused"] is not None:
+            # the bucket layout is a pure function of the (identical)
+            # param tree + bucket bytes, so the sender's keys match ours
+            self._ensure_fused_opt(jax.tree.leaves(
+                tuple(ck.params for ck in self.chunks)))
+            for key, st in state["fused"].items():
+                if tuple(key) not in self._fused_buckets:
+                    raise RuntimeError(
+                        f"stage {self.stage}: synced fused-opt bucket "
+                        f"{key!r} has no local counterpart (bucket-layout "
+                        f"drift between dp ranks?)")
+                self._fused_buckets[tuple(key)]["state"] = st
 
 
 # ----------------------------------------------------- worker-side run loop
@@ -872,11 +940,13 @@ def _run_stage_loop(rt: _StageRuntime, plan: _StagePlan) -> dict:
 
 def _make_runtime(spec_blobs, stage, num_stages, virtual_stages,
                   num_microbatches, optimizer, dp, dp_rank, group_name,
-                  fused_flush, flush_bucket_bytes) -> _StageRuntime:
+                  fused_flush, flush_bucket_bytes,
+                  declarative_group=False) -> _StageRuntime:
     return _StageRuntime(
         [_as_stage_spec(b) for b in spec_blobs], stage, num_stages,
         virtual_stages, num_microbatches, optimizer, dp, dp_rank,
-        group_name, fused_flush, flush_bucket_bytes)
+        group_name, fused_flush, flush_bucket_bytes,
+        declarative_group=declarative_group)
 
 
 class _PipelineStageActorImpl:
@@ -885,17 +955,27 @@ class _PipelineStageActorImpl:
 
     def __init__(self, spec_blobs, stage, num_stages, virtual_stages,
                  num_microbatches, optimizer, dp, dp_rank, group_name,
-                 fused_flush, flush_bucket_bytes):
+                 fused_flush, flush_bucket_bytes, declarative_group=False):
         self._rt = _make_runtime(spec_blobs, stage, num_stages,
                                  virtual_stages, num_microbatches,
                                  optimizer, dp, dp_rank, group_name,
-                                 fused_flush, flush_bucket_bytes)
+                                 fused_flush, flush_bucket_bytes,
+                                 declarative_group)
 
     def ping(self):
         return "ok"
 
     def run_loop(self, plan: _StagePlan) -> dict:
         return _run_stage_loop(self._rt, plan)
+
+    # -- elastic rejoin (driver-orchestrated between run loops)
+
+    def elastic_reset_group(self, dp: int, dp_rank: int) -> str:
+        self._rt.reset_group(dp, dp_rank)
+        return "ok"
+
+    def elastic_sync_state(self, src_rank: int, timeout_ms: int) -> str:
+        return self._rt.sync_state(src_rank, timeout_ms)
 
     # -- dynamic task-per-stage path (microbenchmark baseline; same math)
 
@@ -978,11 +1058,17 @@ class PipelineTrainer:
                  channel_depth: Optional[int] = None,
                  buffer_bytes: Optional[int] = None,
                  stage_options: Optional[Sequence[dict]] = None,
+                 elastic: bool = False,
                  name: str = "pipeline"):
         from ray_tpu._private import api
 
         if mode not in ("channels", "tasks"):
             raise ValueError(f"unknown mode {mode!r}")
+        if elastic and (mode != "channels" or int(dp) < 2):
+            raise ValueError(
+                "elastic=True needs mode='channels' and dp >= 2: a lost "
+                "replica's parameters are recovered from a surviving dp "
+                "peer over collective.broadcast, so there must be one")
         self._specs = [_as_stage_spec(s) for s in stages]
         core = api._require_core()
         self._core = core
@@ -1034,6 +1120,11 @@ class PipelineTrainer:
         if self._depth < 1:
             raise ValueError("channel_depth must be >= 1")
         self._flush = 0
+        # channel-version flush counter: tracks self._flush except that
+        # an elastic heal RESETS it (fresh channels + restarted loops
+        # start at version 0 again while the user-visible step count
+        # keeps climbing)
+        self._vflush = 0
         self._dead = False
         self._torn = False
         self._teardown_lock = threading.Lock()
@@ -1041,6 +1132,23 @@ class PipelineTrainer:
         self._local_channels: Dict[bytes, _channels.LocalChannel] = {}
         self._loop_refs: List[Any] = []
         self._actor_info: Dict[str, dict] = {}
+        self._actor_subs: Dict[str, Any] = {}
+        self._slot_of_hex: Dict[str, Tuple[int, int]] = {}
+
+        # ---- elastic membership (ISSUE 16)
+        self._elastic = bool(elastic)
+        self._optimizer = optimizer
+        self._flush_bucket_bytes = flush_bucket_bytes
+        self._note_lock = threading.Lock()
+        self._lost_hexes: set = set()
+        self._heal_pending = False
+        self._heal_t0 = 0.0
+        self._groups: List[Any] = []
+        self._sup = None
+        if self._elastic:
+            from ray_tpu._private.elastic import ElasticSupervisor
+
+            self._sup = ElasticSupervisor(name=name)
 
         # ---- stage actors (dp x S)
         import uuid
@@ -1049,25 +1157,34 @@ class PipelineTrainer:
         # concurrently-live trainers with the default name must not meet
         # in rendezvous (they would cross-average unrelated models)
         token = uuid.uuid4().hex[:8]
-        cls = _stage_actor()
-        opts = list(stage_options or [])
+        self._token = token
+        self._stage_opts = list(stage_options or [])
         self._actors: List[List[Any]] = []
         for r in range(self._dp):
             row = []
             for s in range(self._S):
-                acls = cls.options(**opts[s]) if s < len(opts) and opts[s] \
-                    else cls
-                chunk_specs = [self._specs[s + u * self._S]
-                               for u in range(self._V)]
-                row.append(acls.remote(
-                    chunk_specs, s, self._S, self._V, self._M, optimizer,
-                    self._dp, r, f"{name}.{token}.stage{s}",
-                    self._fused, flush_bucket_bytes))
+                row.append(self._spawn_stage_actor(r, s))
             self._actors.append(row)
+        for r in range(self._dp):
+            for s in range(self._S):
+                self._slot_of_hex[
+                    self._actors[r][s]._actor_id.hex()] = (r, s)
         import ray_tpu
 
         ray_tpu.get([a.ping.remote() for row in self._actors for a in row],
                     timeout=120)
+
+        if self._elastic:
+            # driver-declared (resizable) dp group per stage: members
+            # rendezvous lazily at the current generation; a heal
+            # re-declares at the next one
+            from ray_tpu.util.collective.resizable import ResizableGroup
+
+            self._groups = [
+                ResizableGroup(
+                    [self._actors[r][s] for r in range(self._dp)],
+                    group_name=f"{name}.{token}.stage{s}", backend="host")
+                for s in range(self._S)]
 
         if mode == "channels":
             try:
@@ -1099,6 +1216,20 @@ class PipelineTrainer:
         return self._V
 
     # -- build
+
+    def _spawn_stage_actor(self, r: int, s: int):
+        """Create the (r, s) stage actor — the build path and the
+        elastic respawn path run the exact same spawn."""
+        cls = _stage_actor()
+        opts = self._stage_opts
+        acls = cls.options(**opts[s]) if s < len(opts) and opts[s] \
+            else cls
+        chunk_specs = [self._specs[s + u * self._S]
+                       for u in range(self._V)]
+        return acls.remote(
+            chunk_specs, s, self._S, self._V, self._M, self._optimizer,
+            self._dp, r, f"{self._name}.{self._token}.stage{s}",
+            self._fused, self._flush_bucket_bytes, self._elastic)
 
     def _create_channel(self, node_addr, n_readers, participants, *,
                         depth: Optional[int] = None,
@@ -1198,9 +1329,14 @@ class PipelineTrainer:
         self._in_writers = [driver_writer(sp) for sp in self._in_specs]
         self._label_writers = [driver_writer(sp) for sp in self._label_specs]
 
-        # participant death -> close everything so nobody hangs
+        # participant death -> close everything so nobody hangs; the
+        # per-actor closure keeps WHICH actor died (the fan-out message
+        # carries only the state — the topic is the identity), which the
+        # elastic heal needs to pick the respawn slots
         for hexid in self._actor_info:
-            core.subscribe("actor:" + hexid, self._on_actor_update)
+            cb = self._make_actor_cb(hexid)
+            self._actor_subs[hexid] = cb
+            core.subscribe("actor:" + hexid, cb)
 
         # start the run loops (they dedicate the actors until teardown)
         for r in range(self._dp):
@@ -1210,11 +1346,36 @@ class PipelineTrainer:
 
     # -- failure fan-out (same shape as dag._ChannelGraph)
 
-    def _on_actor_update(self, message) -> None:
-        if self._dead or not isinstance(message, dict):
-            return
-        if message.get("state") in ("DEAD", "RESTARTING"):
+    def _make_actor_cb(self, hexid: str):
+        def cb(message) -> None:
+            if self._torn or not isinstance(message, dict):
+                return
+            if message.get("state") in ("DEAD", "RESTARTING"):
+                self._note_death(hexid)
+        return cb
+
+    def _note_death(self, hexid: str) -> None:
+        if not self._elastic:
+            if self._dead:
+                return
             self._close_for_failure()
+            return
+        # elastic: remember the slot, mark a heal pending (the next
+        # step() boundary runs it), and close the channels so every loop
+        # unwinds to that boundary — the PR-4 poison invariant: nobody
+        # resumes a torn round, survivors rejoin the next generation
+        with self._note_lock:
+            if not self._heal_pending:
+                self._heal_pending = True
+                self._heal_t0 = time.monotonic()
+            self._lost_hexes.add(hexid)
+        slot = self._slot_of_hex.get(hexid)
+        if slot is not None and self._groups:
+            try:
+                self._groups[slot[1]].note_departure(hexid)
+            except Exception:
+                logger.debug("note_departure failed", exc_info=True)
+        self._close_for_failure()
 
     def _close_for_failure(self) -> None:
         """Close the whole pipeline (same lightweight fan-out as actor
@@ -1232,6 +1393,110 @@ class PipelineTrainer:
         # that will never be written (CompiledDAG.execute's rule)
         self._close_for_failure()
         _channels.surface_loop_failure(self._core, self._loop_refs, closed)
+
+    # -- elastic heal (runs at the step() boundary, never mid-flush)
+
+    def _heal(self) -> None:
+        """Re-form the world after noted departures: respawn the dead
+        slots (budget/backoff via ElasticSupervisor), resize the
+        affected stage dp groups to a fresh generation, broadcast
+        params/opt state from a surviving replica to each replacement,
+        rebuild the channel plan and restart the loops."""
+        while True:
+            with self._note_lock:
+                if not self._heal_pending:
+                    return
+                self._heal_pending = False
+                lost, self._lost_hexes = self._lost_hexes, set()
+            self._heal_once(lost)
+
+    def _heal_once(self, lost: set) -> None:
+        import ray_tpu
+
+        core = self._core
+        t0 = self._heal_t0
+        dead_slots = sorted(self._slot_of_hex[h] for h in lost
+                            if h in self._slot_of_hex)
+        logger.info("pipeline %s: healing after loss of %s",
+                    self._name, dead_slots or sorted(lost))
+
+        # 1. drain the old world: loops exited on the channel close;
+        # collect them, drop the old subscriptions, free the old specs
+        for ch in self._local_channels.values():
+            try:
+                ch.close()
+            except Exception:
+                pass
+        for ref in self._loop_refs:
+            try:
+                core.get([ref], timeout=self._sup.resize_timeout_s)
+            except Exception:
+                pass
+        for hexid, cb in self._actor_subs.items():
+            try:
+                core.unsubscribe("actor:" + hexid, cb)
+            except Exception:
+                pass
+        self._actor_subs.clear()
+        try:
+            _channels.free_and_unpin_specs(core, self._all_specs)
+        except Exception:
+            logger.debug("elastic spec free failed", exc_info=True)
+        self._all_specs = []
+        self._local_channels = {}
+        self._loop_refs = []
+        self._actor_info = {}
+
+        # 2. respawn the dead slots (budget + backoff per slot)
+        for (r, s) in dead_slots:
+            old_hex = self._actors[r][s]._actor_id.hex()
+            self._slot_of_hex.pop(old_hex, None)
+            a = self._sup.respawn(
+                ("dp", r, "stage", s),
+                lambda r=r, s=s: self._spawn_stage_actor(r, s))
+            self._actors[r][s] = a
+            self._slot_of_hex[a._actor_id.hex()] = (r, s)
+        if dead_slots:
+            ray_tpu.get([self._actors[r][s].ping.remote()
+                         for (r, s) in dead_slots], timeout=120)
+
+        # 3. reshard: re-declare each affected stage's dp group at the
+        # next generation, then deliver params/opt state to the joiner
+        # from the lowest-rank survivor (leaf-wise broadcast — no
+        # checkpoint restore anywhere on this path)
+        t_ms = self._sup.resize_timeout_ms
+        for s in sorted({s for (_, s) in dead_slots}):
+            dead_rs = {r for (r, ss) in dead_slots if ss == s}
+            live = [r for r in range(self._dp) if r not in dead_rs]
+            if not live:
+                raise RuntimeError(
+                    f"pipeline {self._name}: every dp replica of stage "
+                    f"{s} died — parameters are unrecoverable without a "
+                    f"checkpoint; treating the outage as terminal")
+            row = [self._actors[r][s] for r in range(self._dp)]
+            self._groups[s].resize(row)
+            ray_tpu.get([row[r].elastic_reset_group.remote(self._dp, r)
+                         for r in range(self._dp)], timeout=120)
+            refs = [row[r].elastic_sync_state.remote(live[0], t_ms)
+                    for r in range(self._dp)]
+            ray_tpu.get(refs, timeout=t_ms / 1000.0 + 30)
+
+        # 4. restart the world: fresh channels + loops (versions restart
+        # at 0 — _vflush resets with them; the user-visible step count
+        # does not)
+        self._vflush = 0
+        try:
+            self._build_channels()
+        except BaseException:
+            self._close_for_failure()
+            raise
+        with self._note_lock:
+            if not self._heal_pending:
+                self._dead = False
+        self._sup.rejoin_span(t0)
+        logger.info("pipeline %s: healed (%d respawn(s), epoch(s) %s)",
+                    self._name, len(dead_slots),
+                    [g.epoch for g in self._groups])
 
     # -- stepping
 
@@ -1265,10 +1530,12 @@ class PipelineTrainer:
         loss. Steady-state cost: channel writes/reads only."""
         if self._mode == "tasks":
             return self._step_tasks(batch)
+        if self._elastic and self._heal_pending and not self._torn:
+            self._heal()
         if self._dead:
             raise ChannelClosedError("pipeline trainer was torn down")
         mbs = self._split(batch)
-        vbase = 2 * (self._flush * self._M + 1)
+        vbase = 2 * (self._vflush * self._M + 1)
         wrote = False
         try:
             for r in range(self._dp):
@@ -1293,7 +1560,7 @@ class PipelineTrainer:
                 # CompiledDAG.execute)
                 self._close_for_failure()
             raise
-        rv = 2 * (self._flush + 1)
+        rv = 2 * (self._vflush + 1)
         reports: List[dict] = []
         try:
             for r in range(self._dp):
@@ -1307,6 +1574,7 @@ class PipelineTrainer:
         except ChannelClosedError as e:
             self._surface_failure(e)
         self._flush += 1
+        self._vflush += 1
         last = [rep for rep in reports if rep["stage"] == self._S - 1]
         loss = float(np.mean([rep["loss_sum"] / rep["microbatches"]
                               for rep in last]))
@@ -1379,11 +1647,12 @@ class PipelineTrainer:
                 ch.close()
             except Exception:
                 pass
-        for hexid in self._actor_info:
+        for hexid, cb in self._actor_subs.items():
             try:
-                core.unsubscribe("actor:" + hexid, self._on_actor_update)
+                core.unsubscribe("actor:" + hexid, cb)
             except Exception:
                 pass
+        self._actor_subs = {}
 
         _channels.close_specs(core, self._all_specs)
         stats: Dict[str, Any] = {"loops": []}

@@ -18,6 +18,11 @@ from ray_tpu.util.collective.collective import (  # noqa: F401
     send,
     synchronize,
 )
+from ray_tpu.util.collective.resizable import (  # noqa: F401
+    ResizableGroup,
+    refresh_membership,
+    sync_tree,
+)
 from ray_tpu.util.collective.types import (  # noqa: F401
     Backend,
     CollectiveError,

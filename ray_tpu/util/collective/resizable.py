@@ -1,0 +1,232 @@
+"""Elastic world membership for declarative collective groups.
+
+A ``ResizableGroup`` is the driver-side wrapper that turns worker loss
+from a terminal event into a *resharding* event (the Podracer
+preemption model, arXiv:2104.06272): between operations — never
+mid-round, the PR-4 poison invariant stays untouched — the group is
+atomically re-declared at the live membership via a fresh rendezvous
+generation. The pieces were already in the substrate:
+
+  * ``create_collective_group`` advances a never-deleted generation
+    counter (``declgen:{name}``) and folds it into every wire key
+    (``{name}@{gen}``) — the monotonic epoch. Straggler frames from the
+    old world carry the old generation's keys and can never fold into
+    the new world's rounds.
+  * A survivor whose member object was poisoned by the departure (or is
+    merely stale) recovers through ``BaseGroup._raise_if_stale`` /
+    :func:`refresh_membership`: the cached member is destroyed and the
+    next collective call lazily re-rendezvouses at the new generation.
+    Poison is a *generation-local* verdict, not a process death
+    sentence.
+  * Joiners receive the current param/optimizer tree leaf-wise over
+    ``collective.broadcast`` from a live rank (:func:`sync_tree`) — no
+    checkpoint restore anywhere on the rejoin path.
+
+Driver-side state machine: ``ready`` --(death fan-out)-->
+``resize_pending`` --(:meth:`ResizableGroup.resize` at the next flush /
+step boundary)--> ``ready`` at the new world size. The workloads
+(``train.PipelineTrainer``, ``rllib.SebulbaTopology``) own *when* the
+boundary is; this module owns the membership bookkeeping and the
+rendezvous mechanics.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu._private import flight
+from ray_tpu.util.collective.collective import (
+    _KV_NS,
+    _kv,
+    _manager,
+    broadcast,
+    create_collective_group,
+    get_rank,
+)
+
+_F_RESIZE = flight.intern("elastic.resize")
+
+
+def _actor_hex(actor_or_hex: Any) -> str:
+    if isinstance(actor_or_hex, str):
+        return actor_or_hex
+    return actor_or_hex._actor_id.hex()
+
+
+class ResizableGroup:
+    """Declarative collective group whose world size can change between
+    operations.
+
+    The driver constructs it with the initial rank-ordered actor roster;
+    the death fan-out calls :meth:`note_departure` which marks the group
+    ``resize_pending`` (members poisoned by the departure stay poisoned
+    only within the old generation); at the next operation boundary the
+    workload calls :meth:`resize` with the healed roster and every
+    survivor re-rendezvouses at the new generation on its next
+    collective call — rank assignment is positional in the new roster,
+    so gradient MEAN re-scales to the live world by construction.
+    """
+
+    def __init__(self, actors: Sequence[Any], *, group_name: str,
+                 backend: str = "host"):
+        if not actors:
+            raise ValueError("ResizableGroup needs at least one actor")
+        self.name = group_name
+        self._backend = backend
+        self._lock = threading.Lock()
+        self._actors: List[Any] = list(actors)
+        self._departed: set = set()
+        self._resize_pending = False
+        self._epoch = -1
+        self._declare_locked()
+
+    # -- introspection
+
+    @property
+    def world_size(self) -> int:
+        return len(self._actors)
+
+    @property
+    def epoch(self) -> int:
+        """The declarative generation the current world rendezvouses
+        under — folded into every wire key, monotonic across resizes."""
+        return self._epoch
+
+    @property
+    def resize_pending(self) -> bool:
+        return self._resize_pending
+
+    def actors(self) -> List[Any]:
+        return list(self._actors)
+
+    def survivors(self) -> List[Any]:
+        return [a for a in self._actors
+                if a._actor_id.hex() not in self._departed]
+
+    # -- membership transitions
+
+    def note_departure(self, actor_or_hex: Any) -> bool:
+        """A member died (node/actor death fan-out): mark the group
+        ``resize_pending``. The wire state of the old generation may be
+        poisoned mid-round — that is fine and REQUIRED (the poison
+        invariant): survivors recover by joining the next generation,
+        never by resuming the torn round. Returns True if the id was a
+        live member."""
+        hexid = _actor_hex(actor_or_hex)
+        with self._lock:
+            known = any(a._actor_id.hex() == hexid for a in self._actors)
+            if known and hexid not in self._departed:
+                self._departed.add(hexid)
+                self._resize_pending = True
+                from ray_tpu._private.elastic import m_departures
+
+                m_departures.inc(labels={"group": self.name})
+                return True
+        return False
+
+    def resize(self, actors: Optional[Sequence[Any]] = None) -> int:
+        """Atomically re-form the group at the new membership.
+
+        Call this only between operations (flush/step boundary — no
+        member may be inside a round). ``actors`` is the new rank-ordered
+        roster; None means "the survivors of the noted departures"
+        (shrink). Re-declares the group at a fresh generation and
+        returns the new epoch; survivors (and joiners) rendezvous lazily
+        on their next collective call after dropping stale members via
+        :func:`refresh_membership` / ``_raise_if_stale``.
+        """
+        t0 = flight.now()
+        with self._lock:
+            roster = (list(actors) if actors is not None
+                      else [a for a in self._actors
+                            if a._actor_id.hex() not in self._departed])
+            if not roster:
+                raise RuntimeError(
+                    f"resizable group {self.name!r} has no survivors to "
+                    f"resize to")
+            self._actors = roster
+            self._departed.clear()
+            self._resize_pending = False
+            self._declare_locked()
+        from ray_tpu._private.elastic import m_reshards
+
+        m_reshards.inc(labels={"group": self.name})
+        flight.span_since(_F_RESIZE, t0)
+        return self._epoch
+
+    def _declare_locked(self) -> None:
+        n = len(self._actors)
+        create_collective_group(
+            self._actors, n, list(range(n)), backend=self._backend,
+            group_name=self.name)
+        meta = _kv().kv_get(f"decl:{self.name}", ns=_KV_NS)
+        self._epoch = int(meta["gen"])
+
+
+# ---------------------------------------------------------- member helpers
+
+
+def refresh_membership(group_name: str) -> bool:
+    """Member-side half of a resize: proactively drop this process's
+    cached group member if the driver re-declared the group at a newer
+    generation, so the NEXT collective call re-rendezvouses in the new
+    world instead of timing out against the old one. This is the
+    success-path twin of ``BaseGroup._raise_if_stale`` (which runs only
+    after a failure). Returns True if a stale member was dropped.
+
+    A member poisoned by a mid-round departure is covered too:
+    destroying it clears the poison along with the stale wire state —
+    the poison verdict is generation-local.
+    """
+    group = _manager.get(group_name)
+    if group is None:
+        return False
+    gen = getattr(group, "_decl_gen", None)
+    if gen is None:
+        # imperative member: generations don't apply — the caller must
+        # destroy/re-init explicitly (the Sebulba bcast-group path)
+        return False
+    meta = _kv().kv_get(f"decl:{group_name}", ns=_KV_NS)
+    if meta is not None and meta["gen"] == gen:
+        return False
+    _manager.destroy(group_name)
+    return True
+
+
+def sync_tree(tree: Optional[Any], group_name: str, *, src_rank: int = 0,
+              timeout_ms: int = 120_000):
+    """Leaf-wise pytree delivery over ``collective.broadcast`` on an
+    EXISTING group — the joiner rejoin path (ISSUE 16): the source rank
+    passes its live param/optimizer tree, every other rank passes
+    ``None`` (or anything — ignored) and receives the identical tree.
+    No checkpoint restore: the tree structure travels as a pickled uint8
+    header broadcast, then one broadcast per leaf (transport frames
+    carry dtype/shape, the ``serve.weights.broadcast_params`` idiom —
+    but over the resizable/declarative group, so rejoin reuses the same
+    rendezvous generation the next training round will)."""
+    import jax
+
+    rank = get_rank(group_name)
+    if rank == src_rank:
+        if tree is None:
+            raise ValueError("sync_tree source rank must pass the tree")
+        host = jax.tree.map(np.asarray, tree)
+        leaves, treedef = jax.tree.flatten(host)
+        spec = pickle.dumps(treedef)
+        broadcast(np.frombuffer(spec, np.uint8), src_rank=src_rank,
+                  group_name=group_name, timeout_ms=timeout_ms)
+        for leaf in leaves:
+            broadcast(np.ascontiguousarray(leaf), src_rank=src_rank,
+                      group_name=group_name, timeout_ms=timeout_ms)
+        return host
+    spec = broadcast(np.empty(0, np.uint8), src_rank=src_rank,
+                     group_name=group_name, timeout_ms=timeout_ms)
+    treedef = pickle.loads(bytes(spec))
+    leaves = [broadcast(np.empty(0, np.uint8), src_rank=src_rank,
+                        group_name=group_name, timeout_ms=timeout_ms)
+              for _ in range(treedef.num_leaves)]
+    return jax.tree.unflatten(treedef, leaves)

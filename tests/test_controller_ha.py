@@ -443,6 +443,7 @@ class TestNodeLivenessDebounce:
         sup.node_id = NodeID.from_random()
         sup._alive_node_hexes = set()
         sup._node_missing_since = {}
+        sup._drained_node_hexes = set()
         return sup
 
     def test_present_dead_reaps_immediately(self):
@@ -477,6 +478,15 @@ class TestNodeLivenessDebounce:
         reaped = sup._node_liveness_reap(set(), set(), 10.0 + 2e6)
         assert reaped == {"x"}
         assert me not in reaped
+
+    def test_drained_node_skips_the_missing_debounce(self):
+        # a DELIBERATE drain whose record already left the view is not an
+        # indeterminate crash: the node handed off on purpose, reap now
+        sup = self._sup()
+        sup._node_liveness_reap({"a", "b"}, set(), 100.0)
+        sup._drained_node_hexes.add("b")
+        assert sup._node_liveness_reap({"a"}, set(), 100.1) == {"b"}
+        assert "b" not in sup._drained_node_hexes
 
 
 # ------------------------------------------------------ cluster-level proofs

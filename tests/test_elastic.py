@@ -1,0 +1,438 @@
+"""Elastic world membership (ISSUE 16).
+
+Unit tier: the ``ElasticSupervisor`` respawn policy (budget, backoff,
+require_positive knob validation) and the object store's
+incarnation-keyed pin accounting (the dead-client sweep racing a
+replacement's registration on the same client id).
+
+Integration tier (single-node cluster): ``ResizableGroup`` +
+``sync_tree`` semantics, then the two workload tentpoles — an elastic
+dp ``PipelineTrainer`` whose killed replica is respawned and rejoins
+over broadcast with EXACT losses, and an elastic Sebulba topology whose
+killed env-runner rejoins over the next-epoch parameter broadcast.
+
+Cluster tier: a deliberately drained node dies immediately (drained
+flag in the views, supervisor process still healthy — no health-grace
+debounce).
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu._private.elastic import ElasticSupervisor, require_positive
+
+
+class TestRequirePositive:
+    def test_accepts_positive(self):
+        assert require_positive("k", 3) == 3
+        assert require_positive("k", "4") == 4
+        assert require_positive("k", 0.5, kind=float) == 0.5
+
+    @pytest.mark.parametrize("bad", [0, -1, "0"])
+    def test_rejects_zero_and_negative(self, bad):
+        with pytest.raises(ValueError, match="positive"):
+            require_positive("k", bad)
+
+    def test_rejects_none(self):
+        with pytest.raises(ValueError, match="must be set"):
+            require_positive("k", None)
+
+
+class TestElasticSupervisor:
+    def _sup(self, **kw):
+        kw.setdefault("respawn_budget", 2)
+        kw.setdefault("backoff_s", 0.01)
+        kw.setdefault("resize_timeout_s", 5.0)
+        return ElasticSupervisor(**kw)
+
+    def test_budget_is_per_slot(self):
+        sup = self._sup()
+        spawned = []
+        for _ in range(2):
+            sup.respawn("a", lambda: spawned.append("a"))
+        with pytest.raises(RuntimeError, match="budget exhausted"):
+            sup.respawn("a", lambda: spawned.append("a"))
+        # a different slot has its own budget
+        sup.respawn("b", lambda: spawned.append("b"))
+        assert spawned == ["a", "a", "b"]
+        assert sup.attempts("a") == 2 and sup.attempts("b") == 1
+
+    def test_backoff_grows_on_same_slot(self):
+        sup = self._sup(respawn_budget=3, backoff_s=0.05)
+        t0 = time.monotonic()
+        sup.respawn("s", lambda: None)      # first attempt: no backoff
+        first = time.monotonic() - t0
+        t0 = time.monotonic()
+        sup.respawn("s", lambda: None)      # second: ~backoff_s
+        second = time.monotonic() - t0
+        assert first < 0.04
+        assert second >= 0.04
+
+    @pytest.mark.parametrize("knob", [
+        dict(respawn_budget=0),
+        dict(backoff_s=0.0),
+        dict(resize_timeout_s=0),
+    ])
+    def test_explicit_zero_knobs_raise(self, knob):
+        with pytest.raises(ValueError, match="positive"):
+            self._sup(**knob)
+
+    def test_env_knobs_flow_through_config(self, monkeypatch):
+        from ray_tpu._private.config import Config
+
+        monkeypatch.setenv("RAY_TPU_ELASTIC_RESPAWN_BUDGET", "5")
+        monkeypatch.setenv("RAY_TPU_ELASTIC_BACKOFF_S", "0.25")
+        cfg = Config.from_env()
+        sup = ElasticSupervisor(config=cfg)
+        assert sup.respawn_budget == 5
+        assert sup.backoff_s == 0.25
+
+    def test_env_zero_rejected_not_defaulted(self, monkeypatch):
+        from ray_tpu._private.config import Config
+
+        monkeypatch.setenv("RAY_TPU_ELASTIC_RESPAWN_BUDGET", "0")
+        cfg = Config.from_env()
+        with pytest.raises(ValueError, match="positive"):
+            ElasticSupervisor(config=cfg)
+
+
+class TestIncarnationKeyedPins:
+    """The dead-client pin sweep racing a replacement's registration on
+    the SAME client id ("node:<hex>" flap-back): the sweep captures
+    ``client_epoch + 1`` at death, the re-registration bumps the epoch
+    BEFORE re-pinning, so the late release only reclaims the dead
+    incarnation's pins."""
+
+    def _store(self, tmp_path):
+        from ray_tpu._private.object_store import NodeObjectStore
+
+        return NodeObjectStore(str(tmp_path / "arena"), 1 << 20,
+                               str(tmp_path / "spill"))
+
+    def _sealed(self, store, size=64):
+        from ray_tpu._private.object_store import ObjectID
+
+        oid = ObjectID.from_put()
+        off = store.create(oid, size)
+        store.arena.write(off, b"x" * size)
+        store.seal(oid)
+        return oid
+
+    def test_release_bounded_to_dead_incarnation(self, tmp_path):
+        store = self._store(tmp_path)
+        try:
+            a, b = self._sealed(store), self._sealed(store)
+            client = "node:deadbeef"
+            store.locate(a, pin=True, client=client)      # epoch 0 pin
+            # death observed: sweep captures the bound FIRST...
+            bound = store.client_epoch(client) + 1
+            # ...then the node flaps back and re-pins under a bumped
+            # epoch before the (slow) release runs
+            store.bump_client_epoch(client)
+            store.locate(b, pin=True, client=client)      # epoch 1 pin
+            assert store.stats()["pins_total"] == 2
+            released = store.release_client_pins(client, bound)
+            assert released == 1
+            # the replacement incarnation's pin SURVIVED the late sweep
+            assert store.stats()["pins_total"] == 1
+            assert store.pinned_clients() == [client]
+            # unbounded release (graceful departure) takes the rest
+            assert store.release_client_pins(client) == 1
+            assert store.stats()["pins_total"] == 0
+        finally:
+            store.shutdown()
+
+    def test_unpin_matches_older_epoch_pin(self, tmp_path):
+        store = self._store(tmp_path)
+        try:
+            a = self._sealed(store)
+            client = "node:cafe"
+            store.locate(a, pin=True, client=client)      # epoch 0
+            store.bump_client_epoch(client)               # flap-back bump
+            # an owner that outlived the bump still unpins its old pin
+            assert store.unpin(a, client)
+            assert store.stats()["pins_total"] == 0
+        finally:
+            store.shutdown()
+
+    def test_pinned_clients_folds_incarnations(self, tmp_path):
+        store = self._store(tmp_path)
+        try:
+            a, b = self._sealed(store), self._sealed(store)
+            store.locate(a, pin=True, client="node:ab")
+            store.bump_client_epoch("node:ab")
+            store.locate(b, pin=True, client="node:ab")
+            assert store.pinned_clients() == ["node:ab"]
+        finally:
+            store.shutdown()
+
+
+@pytest.mark.usefixtures("ray_init")
+class TestResizableGroup:
+    def test_resize_and_sync_tree(self, ray_init):
+        import ray_tpu
+        from ray_tpu.util.collective.resizable import ResizableGroup
+
+        @ray_tpu.remote
+        class Member:
+            def allreduce(self, fill, name, timeout_ms=60000):
+                from ray_tpu.util import collective as col
+
+                out = col.allreduce(np.full(4, float(fill), np.float64),
+                                    group_name=name,
+                                    timeout_ms=timeout_ms)
+                return float(out[0])
+
+            def refresh(self, name):
+                from ray_tpu.util.collective.resizable import (
+                    refresh_membership)
+
+                return refresh_membership(name)
+
+            def sync(self, fill, name, src_rank=0):
+                from ray_tpu.util.collective.resizable import sync_tree
+
+                tree = None
+                if fill is not None:
+                    tree = {"w": np.full(3, float(fill), np.float64)}
+                out = sync_tree(tree, name, src_rank=src_rank)
+                return float(out["w"][0]), out["w"].shape
+
+        name = f"rz_{os.getpid()}"
+        members = [Member.remote() for _ in range(3)]
+        group = ResizableGroup(members, group_name=name, backend="host")
+        epoch0 = group.epoch
+        assert ray_tpu.get(
+            [m.allreduce.remote(i + 1, name)
+             for i, m in enumerate(members)], timeout=120) == [6.0] * 3
+
+        # shrink: re-declare the two survivors at a fresh generation
+        group.resize(members[:2])
+        assert group.epoch > epoch0
+        ray_tpu.get([m.refresh.remote(name) for m in members[:2]],
+                    timeout=60)
+        assert ray_tpu.get(
+            [m.allreduce.remote(i + 1, name)
+             for i, m in enumerate(members[:2])],
+            timeout=120) == [3.0] * 2
+
+        # grow: a fresh joiner enters at the next generation and receives
+        # rank 0's state tree leaf-wise over collective.broadcast
+        joiner = Member.remote()
+        world = [members[0], members[1], joiner]
+        group.resize(world)
+        ray_tpu.get([m.refresh.remote(name) for m in world], timeout=60)
+        outs = ray_tpu.get(
+            [world[0].sync.remote(7.5, name),
+             world[1].sync.remote(None, name),
+             joiner.sync.remote(None, name)], timeout=120)
+        for first, shape in outs:
+            assert first == 7.5 and tuple(shape) == (3,)
+        assert ray_tpu.get(
+            [m.allreduce.remote(1, name) for m in world],
+            timeout=120) == [3.0] * 3
+
+
+@pytest.mark.preempt
+@pytest.mark.usefixtures("ray_init")
+class TestElasticWorkloads:
+    def test_pipeline_elastic_rejoin_exact(self, ray_init):
+        """Kill one dp stage replica between flushes: the trainer
+        respawns it, reshards the dp group, streams params+opt state to
+        the joiner over broadcast (no checkpoint restore), and every
+        loss matches the uninterrupted single-process reference."""
+        import ray_tpu
+        from ray_tpu._private import api as _api
+        from ray_tpu._private.elastic import (m_departures, m_joins,
+                                              m_rejoin_seconds, m_reshards)
+        from ray_tpu.models import presets
+        from ray_tpu.train import PipelineTrainer
+        from tests.test_train_pipeline import (_batch, _local_losses,
+                                               _store_pins, _tiny_cfg)
+
+        core = _api._require_core()
+        pins0 = _store_pins(core)
+        joins0, deps0 = m_joins.total(), m_departures.total()
+        reshards0 = m_reshards.total()
+        rejoins0 = m_rejoin_seconds.count_total()
+
+        cfg = _tiny_cfg()
+        batch = _batch()
+        STEPS = 4
+        ref = _local_losses(cfg, batch, num_microbatches=2, steps=STEPS)
+
+        trainer = PipelineTrainer(
+            presets.pipeline_stage_defs(cfg, 2, seed=0),
+            num_microbatches=2, dp=2, optimizer=("sgd", 0.05),
+            elastic=True)
+        both = np.concatenate([batch, batch])
+        got = []
+        try:
+            got.append(trainer.step(both)["loss"])
+            got.append(trainer.step(both)["loss"])
+            ray_tpu.kill(trainer._actors[1][0])  # dp row 1, stage 0
+            deadline = time.monotonic() + 30
+            while not trainer._heal_pending \
+                    and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert trainer._heal_pending, \
+                "death fan-out never marked the trainer for healing"
+            got.append(trainer.step(both)["loss"])  # heals, then steps
+            got.append(trainer.step(both)["loss"])
+        finally:
+            trainer.shutdown()
+
+        assert np.allclose(got, ref, atol=1e-5), (got, ref)
+        assert m_joins.total() == joins0 + 1
+        assert m_departures.total() == deps0 + 1
+        assert m_reshards.total() == reshards0 + 1
+        assert m_rejoin_seconds.count_total() == rejoins0 + 1
+
+        deadline = time.monotonic() + 30
+        while _store_pins(core) != pins0 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert _store_pins(core) == pins0
+
+    def test_pipeline_elastic_requires_dp_channels(self, ray_init):
+        from ray_tpu.models import presets
+        from ray_tpu.train import PipelineTrainer
+        from tests.test_train_pipeline import _tiny_cfg
+
+        with pytest.raises(ValueError, match="elastic"):
+            PipelineTrainer(
+                presets.pipeline_stage_defs(_tiny_cfg(), 2, seed=0),
+                num_microbatches=2, dp=1, optimizer=("sgd", 0.05),
+                elastic=True)
+
+    def test_sebulba_elastic_runner_respawn(self, ray_init):
+        """Kill an env-runner mid-run: the topology respawns it into the
+        same seed slot; the replacement rejoins over the next-epoch
+        broadcast (iteration-0 sync_params — no checkpoint restore) and
+        training continues."""
+        import ray_tpu
+        from ray_tpu._private import api as _api
+        from ray_tpu._private.elastic import m_joins, m_rejoin_seconds
+        from ray_tpu.rllib import IMPALAConfig
+
+        core = _api._require_core()
+
+        def store_pins():
+            stats = core._run(core.clients.get(core.supervisor_addr).call(
+                "store_stats"))
+            return stats["pins_total"]
+
+        pins0 = store_pins()
+        joins0, rejoins0 = m_joins.total(), m_rejoin_seconds.count_total()
+
+        cfg = (IMPALAConfig()
+               .environment("CartPole-v1")
+               .env_runners(num_env_runners=2, num_envs_per_env_runner=4,
+                            rollout_fragment_length=16)
+               .training(num_batches_per_iteration=1,
+                         broadcast_interval=1)
+               .learners(topology="sebulba", elastic=True)
+               .debugging(seed=0))
+        algo = cfg.build()
+        topo = algo._podracer
+        try:
+            r1 = algo.train()
+            assert np.isfinite(r1["total_loss"])
+            ray_tpu.kill(topo._runners[1])
+            deadline = time.monotonic() + 30
+            while not topo._heal_pending and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert topo._heal_pending, \
+                "death fan-out never marked the topology for healing"
+            r2 = algo.train()   # heals (respawn + epoch bump), then steps
+            r3 = algo.train()
+            assert topo._epoch == 1
+            assert np.isfinite(r2["total_loss"])
+            assert np.isfinite(r3["total_loss"])
+        finally:
+            algo.stop()
+
+        assert m_joins.total() >= joins0 + 1
+        assert m_rejoin_seconds.count_total() >= rejoins0 + 1
+
+        deadline = time.monotonic() + 30
+        while store_pins() != pins0 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert store_pins() == pins0
+
+    def test_sebulba_learner_death_is_terminal(self, ray_init):
+        """A learner's optimizer state is not replayable without a
+        checkpoint: elastic Sebulba treats a learner death as a clean
+        terminal error, never a silent respawn."""
+        import ray_tpu
+        from ray_tpu.rllib import IMPALAConfig
+
+        cfg = (IMPALAConfig()
+               .environment("CartPole-v1")
+               .env_runners(num_env_runners=1, num_envs_per_env_runner=4,
+                            rollout_fragment_length=16)
+               .training(num_batches_per_iteration=1,
+                         broadcast_interval=1)
+               .learners(topology="sebulba", elastic=True)
+               .debugging(seed=0))
+        algo = cfg.build()
+        topo = algo._podracer
+        try:
+            algo.train()
+            ray_tpu.kill(topo._learners[0])
+            deadline = time.monotonic() + 30
+            while not topo._heal_pending and time.monotonic() < deadline:
+                time.sleep(0.05)
+            with pytest.raises(Exception, match="learner|dead|closed"):
+                for _ in range(3):
+                    algo.train()
+        finally:
+            algo.stop()
+
+
+@pytest.mark.preempt
+class TestNodeDrain:
+    def test_drained_node_dies_immediately(self, ray_cluster):
+        """rpc_node_drain retires a HEALTHY node: its supervisor keeps
+        answering health checks, so only the drain explains the death —
+        the views flip to drained without any health-grace debounce."""
+        import ray_tpu
+
+        # last test in the module: detach from the module-scoped
+        # single-node session before joining the multi-node cluster
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        ray_cluster.add_node(num_cpus=2)
+        node_b = ray_cluster.add_node(num_cpus=2)
+        ray_cluster.wait_for_nodes(2)
+        ray_tpu.init(address=ray_cluster.address)
+
+        from ray_tpu._private import api as _api
+
+        core = _api._require_core()
+        me = core.node_id_hex
+        victim = [v["node_id_hex"] for v in ray_tpu.nodes()
+                  if v["alive"] and v["node_id_hex"] != me]
+        assert victim, "no second node visible"
+        t0 = time.monotonic()
+        core._run(core.clients.get(core.controller_addr).call(
+            "node_drain", {"node_id_hex": victim[0]}))
+        deadline = time.monotonic() + 10
+        flipped = None
+        while time.monotonic() < deadline and flipped is None:
+            views = {v["node_id_hex"]: v for v in ray_tpu.nodes()}
+            v = views.get(victim[0])
+            if v is not None and not v["alive"]:
+                flipped = v
+            else:
+                time.sleep(0.05)
+        assert flipped is not None, "drained node never left the view"
+        assert flipped["drained"], flipped
+        # immediacy: well under the crash path's grace window — the
+        # supervisor process is still alive, so no health check failed
+        assert time.monotonic() - t0 < 5.0
+        assert node_b.proc.poll() is None, (
+            "drain must mark the node dead in the view, not kill the "
+            "supervisor process")
