@@ -93,12 +93,19 @@ def _measure(candidates, batch, seq, steps):
                   file=sys.stderr)
 
 
-def _mfu_record(metric, dt, n_params, cfg, batch, seq, peak):
+def _mfu_record(metric, dt, n_params, cfg, batch, seq, peak,
+                tp=1, dp=1, pp=1, virtual_stages=1):
     tokens_per_step = batch * seq
     # Model FLOPs only (MFU convention — remat recompute excluded):
     # fwd+bwd ≈ 6 flops/param/token + attention 12*L*S*E per token.
+    # n_params is the FUSED model; under tensor parallelism each rank
+    # executes 1/tp of those flops (column/row shards split every matmul
+    # evenly), so the per-device utilization divides by tp. dp replicates
+    # compute (no division) and pp splits by stage via n_params already
+    # being the per-stage count at the call site.
     flops_per_token = 6 * n_params + 12 * cfg.num_layers * seq * cfg.embed_dim
-    mfu = flops_per_token * tokens_per_step / dt / peak
+    flops_per_token_per_rank = flops_per_token / max(int(tp), 1)
+    mfu = flops_per_token_per_rank * tokens_per_step / dt / peak
     return {
         "metric": metric,
         "value": round(mfu, 4),
@@ -109,6 +116,13 @@ def _mfu_record(metric, dt, n_params, cfg, batch, seq, peak):
             "step_time_ms": round(dt * 1e3, 2),
             "params": n_params,
             "remat": cfg.remat_policy if cfg.remat else "none",
+            # parallelism stamp: MFU records from different grid shapes
+            # must not be compared without knowing the axes
+            "tp": int(tp),
+            "dp": int(dp),
+            "pp": int(pp),
+            "virtual_stages": int(virtual_stages),
+            "flops_per_token_per_rank": int(flops_per_token_per_rank),
         },
     }
 

@@ -703,14 +703,39 @@ def free_and_unpin_specs(core, specs, timeout: float = 60) -> None:
             logger.debug("channel release fan-out failed", exc_info=True)
 
 
-def resolve_actor_placement(core, actor_id, views=None) -> dict:
+def plan_axis_placement(views, *, num_stages: int, dp: int = 1
+                        ) -> "list[list[str]]":
+    """Per-axis device model for a tp x dp x pp trainer: node_id_hex per
+    (dp replica, pipeline stage) slot. Every tp rank of a (r, s) slot
+    shares ONE node — the node-as-pseudo-pod whose collective auto rule
+    picks the shared-memory fast path — while consecutive stages (and dp
+    replicas) round-robin across nodes so the pp/dp edges are the ones
+    that cross hosts. Nodes are taken alive-first in sorted-id order, so
+    the plan is deterministic for a given cluster view."""
+    nodes = sorted(v["node_id_hex"] for v in views if v.get("alive", True))
+    if not nodes:
+        nodes = sorted(v["node_id_hex"] for v in views)
+    if not nodes:
+        raise RuntimeError("plan_axis_placement: empty cluster view")
+    return [[nodes[(r * num_stages + s) % len(nodes)]
+             for s in range(num_stages)] for r in range(dp)]
+
+
+def resolve_actor_placement(core, actor_id, views=None, *,
+                            expect_node_id_hex=None) -> dict:
     """Wait (bounded) for the actor to be ALIVE, then snapshot its
     worker/node identity. Channel placement pins to this incarnation:
     if the actor later restarts elsewhere, its run loop dies with the
     old worker and the graph/pipeline closes — compiled topologies do
     not migrate; rebuild against the restarted actor. ``views`` lets a
     caller resolve a whole actor set against one node_views snapshot
-    (refreshed once here if the actor's node joined after it)."""
+    (refreshed once here if the actor's node joined after it).
+
+    ``expect_node_id_hex``: the node an axis-aware plan
+    (plan_axis_placement) asked for. Soft scheduling may land the actor
+    elsewhere — correctness holds (ring transport crosses nodes), only
+    the shm fast path is lost — so a mismatch warns and is recorded as
+    ``planned_node_ok=False`` rather than raising."""
     ctrl = core.clients.get(core.controller_addr)
     deadline = time.monotonic() + 60
     while True:
@@ -745,9 +770,19 @@ def resolve_actor_placement(core, actor_id, views=None) -> dict:
         raise RuntimeError(
             f"actor {actor_id.hex()[:12]}'s node "
             f"{rec['node_id_hex'][:12]} not in the cluster view")
-    return {"actor_id": actor_id, "node_addr": node_addr,
+    info = {"actor_id": actor_id, "node_addr": node_addr,
             "node_id_hex": rec["node_id_hex"],
             "worker_id_hex": rec["worker_id_hex"]}
+    if expect_node_id_hex is not None:
+        ok = rec["node_id_hex"] == expect_node_id_hex
+        info["planned_node_ok"] = ok
+        if not ok:
+            logger.warning(
+                "actor %s landed on node %s, not the planned node %s — "
+                "its tp group falls back to the cross-node ring "
+                "transport", actor_id.hex()[:12],
+                rec["node_id_hex"][:12], expect_node_id_hex[:12])
+    return info
 
 
 def surface_loop_failure(core, loop_refs, closed: "ChannelClosedError"):

@@ -112,6 +112,14 @@ class Config:
     # schedule bit-for-bit. Explicit zeros are REJECTED at build (env or
     # argument — the falsy-zero lesson): 0 never silently means 1
     pipeline_virtual_stages: int = 1
+    # tensor-parallel width (tp x dp x pp 3D training): each pipeline
+    # stage's chunk params are Megatron column/row-sharded over this many
+    # ranks, partial sums allreduced over per-(stage, dp-rank) collective
+    # groups, and the dp flush reduces only each rank's 1/tp shard
+    # (weight-update sharding). 1 (default) is the 2D dp x pp trainer
+    # bit-for-bit. Explicit zeros are REJECTED at build (env or argument
+    # — the falsy-zero lesson): 0 never silently means 1
+    pipeline_tp: int = 1
     # ---- serve: continuous (iteration-level) batching ----
     # KV-arena sequence slots per LLM replica: the fixed batch width of the
     # jitted decode step (serve/_private/continuous.py). More slots = more

@@ -168,16 +168,84 @@ def _resolve_virtual_stages(virtual_stages, num_stages: int,
     return v
 
 
+def _check_tp_cfg(cfg, tp: int) -> None:
+    """tensor_parallel feasibility, the house way: every rejection names
+    the offending CONFIG FIELD and the actionable count."""
+    if cfg.tie_embeddings:
+        raise ValueError(
+            "tensor_parallel>1: cfg.tie_embeddings=True is unsupported — "
+            "the tied table would need a cross-stage AND cross-tp-rank "
+            "gradient sum every flush. Build the config with "
+            "tie_embeddings=False")
+    if cfg.mlp == "moe":
+        raise ValueError(
+            "tensor_parallel>1: cfg.mlp='moe' is unsupported — experts "
+            "shard over the expert axis, not tensor columns. Use a dense "
+            "mlp (cfg.mlp='swiglu'/'gelu'), or shard MoE configs with "
+            "expert parallelism")
+    if cfg.num_heads % tp:
+        raise ValueError(
+            f"tensor_parallel={tp} does not divide cfg.num_heads="
+            f"{cfg.num_heads}: attention shards whole query heads, so "
+            f"each rank needs num_heads/tp = {cfg.num_heads}/{tp} to be "
+            f"an integer — use a tp that divides {cfg.num_heads}")
+    if cfg.kv_heads % tp:
+        raise ValueError(
+            f"tensor_parallel={tp} does not divide cfg.num_kv_heads="
+            f"{cfg.kv_heads}: GQA shards whole kv heads alongside their "
+            f"query groups, so each rank needs num_kv_heads/tp = "
+            f"{cfg.kv_heads}/{tp} to be an integer — use a tp that "
+            f"divides {cfg.kv_heads}")
+    if cfg.hidden_dim % tp:
+        raise ValueError(
+            f"tensor_parallel={tp} does not divide the ffn width "
+            f"cfg.mlp_dim={cfg.hidden_dim}: the ffn-up/ffn-down pair "
+            f"shards whole columns, so each rank needs mlp_dim/tp = "
+            f"{cfg.hidden_dim}/{tp} to be an integer — use a tp that "
+            f"divides {cfg.hidden_dim}")
+
+
+def _resolve_tensor_parallel(tensor_parallel, cfg) -> int:
+    """Validate + default the tensor-parallel width. ``None`` takes the
+    ``RAY_TPU_PIPELINE_TP`` knob (default 1); an explicit 0 — argument
+    or env — RAISES instead of silently meaning 1 (the falsy-zero
+    lesson), and an infeasible tp raises naming the config field."""
+    if tensor_parallel is None:
+        from ray_tpu._private.config import global_config
+
+        tensor_parallel = global_config().pipeline_tp
+        source = "RAY_TPU_PIPELINE_TP"
+    else:
+        source = "tensor_parallel"
+    t = int(tensor_parallel)
+    if t < 1:
+        raise ValueError(
+            f"{source}={tensor_parallel} is invalid: tensor_parallel "
+            f"must be >= 1 (1 = unsharded stages; 0 does not mean "
+            f"'default')")
+    if t > 1:
+        _check_tp_cfg(cfg, t)
+    return t
+
+
 def partition_pipeline_params(cfg, params, num_stages: int,
-                              virtual_stages: int = 1):
+                              virtual_stages: int = 1,
+                              tensor_parallel: int = 1):
     """Slice a full init_params() tree into per-CHUNK shards, in
     pipeline order — ``num_stages * virtual_stages`` of them (parity
     tests init once and compare the assembled pipeline to the
     single-process model bit-for-bit; the trainer hands chunk c to
-    stage actor c % num_stages)."""
+    stage actor c % num_stages). With ``tensor_parallel=tp`` > 1 each
+    entry is instead a LIST of tp per-rank shards: blocks Megatron
+    column/row-cut (transformer.shard_block_params), embed / pos /
+    final_norm / lm_head replicated. ``reassemble_pipeline_params`` is
+    the bit-exact inverse."""
     import jax
 
     _check_pipeline_cfg(cfg)
+    tp = int(tensor_parallel)
+    if tp > 1:
+        _check_tp_cfg(cfg, tp)
     chunks = num_stages * int(virtual_stages)
     splits = pipeline_splits(cfg.num_layers, chunks)
     shards = []
@@ -197,8 +265,74 @@ def partition_pipeline_params(cfg, params, num_stages: int,
         if c == chunks - 1:
             shard["final_norm"] = params["final_norm"]
             shard["lm_head"] = params["lm_head"]
-        shards.append(shard)
+        if tp > 1:
+            from ray_tpu.models.transformer import shard_block_params
+
+            ranks = []
+            for t in range(tp):
+                rs = dict(shard)
+                if cfg.scan_layers:
+                    rs["blocks"] = shard_block_params(
+                        cfg, shard["blocks"], tp, t, stacked=True)
+                else:
+                    rs["blocks"] = {
+                        k: shard_block_params(cfg, b, tp, t)
+                        for k, b in shard["blocks"].items()}
+                ranks.append(rs)
+            shards.append(ranks)
+        else:
+            shards.append(shard)
     return shards
+
+
+def reassemble_pipeline_params(cfg, shards, num_stages: int,
+                               virtual_stages: int = 1,
+                               tensor_parallel: int = 1):
+    """Bit-exact inverse of ``partition_pipeline_params``: glue per-chunk
+    (and, with tp > 1, per-tp-rank) shards back into a full
+    ``init_params()``-shaped tree — the parity oracle for comparing an
+    assembled pipeline (e.g. ``PipelineTrainer.fetch_params``) against
+    the fused single-process model."""
+    import jax
+    import jax.numpy as jnp
+
+    chunks = num_stages * int(virtual_stages)
+    tp = int(tensor_parallel)
+    merged = []
+    for c in range(chunks):
+        sh = shards[c]
+        if tp > 1:
+            from ray_tpu.models.transformer import merge_tp_block_params
+
+            base = dict(sh[0])
+            if cfg.scan_layers:
+                base["blocks"] = merge_tp_block_params(
+                    cfg, [s["blocks"] for s in sh], stacked=True)
+            else:
+                base["blocks"] = {
+                    k: merge_tp_block_params(
+                        cfg, [s["blocks"][k] for s in sh])
+                    for k in sh[0]["blocks"]}
+            sh = base
+        merged.append(sh)
+    params = {}
+    if cfg.scan_layers:
+        params["blocks"] = jax.tree.map(
+            lambda *xs: jnp.concatenate(xs, axis=0),
+            *[m["blocks"] for m in merged])
+    else:
+        splits = pipeline_splits(cfg.num_layers, chunks)
+        blocks = {}
+        for (lo, hi), m in zip(splits, merged):
+            for i in range(lo, hi):
+                blocks[str(i)] = m["blocks"][str(i - lo)]
+        params["blocks"] = blocks
+    params["embed"] = merged[0]["embed"]
+    if cfg.pos == "learned":
+        params["pos_embed"] = merged[0]["pos_embed"]
+    params["final_norm"] = merged[-1]["final_norm"]
+    params["lm_head"] = merged[-1]["lm_head"]
+    return params
 
 
 def _stage_init(cfg, seed: int, num_chunks: int, chunk: int):
@@ -313,8 +447,147 @@ def _stage_loss(cfg, lo: int, hi: int, params, x, tokens):
     return loss
 
 
+def _stage_init_tp(cfg, seed: int, num_chunks: int, chunk: int, tp: int,
+                   tp_rank: int = 0):
+    """tp rank's shard of one chunk: the SAME deterministic per-group key
+    layout as _stage_init, each block Megatron-cut after init — bit-
+    identical to slicing ``partition_pipeline_params(init_params(...),
+    ..., tensor_parallel=tp)``. Replicated groups (embed, pos, final
+    norm, lm_head) are built whole on every rank."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.transformer import (_block_params, _norm_params,
+                                            shard_block_params)
+
+    _check_pipeline_cfg(cfg)
+    _check_tp_cfg(cfg, tp)
+    lo, hi = pipeline_splits(cfg.num_layers, num_chunks)[chunk]
+    keys = jax.random.split(jax.random.PRNGKey(seed), cfg.num_layers + 3)
+    init = jax.nn.initializers.normal(0.02, cfg.param_dtype)
+    blocks = [shard_block_params(cfg, _block_params(cfg, keys[3 + i]),
+                                 tp, tp_rank)
+              for i in range(lo, hi)]
+    shard = {}
+    if cfg.scan_layers:
+        shard["blocks"] = jax.tree.map(
+            lambda *xs: jnp.stack(xs, axis=0), *blocks)
+    else:
+        shard["blocks"] = {str(i): b for i, b in enumerate(blocks)}
+    if chunk == 0:
+        shard["embed"] = {
+            "table": init(keys[0], (cfg.vocab_size, cfg.embed_dim))}
+        if cfg.pos == "learned":
+            shard["pos_embed"] = {
+                "table": init(keys[1], (cfg.max_seq_len, cfg.embed_dim))}
+    if chunk == num_chunks - 1:
+        shard["final_norm"] = _norm_params(cfg, cfg.embed_dim)
+        shard["lm_head"] = {
+            "kernel": init(keys[2], (cfg.embed_dim, cfg.vocab_size))}
+    return shard
+
+
+def _tp_apply_blocks(cfg, blocks, h, n_local: int, tp_ops,
+                     split_tail: bool):
+    """Run one stage's tp-sharded block slice — same remat/scan structure
+    as _apply_blocks, with the (g, f) reduce pair threaded through each
+    block. ``split_tail``: the LAST block returns its (residual carry,
+    mlp partial) pair instead of the reduced output, so the trainer can
+    issue the final partial-sum reduce asynchronously and overlap it
+    with the next microbatch's compute."""
+    import jax
+    from jax import lax
+
+    from ray_tpu.models.transformer import _tp_block, _tp_block_tail
+    from ray_tpu.ops.rotary import rope_frequencies
+
+    g, f = tp_ops
+    rope = None if cfg.pos == "learned" else rope_frequencies(
+        cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+
+    def one_block(p, x):
+        return _tp_block(cfg, p, x, rope, g, f)
+
+    def tail_block(p, x):
+        return _tp_block_tail(cfg, p, x, rope, g, f)
+
+    if cfg.remat:
+        policies = {
+            "full": jax.checkpoint_policies.nothing_saveable,
+            "dots": jax.checkpoint_policies.checkpoint_dots,
+        }
+        one_block = jax.checkpoint(
+            one_block, policy=policies[cfg.remat_policy])
+        tail_block = jax.checkpoint(
+            tail_block, policy=policies[cfg.remat_policy])
+
+    n_chain = n_local - 1 if split_tail else n_local
+    if cfg.scan_layers:
+        if n_chain:
+            def body(carry, layer_params):
+                return one_block(layer_params, carry), None
+            head = jax.tree.map(lambda a: a[:n_chain], blocks)
+            h, _ = lax.scan(body, h, head)
+        last = jax.tree.map(lambda a: a[n_local - 1], blocks)
+    else:
+        for i in range(n_chain):
+            h = one_block(blocks[str(i)], h)
+        last = blocks[str(n_local - 1)]
+    if split_tail:
+        return tail_block(last, h)
+    return h
+
+
+def _stage_fwd_tp(cfg, lo: int, hi: int, first: bool, tail: bool, params,
+                  x, *, tp_ops):
+    """tp-sharded non-last-chunk forward. With ``tail`` the return value
+    is the last block's (u, mlp_partial) pair — the chunk output is
+    ``u + allreduce(mlp_partial)``, completed by the trainer."""
+    import jax.numpy as jnp
+
+    if first:
+        h = params["embed"]["table"].astype(cfg.dtype)[x]
+        if cfg.pos == "learned":
+            h = h + params["pos_embed"]["table"].astype(
+                cfg.dtype)[jnp.arange(x.shape[1])]
+    else:
+        h = jnp.asarray(x).astype(cfg.dtype)
+    return _tp_apply_blocks(cfg, params["blocks"], h, hi - lo, tp_ops,
+                            split_tail=tail)
+
+
+def _stage_loss_tp(cfg, lo: int, hi: int, params, x, tokens, *, tp_ops):
+    """tp-sharded last chunk: every reduced quantity is the full sum, so
+    the loss (and its gradient) is identical on every tp rank. The final
+    norm / lm_head are replicated; never tail-split."""
+    import jax.numpy as jnp
+
+    from ray_tpu.models.transformer import _norm
+
+    h = _tp_apply_blocks(cfg, params["blocks"],
+                         jnp.asarray(x).astype(cfg.dtype), hi - lo,
+                         tp_ops, split_tail=False)
+    h = _norm(cfg, params["final_norm"], h)
+    targets = tokens[:, 1:]
+    if cfg.fused_ce:
+        from ray_tpu.ops.losses import fused_softmax_cross_entropy
+
+        loss, _ = fused_softmax_cross_entropy(
+            h[:, :-1], params["lm_head"]["kernel"], targets, None,
+            chunk=cfg.ce_chunk, compute_dtype=cfg.dtype,
+            transpose_table=True)
+    else:
+        from ray_tpu.ops.losses import softmax_cross_entropy
+
+        logits = jnp.einsum(
+            "bsd,dv->bsv", h,
+            params["lm_head"]["kernel"].astype(cfg.dtype))
+        loss, _ = softmax_cross_entropy(logits[:, :-1], targets, None)
+    return loss
+
+
 def pipeline_stage_defs(cfg, num_stages: int, *, virtual_stages=None,
-                        seed: int = 0):
+                        seed: int = 0, tensor_parallel=None):
     """Partition ``cfg`` into pipeline chunk specs for
     ``ray_tpu.train.PipelineTrainer``: uniform block split, embedding on
     the first chunk, final-norm + lm_head + loss on the last. With
@@ -325,21 +598,46 @@ def pipeline_stage_defs(cfg, num_stages: int, *, virtual_stages=None,
     Each spec is a dict of picklable callables ({"init", "fwd"} /
     {"init", "loss"}); init runs ON the stage actor and re-derives the
     full model's deterministic init before slicing, so an assembled
-    pipeline matches ``init_params(cfg, PRNGKey(seed))`` exactly."""
+    pipeline matches ``init_params(cfg, PRNGKey(seed))`` exactly.
+
+    With ``tensor_parallel=tp`` (None = the ``RAY_TPU_PIPELINE_TP``
+    knob, default 1) each chunk is additionally Megatron column/row-
+    sharded over tp ranks: init grows a ``tp_rank`` kwarg (the trainer
+    binds each rank's), fwd/loss grow a ``tp_ops`` kwarg (the (g, f)
+    partial-sum reduce pair from ``ray_tpu.util.collective.tp``), and
+    the spec carries ``tp``/``tp_tail`` so the trainer wires per-(stage,
+    dp-rank) tp groups and the async tail reduce. Pass the SAME tp to
+    ``PipelineTrainer(tensor_parallel=...)``."""
     import functools
+
+    from ray_tpu.models.transformer import tp_tail_supported
 
     _check_pipeline_cfg(cfg)
     v = _resolve_virtual_stages(virtual_stages, num_stages,
                                 cfg.num_layers)
+    t = _resolve_tensor_parallel(tensor_parallel, cfg)
     chunks = num_stages * v
     splits = pipeline_splits(cfg.num_layers, chunks)
     defs = []
     for c, (lo, hi) in enumerate(splits):
-        d = {"init": functools.partial(
-            _stage_init, cfg, seed, chunks, c)}
-        if c == chunks - 1:
-            d["loss"] = functools.partial(_stage_loss, cfg, lo, hi)
+        if t == 1:
+            d = {"init": functools.partial(
+                _stage_init, cfg, seed, chunks, c)}
+            if c == chunks - 1:
+                d["loss"] = functools.partial(_stage_loss, cfg, lo, hi)
+            else:
+                d["fwd"] = functools.partial(
+                    _stage_fwd, cfg, lo, hi, c == 0)
         else:
-            d["fwd"] = functools.partial(_stage_fwd, cfg, lo, hi, c == 0)
+            d = {"init": functools.partial(
+                _stage_init_tp, cfg, seed, chunks, c, t), "tp": t}
+            if c == chunks - 1:
+                d["loss"] = functools.partial(_stage_loss_tp, cfg, lo, hi)
+                d["tp_tail"] = False
+            else:
+                tail = tp_tail_supported(cfg)
+                d["fwd"] = functools.partial(
+                    _stage_fwd_tp, cfg, lo, hi, c == 0, tail)
+                d["tp_tail"] = tail
         defs.append(d)
     return defs

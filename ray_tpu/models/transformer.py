@@ -351,6 +351,164 @@ def forward(cfg: TransformerConfig, params, tokens, *, positions=None,
     return logits
 
 
+# ---------------------------------------------------------------------------
+# tensor parallelism (Megatron column/row sharding, arXiv:1909.09756)
+#
+# Each block is cut over tp ranks: QKV / ffn-up are COLUMN-parallel (output
+# features sharded — heads for attention, ffn columns for the mlp) and the
+# attention proj / ffn-down are ROW-parallel (input features sharded), so a
+# rank's block forward needs exactly one partial-sum allreduce per sublayer:
+# the conjugate (g, f) operator pair from ray_tpu.util.collective.tp. Norms,
+# post-reduce biases, embeddings and the lm_head stay replicated and receive
+# exact replicated gradients via f's backward reduce — no flush-time tp sync.
+
+
+def tp_block_shard_spec(cfg: TransformerConfig) -> Dict[str, Dict[str, int]]:
+    """path -> shard axis for ONE UNSTACKED block's sharded leaves.
+
+    Column-parallel leaves shard their output-feature axis, row-parallel
+    leaves their input-feature axis. Leaves absent from the spec (norms,
+    gelu's post-reduce b_out) are replicated. For scan-stacked blocks add 1
+    to every axis (the leading layers axis).
+    """
+    spec: Dict[str, Dict[str, int]] = {
+        "attn": {"wq": 1, "wk": 1, "wv": 1,   # (d, heads, hd) — heads
+                 "wo": 0},                     # (heads, hd, d) — heads
+    }
+    if cfg.mlp == "swiglu":
+        spec["mlp"] = {"w_gate": 1, "w_up": 1,  # (d, f) — ffn columns
+                       "w_down": 0}             # (f, d) — ffn columns
+    elif cfg.mlp == "gelu":
+        spec["mlp"] = {"w_in": 1, "b_in": 0,    # column-parallel (+ its bias)
+                       "w_out": 0}              # row-parallel; b_out replicated
+    else:
+        raise ValueError(
+            "tensor parallelism does not support cfg.mlp='moe' — experts "
+            "are already expert-parallel; shard with moe_num_experts "
+            "instead, or set cfg.mlp to 'swiglu'/'gelu'")
+    return spec
+
+
+def _tp_map_block(cfg, block, fn, stacked: bool):
+    """Apply fn(leaf, shard_axis_or_None) over one block's leaves."""
+    spec = tp_block_shard_spec(cfg)
+    off = 1 if stacked else 0
+    out: Dict[str, Any] = {}
+    for group, leaves in block.items():
+        gspec = spec.get(group, {})
+        out[group] = {
+            name: fn(leaf, gspec[name] + off if name in gspec else None)
+            for name, leaf in leaves.items()}
+    return out
+
+
+def shard_block_params(cfg: TransformerConfig, block, tp: int, tp_rank: int,
+                       *, stacked: bool = False):
+    """Rank ``tp_rank``'s shard of one block's params (replicated leaves
+    pass through unsliced). ``stacked``: block carries a leading layers
+    axis (scan_layers stacking)."""
+    def cut(leaf, axis):
+        if axis is None:
+            return leaf
+        n = leaf.shape[axis]
+        k = n // tp
+        idx = (slice(None),) * axis + (slice(tp_rank * k, (tp_rank + 1) * k),)
+        return leaf[idx]
+
+    return _tp_map_block(cfg, block, cut, stacked)
+
+
+def merge_tp_block_params(cfg: TransformerConfig, shards, *,
+                          stacked: bool = False):
+    """Bit-exact inverse of shard_block_params: concatenate the rank
+    shards back into the fused block (replicated leaves taken from
+    rank 0)."""
+    def glue(path_leaves, axis):
+        if axis is None:
+            return path_leaves[0]
+        return jnp.concatenate(path_leaves, axis=axis)
+
+    spec = tp_block_shard_spec(cfg)
+    off = 1 if stacked else 0
+    out: Dict[str, Any] = {}
+    for group in shards[0]:
+        gspec = spec.get(group, {})
+        out[group] = {
+            name: glue([s[group][name] for s in shards],
+                       gspec[name] + off if name in gspec else None)
+            for name in shards[0][group]}
+    return out
+
+
+def _tp_attn_partial(cfg, p, x, rope, positions=None):
+    """Attention over this rank's local heads; returns the PARTIAL output
+    projection (sum over local heads only — g completes it)."""
+    q = jnp.einsum("bsd,dhk->bshk", x, p["wq"].astype(cfg.dtype))
+    k = jnp.einsum("bsd,dhk->bshk", x, p["wk"].astype(cfg.dtype))
+    v = jnp.einsum("bsd,dhk->bshk", x, p["wv"].astype(cfg.dtype))
+    if rope is not None:
+        cos, sin = rope
+        q = apply_rotary(q, cos, sin, positions)
+        k = apply_rotary(k, cos, sin, positions)
+    o = attention(q, k, v, causal=True,
+                  impl=cfg.attn_impl if cfg.attn_impl != "ring" else "auto")
+    return jnp.einsum("bshk,hkd->bsd", o, p["wo"].astype(cfg.dtype))
+
+
+def _tp_mlp_partial(cfg, p, x):
+    """MLP over this rank's local ffn columns; returns the PARTIAL down
+    projection (gelu's replicated b_out is added AFTER g — see
+    _tp_mlp_finish)."""
+    if cfg.mlp == "swiglu":
+        gate = jnp.einsum("bsd,df->bsf", x, p["w_gate"].astype(cfg.dtype))
+        up = jnp.einsum("bsd,df->bsf", x, p["w_up"].astype(cfg.dtype))
+        return jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up,
+                          p["w_down"].astype(cfg.dtype))
+    h = jnp.einsum("bsd,df->bsf", x, p["w_in"].astype(cfg.dtype))
+    h = jax.nn.gelu(h + p["b_in"].astype(cfg.dtype), approximate=True)
+    return jnp.einsum("bsf,fd->bsd", h, p["w_out"].astype(cfg.dtype))
+
+
+def _tp_mlp_finish(cfg, p, reduced):
+    """Post-reduce epilogue: replicated bias (gelu) rides on the FULL sum
+    so each rank adds it exactly once."""
+    if cfg.mlp == "gelu":
+        return reduced + p["b_out"].astype(cfg.dtype)
+    return reduced
+
+
+def _tp_block(cfg, p, x, rope, g, f):
+    """Sharded block forward, exact parity with _block on the fused model.
+
+    f on the norm outputs (column-parallel inputs) makes replicated-param
+    and residual cotangents exact; g on the row-parallel partial sums
+    completes each sublayer's activation."""
+    a = g(_tp_attn_partial(cfg, p["attn"], f(_norm(cfg, p["ln1"], x)), rope))
+    x = x + a
+    m = _tp_mlp_finish(
+        cfg, p["mlp"],
+        g(_tp_mlp_partial(cfg, p["mlp"], f(_norm(cfg, p["ln2"], x)))))
+    return x + m
+
+
+def _tp_block_tail(cfg, p, x, rope, g, f):
+    """Last block of a forward chunk, tail-split: returns (u, mlp_partial)
+    where the full output is u + allreduce(mlp_partial). The trainer issues
+    that final reduce asynchronously on the host and overlaps it with the
+    next microbatch's compute. Only valid when the mlp has no post-reduce
+    epilogue (swiglu — see tp_tail_supported)."""
+    a = g(_tp_attn_partial(cfg, p["attn"], f(_norm(cfg, p["ln1"], x)), rope))
+    u = x + a
+    mp = _tp_mlp_partial(cfg, p["mlp"], f(_norm(cfg, p["ln2"], u)))
+    return u, mp
+
+
+def tp_tail_supported(cfg: TransformerConfig) -> bool:
+    """Whether forward chunks may tail-split their last block (the partial
+    sum must BE the block's residual delta — no post-reduce bias)."""
+    return cfg.mlp == "swiglu"
+
+
 def loss_fn(cfg: TransformerConfig, params, batch, *, sp_axis=None,
             positions=None):
     """Causal-LM loss. batch: {'tokens': [B,S], optional 'mask': [B,S]}.

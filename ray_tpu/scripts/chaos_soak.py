@@ -630,6 +630,8 @@ def run_pipeline_chaos(
     delay_max_ms: int = 20,
     kills: bool = True,
     virtual_stages: int = 1,
+    tensor_parallel: int = 1,
+    dp: int = 1,
 ) -> None:
     """One seeded chaos run against the MPMD pipeline trainer.
 
@@ -647,6 +649,13 @@ def run_pipeline_chaos(
     in-flight step must surface a clean ChannelClosedError/ActorDiedError
     (never a hang, never a silently wrong loss), teardown must unwind,
     and the driver's channel pins must return to baseline.
+    With ``tensor_parallel=2`` (and ``dp=2``) the same two nodes carry
+    the full 3D grid — tp=2 x dp=2 x S=2, eight actors, every stage's
+    four (dp, tp) replicas pinned to one node so the tp partial-sum
+    reduces stay same-node while every pp act/grad hop still crosses
+    nodes under the attack. Losses must still match the fused
+    single-process reference exactly, and every steady report must show
+    the tp groups engaged.
     """
     import threading
 
@@ -666,9 +675,17 @@ def run_pipeline_chaos(
     from ray_tpu.models.transformer import init_params, loss_fn
 
     V = int(virtual_stages)
-    mcfg = presets.llama_debug(
-        num_layers=2 * V, vocab_size=128, max_seq_len=32, embed_dim=32,
-        num_heads=2, num_kv_heads=1, mlp_dim=64)
+    TP = int(tensor_parallel)
+    DP = int(dp)
+    if TP == 1:
+        mcfg = presets.llama_debug(
+            num_layers=2 * V, vocab_size=128, max_seq_len=32, embed_dim=32,
+            num_heads=2, num_kv_heads=1, mlp_dim=64)
+    else:
+        # tp must divide the head/kv-head/mlp counts
+        mcfg = presets.llama_debug(
+            num_layers=2 * V, vocab_size=128, max_seq_len=32, embed_dim=32,
+            num_heads=2 * TP, num_kv_heads=TP, mlp_dim=64)
     batch = np.random.default_rng(0).integers(
         0, 128, (16, 16)).astype(np.int32)
     M = 4
@@ -707,8 +724,10 @@ def run_pipeline_chaos(
 
     cluster = Cluster(config=cfg)
     try:
-        cluster.add_node(num_cpus=4, resources={"left": 100})
-        cluster.add_node(num_cpus=4, resources={"right": 100})
+        # the 3D grid packs the tp x dp replicas of each stage on one node
+        ncpu = 4 if TP == 1 and DP == 1 else 4 * TP * DP
+        cluster.add_node(num_cpus=ncpu, resources={"left": 100})
+        cluster.add_node(num_cpus=ncpu, resources={"right": 100})
         cluster.wait_for_nodes(2)
         ray_tpu.init(address=cluster.address)
         chaos.set_fault_controller(FaultController(
@@ -726,22 +745,33 @@ def run_pipeline_chaos(
             return stats["pins_total"]
 
         pins_before = store_pins()
+        extra = {}
+        if TP > 1:
+            # keep the 3D grid's 2x ring count inside the object store
+            extra["buffer_bytes"] = 1 * 1024 * 1024
         trainer = PipelineTrainer(
             presets.pipeline_stage_defs(mcfg, 2, virtual_stages=V,
-                                        seed=0),
-            num_microbatches=M, virtual_stages=V, optimizer=("sgd", 0.05),
+                                        seed=0, tensor_parallel=TP),
+            num_microbatches=M, dp=DP, virtual_stages=V,
+            tensor_parallel=TP, optimizer=("sgd", 0.05),
             stage_options=[{"resources": {"left": 1}},
-                           {"resources": {"right": 1}}])
+                           {"resources": {"right": 1}}], **extra)
         assert trainer.is_channel_backed and trainer.channel_depth > 1, (
             "pipeline chaos run is not on the slot-ring channel substrate")
         assert trainer.virtual_stages == V, (
             "pipeline chaos run is not on the requested interleaved "
             "schedule")
+        assert trainer.tensor_parallel == TP, (
+            "pipeline chaos run is not on the requested tp width")
         for step in range(3):
             out = trainer.step(batch)
             assert abs(out["loss"] - ref_losses[step]) < 1e-4, (
                 f"step {step}: pipeline loss {out['loss']} != reference "
                 f"{ref_losses[step]} — chaos corrupted training")
+            if TP > 1:
+                for rep in out["reports"]:
+                    assert rep["tp"] == TP and rep["tp_reduce_calls"] > 0, (
+                        f"step {step}: tp groups not engaged: {rep}")
 
         if kills:
             # stage kill MID-FLUSH: the in-flight step must fail clean
@@ -756,7 +786,7 @@ def run_pipeline_chaos(
             t = threading.Thread(target=stepper)
             t.start()
             time.sleep(0.05)
-            ray_tpu.kill(trainer._actors[0][1])
+            ray_tpu.kill(trainer._actors[0][1][0])
             t.join(timeout=180)
             assert not t.is_alive(), "step hung after a stage-actor kill"
             if "err" in box:
@@ -1848,7 +1878,7 @@ def _preempt_pipeline(seed: int, cluster) -> None:
         got = []
         for step in range(kill_after + 1):
             got.append(trainer.step(both)["loss"])
-        victim = trainer._actors[victim_r][victim_s]
+        victim = trainer._actors[victim_r][victim_s][0]
         ray_tpu.kill(victim)
         deadline = time.monotonic() + 60
         while not trainer._heal_pending and time.monotonic() < deadline:
@@ -2129,6 +2159,16 @@ def _run_one(seed: int, args) -> None:
                 delay_prob=args.delay,
                 delay_max_ms=args.delay_max_ms, kills=not args.no_kills,
                 virtual_stages=v)
+        # then the full 3D grid (ISSUE 17): tp=2 x dp=2 x S=2, eight
+        # actors across the same two nodes — every pp hop still crosses
+        # nodes under the identical fault schedule while the tp
+        # partial-sum reduces run same-node
+        run_pipeline_chaos(
+            seed,
+            drop_prob=args.drop, dup_prob=args.dup,
+            delay_prob=args.delay,
+            delay_max_ms=args.delay_max_ms, kills=not args.no_kills,
+            virtual_stages=1, tensor_parallel=2, dp=2)
         return
     if args.data:
         run_data_chaos(
@@ -2189,8 +2229,9 @@ def main() -> int:
                              "with out-of-order waits under drop/dup/delay "
                              "+ a participant kill mid-flight")
     parser.add_argument("--pipeline", action="store_true",
-                        help="attack the MPMD pipeline trainer (both the "
-                             "plain and the V=2 interleaved schedules): "
+                        help="attack the MPMD pipeline trainer (the "
+                             "plain and V=2 interleaved schedules, then "
+                             "the tp=2 x dp=2 x S=2 3D grid): "
                              "cross-node "
                              "1F1B microbatch pushes (chunked channel "
                              "frames) under drop/dup/delay must train to "

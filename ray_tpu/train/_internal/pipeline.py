@@ -88,6 +88,7 @@ _F_FLUSH = flight.intern("pipe.flush")
 _F_OPT = flight.intern("pipe.opt")
 _F_DP = flight.intern("pipe.dp_allreduce")
 _F_BUBBLE = flight.intern("pipe.bubble_bp")
+_F_TPTAIL = flight.intern("pipe.tp_tail_wait")
 
 _m_microbatches = Counter(
     "ray_tpu_pipeline_microbatches_total",
@@ -120,11 +121,23 @@ class StageSpec:
       init()                  -> params pytree (this shard only)
       fwd(params, x)          -> y activations (differentiable in both)
       loss(params, x, labels) -> scalar loss (differentiable in p and x)
+
+    Tensor-parallel chunks (``tp`` > 1, e.g. from
+    ``pipeline_stage_defs(cfg, S, tensor_parallel=tp)``) additionally
+    accept ``init(tp_rank=...)`` (this rank's Megatron column/row shard)
+    and ``fwd/loss(..., tp_ops=(g, f))`` — the partial-sum reduce pair
+    the trainer binds to this rank's per-(stage, dp) tp group. With
+    ``tp_tail`` the fwd returns the last block's ``(u, mlp_partial)``
+    pair instead of the finished activation; the run loop completes
+    ``u + allreduce(mlp_partial)`` on the host, asynchronously when the
+    next scheduled op allows overlap.
     """
 
     init: Callable[[], Any]
     fwd: Optional[Callable[[Any, Any], Any]] = None
     loss: Optional[Callable[[Any, Any, Any], Any]] = None
+    tp: int = 1
+    tp_tail: bool = False
 
 
 def _as_stage_spec(obj) -> StageSpec:
@@ -132,7 +145,8 @@ def _as_stage_spec(obj) -> StageSpec:
         return obj
     if isinstance(obj, dict):
         return StageSpec(init=obj["init"], fwd=obj.get("fwd"),
-                         loss=obj.get("loss"))
+                         loss=obj.get("loss"), tp=int(obj.get("tp", 1)),
+                         tp_tail=bool(obj.get("tp_tail", False)))
     raise TypeError(f"not a stage spec: {obj!r}")
 
 
@@ -164,14 +178,32 @@ class _ChunkRuntime:
     — full-remat 1F1B, so the stash is one input per in-flight
     microbatch, never the whole residual tree), gradient accumulator."""
 
-    def __init__(self, spec: StageSpec, chunk: int, num_chunks: int):
+    def __init__(self, spec: StageSpec, chunk: int, num_chunks: int,
+                 tp_rank: int = 0, tp_ops=None):
+        import functools
+
         import jax
 
         self.spec = spec
         self.chunk = int(chunk)
         self.first = self.chunk == 0
         self.last = self.chunk == int(num_chunks) - 1
-        self.params = spec.init()
+        self.tp = int(getattr(spec, "tp", 1) or 1)
+        # tail chunks end on the last block's (u, mlp_partial) pair —
+        # the run loop completes u + allreduce(mp) on the host so the
+        # reduce can overlap the NEXT microbatch's compute
+        self.tail = bool(spec.tp_tail) and self.tp > 1 and not self.last
+        if self.tp > 1:
+            # bind this rank's shard + the trainer's reduce pair into
+            # the spec callables: downstream code sees plain fns
+            init_fn = functools.partial(spec.init, tp_rank=int(tp_rank))
+            fwd_fn = (functools.partial(spec.fwd, tp_ops=tp_ops)
+                      if spec.fwd is not None else None)
+            loss_fn = (functools.partial(spec.loss, tp_ops=tp_ops)
+                       if spec.loss is not None else None)
+        else:
+            init_fn, fwd_fn, loss_fn = spec.init, spec.fwd, spec.loss
+        self.params = init_fn()
         self._stash: Dict[int, Any] = {}
         self.acc = None
         self.losses: List[float] = []
@@ -184,11 +216,11 @@ class _ChunkRuntime:
         # the running accumulator donated in place. Two variants each:
         # the flush's first microbatch has no accumulator yet.
         if self.last:
-            if spec.loss is None:
+            if loss_fn is None:
                 raise ValueError(
                     f"chunk {chunk} is the last of {num_chunks} and needs "
                     f"a loss callable")
-            lg = jax.value_and_grad(spec.loss, argnums=(0, 1))
+            lg = jax.value_and_grad(loss_fn, argnums=(0, 1))
 
             def _lg_first(p, x, labels):
                 loss, (gp, gx) = lg(p, x, labels)
@@ -201,30 +233,39 @@ class _ChunkRuntime:
             self._lg_first = jax.jit(_lg_first)
             self._lg_acc = jax.jit(_lg_acc, donate_argnums=3)
         else:
-            if spec.fwd is None:
+            if fwd_fn is None:
                 raise ValueError(f"chunk {chunk} needs a fwd callable")
-            self._fwd = jax.jit(spec.fwd)
-            fwd = spec.fwd
+            self._fwd = jax.jit(fwd_fn)
+            fwd = fwd_fn
+            # tail chunks emit (u, mp) with y = u + allreduce(mp)
+            # completed OUTSIDE the jit: dy/du is the identity and the
+            # partial-sum allreduce is identity in its backward (the g
+            # rule), so the downstream cotangent gy enters BOTH outputs
+            tail = self.tail
+
+            def cot(gy):
+                return (gy, gy) if tail else gy
+
             if self.first:
                 # input is raw data (tokens): no gradient flows past it
                 def _bwd_first(p, x, gy):
                     _, vjp = jax.vjp(lambda pp: fwd(pp, x), p)
-                    (gp,) = vjp(gy)
+                    (gp,) = vjp(cot(gy))
                     return None, gp
 
                 def _bwd_acc(p, x, gy, acc):
                     _, vjp = jax.vjp(lambda pp: fwd(pp, x), p)
-                    (gp,) = vjp(gy)
+                    (gp,) = vjp(cot(gy))
                     return None, tree_add(acc, gp)
             else:
                 def _bwd_first(p, x, gy):
                     _, vjp = jax.vjp(fwd, p, x)
-                    gp, gx = vjp(gy)
+                    gp, gx = vjp(cot(gy))
                     return gx, gp
 
                 def _bwd_acc(p, x, gy, acc):
                     _, vjp = jax.vjp(fwd, p, x)
-                    gp, gx = vjp(gy)
+                    gp, gx = vjp(cot(gy))
                     return gx, tree_add(acc, gp)
             self._bwd_first = jax.jit(_bwd_first)
             self._bwd_acc = jax.jit(_bwd_acc, donate_argnums=3)
@@ -269,7 +310,10 @@ class _StageRuntime:
                  num_microbatches: int, optimizer, dp: int, dp_rank: int,
                  group_name: str, fused_flush: bool = True,
                  flush_bucket_bytes: Optional[int] = None,
-                 declarative_group: bool = False):
+                 declarative_group: bool = False, tp: int = 1,
+                 tp_rank: int = 0, tp_group: Optional[str] = None,
+                 tp_tail_group: Optional[str] = None,
+                 tp_overlap: bool = True):
         self.stage = int(stage)
         self.S = int(num_stages)
         self.V = int(virtual_stages)
@@ -283,9 +327,40 @@ class _StageRuntime:
         # generation after a resize — no imperative init here
         self._declarative = bool(declarative_group)
         self._group_ready = False
+        # ---- tensor parallelism (tp x dp x pp): this rank holds each
+        # chunk's 1/tp Megatron column/row shard; the in-jit partial-sum
+        # reduces go through a pure_callback pair bound here against the
+        # per-(stage, dp-rank) tp group. The callbacks carry no tags —
+        # EXECUTION ORDER IS THE MATCH — which is why tp > 1 runs the
+        # deterministic static schedule (run_flush_tp), never the
+        # timing-dependent ready()-probing loops.
+        self.tp = int(tp)
+        self.tp_rank = int(tp_rank)
+        self.tp_group = tp_group
+        self.tp_tail_group = tp_tail_group
+        self.tp_overlap = bool(tp_overlap)
+        self._tp_reduce_calls = 0  # lifetime; reports carry deltas
+        tp_ops = None
+        if self.tp > 1:
+            if not tp_group or not tp_tail_group:
+                raise ValueError(
+                    f"stage {stage}: tp={tp} needs tp_group and "
+                    f"tp_tail_group collective group names")
+            from ray_tpu.util.collective.tp import make_tp_reduce_ops
+
+            def _tp_reduce(arr):
+                from ray_tpu.util import collective as col
+                from ray_tpu.util.collective.types import ReduceOp
+
+                self._tp_reduce_calls += 1
+                return col.allreduce(arr, group_name=self.tp_group,
+                                     op=ReduceOp.SUM)
+
+            tp_ops = make_tp_reduce_ops(_tp_reduce)
         C = self.S * self.V
         self.chunks = [
-            _ChunkRuntime(spec, self.stage + v * self.S, C)
+            _ChunkRuntime(spec, self.stage + v * self.S, C,
+                          tp_rank=self.tp_rank, tp_ops=tp_ops)
             for v, spec in enumerate(specs)]
         self.first = self.chunks[0].first  # global chunk 0 lives here
         self.last = self.chunks[-1].last  # the loss chunk lives here
@@ -308,6 +383,29 @@ class _StageRuntime:
 
     def backward(self, v: int, m: int, gy) -> Any:
         return self.chunks[v].backward(m, gy)
+
+    # -- tail reduce (tp > 1): the last block's mlp partial sum rides a
+    # SEPARATE collective group from the in-jit callbacks, so a pending
+    # async tail reduce can never be mis-paired with the next
+    # microbatch's in-jit reduce sequence
+
+    def tail_reduce_async(self, mp):
+        """Kick the tail partial's allreduce on the runner thread and
+        return the CollectiveWork handle — the caller overlaps it with
+        the next microbatch's forward compute."""
+        from ray_tpu.util import collective as col
+        from ray_tpu.util.collective.types import ReduceOp
+
+        self._tp_reduce_calls += 1
+        return col.allreduce_coalesced_async(
+            [np.asarray(mp)], group_name=self.tp_tail_group,
+            op=ReduceOp.SUM)
+
+    def tail_combine(self, u, work, timeout_ms: int = 120_000):
+        """Finish y = u + allreduce(mp): wait for the tail reduce and
+        add the (replicated-exact) sum onto the residual stream."""
+        (reduced,) = work.wait(timeout_ms)
+        return np.asarray(u) + reduced
 
     # -- flush
 
@@ -606,6 +704,115 @@ def _copy_tree(value):
     return value
 
 
+def _simulate_tp_schedule(S: int, V: int, M: int, depth: int,
+                          stage: int) -> List[Tuple[str, int, int]]:
+    """Deterministic static 1F1B order for ONE stage of a tp > 1
+    pipeline, as ``[("fwd" | "bwd", local_chunk_v, m), ...]``.
+
+    Tensor parallelism forbids the dynamic schedulers: their
+    ``ready()``-probing choices diverge with timing across tp peers, and
+    the in-jit reduce callbacks carry no tags — a mismatched op sequence
+    silently sums the WRONG microbatches (shapes match) or deadlocks. So
+    every rank derives the same order from the same (S, V, M, depth,
+    stage) inputs by simulating all S stages jointly with unit-time ops:
+
+      - per tick each stage runs its deepest ready backward, else its
+        shallowest ready forward (the measured-best interleaved policy);
+      - the loss chunk is ONE fused fwd+bwd op (as in the real loop);
+      - per-chunk in-flight stashes are bounded by min(M, depth) and
+        each act/grad ring holds at most ``depth`` unread values
+        (writes need space; reads ack immediately, like the run loop);
+      - a value written at tick t is readable from t+1.
+
+    The simulated global schedule is feasible under exactly the run
+    loop's blocking-read/write semantics, so S loops each executing
+    their own slice of it in order cannot deadlock: every op's inputs
+    are produced by ops earlier in the witness order, and ring space for
+    every write is freed by reads earlier in the witness order.
+    """
+    C = S * V
+    limit = max(1, min(M, depth))
+    fwd_done = [0] * C
+    bwd_done = [0] * C
+    act_occ = [0] * max(C - 1, 0)   # chunk c -> c+1 values in flight
+    grad_occ = [0] * max(C - 1, 0)  # chunk c+1 -> c values in flight
+    act_tick: Dict[Tuple[int, int], int] = {}   # (edge c, m) -> write tick
+    grad_tick: Dict[Tuple[int, int], int] = {}
+    order: List[List[Tuple[str, int, int]]] = [[] for _ in range(S)]
+    total = 2 * C * M
+    tick = 0
+    while sum(fwd_done) + sum(bwd_done) < total:
+        progressed = False
+        for s in range(S):
+            chunks = [s + u * S for u in range(V)]
+            op = None
+            # deepest ready backward first: it frees a stash slot and
+            # feeds upstream soonest (loss chunk has no separate bwd)
+            for c in reversed(chunks):
+                if c == C - 1 or bwd_done[c] >= fwd_done[c]:
+                    continue
+                m = bwd_done[c]
+                if grad_tick.get((c, m), tick) >= tick:
+                    continue  # grad not committed before this tick
+                if c > 0 and grad_occ[c - 1] >= depth:
+                    continue  # no ring space for our grad write
+                op = ("bwd", c, m)
+                break
+            if op is None:
+                # shallowest ready forward (fills downstream soonest)
+                for c in chunks:
+                    if fwd_done[c] >= M:
+                        continue
+                    m = fwd_done[c]
+                    if c > 0 and act_tick.get((c - 1, m), tick) >= tick:
+                        continue  # input act not committed yet
+                    if c == C - 1:
+                        # loss chunk: fused fwd+bwd, writes grad C-2
+                        if grad_occ[c - 1] >= depth:
+                            continue
+                        op = ("loss", c, m)
+                        break
+                    if fwd_done[c] - bwd_done[c] >= limit:
+                        continue  # stash bound
+                    if act_occ[c] >= depth:
+                        continue  # no ring space for our act write
+                    op = ("fwd", c, m)
+                    break
+            if op is None:
+                continue
+            kind, c, m = op
+            progressed = True
+            if kind == "bwd":
+                bwd_done[c] += 1
+                grad_occ[c] -= 1  # read acks the grad we consumed
+                if c > 0:
+                    grad_occ[c - 1] += 1
+                    grad_tick[(c - 1, m)] = tick
+                order[s].append(("bwd", c // S, m))
+            elif kind == "loss":
+                fwd_done[c] += 1
+                bwd_done[c] += 1
+                act_occ[c - 1] -= 1
+                grad_occ[c - 1] += 1
+                grad_tick[(c - 1, m)] = tick
+                order[s].append(("fwd", c // S, m))
+            else:
+                fwd_done[c] += 1
+                if c > 0:
+                    act_occ[c - 1] -= 1
+                if c < C - 1:
+                    act_occ[c] += 1
+                    act_tick[(c, m)] = tick
+                order[s].append(("fwd", c // S, m))
+        if not progressed:
+            raise RuntimeError(
+                f"tp schedule simulation wedged at tick {tick} "
+                f"(S={S} V={V} M={M} depth={depth}; "
+                f"fwd={fwd_done} bwd={bwd_done}) — scheduler bug")
+        tick += 1
+    return order[int(stage)]
+
+
 def _run_stage_loop(rt: _StageRuntime, plan: _StagePlan) -> dict:
     """The per-actor eager-1F1B run loop (occupies the stage actor until
     its channels close): per flush, run backwards the moment their
@@ -848,6 +1055,78 @@ def _run_stage_loop(rt: _StageRuntime, plan: _StagePlan) -> dict:
             if not first_read[0]:
                 wait_box[0] += time.perf_counter() - t0
 
+    # tp > 1: the deterministic static order every tp peer of this
+    # (stage, dp-rank) slot executes identically — computed once, pure
+    # function of (S, V, M, depth, stage)
+    tp_order = (_simulate_tp_schedule(S, V, M, depth, s)
+                if rt.tp > 1 else None)
+
+    def run_flush_tp(vbase: int) -> None:
+        """The tp static schedule: execute this stage's simulated op
+        order with blocking reads/writes. Tail chunks (Megatron swiglu
+        last block) return (u, mlp_partial); when the IMMEDIATELY next
+        op is the same chunk's next forward, the tail allreduce runs
+        async on the ".tail" group and overlaps that forward's compute —
+        any other successor may transitively depend on the held act
+        write, so the combine happens inline instead. At most one tail
+        reduce is ever pending, and it is flushed before any other
+        channel write (writes stay in version order)."""
+        chs = rt.chunks
+        pending = [None]  # (v, version, u, work)
+
+        def flush_pending() -> None:
+            v, ver, u, work = pending[0]
+            pending[0] = None
+            t0 = flight.now()
+            y = rt.tail_combine(u, work)
+            flight.span_since(_F_TPTAIL, t0)
+            write_value(act_out[v], y, ver)
+
+        n_ops = len(tp_order)
+        for i, (kind, v, m) in enumerate(tp_order):
+            ver = vbase + 2 * m
+            if kind == "fwd":
+                t_mb = flight.now()
+                x = read_value(in_ch if chs[v].first else act_in[v], ver)
+                if chs[v].last:
+                    if pending[0] is not None:
+                        flush_pending()
+                    labels = read_value(label_ch, ver)
+                    _, gx = rt.loss_backward(v, x, labels)
+                    write_value(grad_out[v], gx, ver)
+                elif chs[v].tail:
+                    out = rt.forward(v, m, x)  # overlaps pending reduce
+                    if pending[0] is not None:
+                        flush_pending()  # version order on act_out[v]
+                    u, mp = out
+                    work = rt.tail_reduce_async(mp)
+                    nxt = tp_order[i + 1] if i + 1 < n_ops else None
+                    if rt.tp_overlap and nxt == ("fwd", v, m + 1):
+                        pending[0] = (v, ver, u, work)
+                    else:
+                        t0 = flight.now()
+                        y = rt.tail_combine(u, work)
+                        flight.span_since(_F_TPTAIL, t0)
+                        write_value(act_out[v], y, ver)
+                else:
+                    y = rt.forward(v, m, x)
+                    if pending[0] is not None:
+                        flush_pending()
+                    write_value(act_out[v], y, ver)
+                _m_microbatches.inc(labels=stage_label)
+                flight.span_since(_F_FWD, t_mb)
+            else:
+                if pending[0] is not None:
+                    flush_pending()
+                t_mb = flight.now()
+                gy = read_value(grad_in[v], ver)
+                gx = rt.backward(v, m, gy)
+                if not chs[v].first:
+                    write_value(grad_out[v], gx, ver)
+                flight.span_since(_F_BWD, t_mb)
+        if pending[0] is not None:
+            flush_pending()
+
     flush_idx = 0
     microbatches = 0
     try:
@@ -859,9 +1138,12 @@ def _run_stage_loop(rt: _StageRuntime, plan: _StagePlan) -> dict:
             wait_box[0] = 0.0
             first_read[0] = True
             rpc_before = rpc._m_client_calls.total()
+            tp_before = rt._tp_reduce_calls
             vbase = 2 * (flush_idx * M + 1)
 
-            if V == 1:
+            if rt.tp > 1:
+                run_flush_tp(vbase)
+            elif V == 1:
                 run_flush_v1(vbase)
             else:
                 run_flush_interleaved(vbase)
@@ -887,6 +1169,9 @@ def _run_stage_loop(rt: _StageRuntime, plan: _StagePlan) -> dict:
                 "virtual_stages": V,
                 "fused_bucket_applies":
                     flush_stats["fused_bucket_applies"],
+                "tp": rt.tp,
+                "tp_rank": rt.tp_rank,
+                "tp_reduce_calls": rt._tp_reduce_calls - tp_before,
                 "rpc_calls": rpc._m_client_calls.total() - rpc_before,
                 "wait_s": wait_box[0],
                 "flush_s": total_s,
@@ -941,12 +1226,16 @@ def _run_stage_loop(rt: _StageRuntime, plan: _StagePlan) -> dict:
 def _make_runtime(spec_blobs, stage, num_stages, virtual_stages,
                   num_microbatches, optimizer, dp, dp_rank, group_name,
                   fused_flush, flush_bucket_bytes,
-                  declarative_group=False) -> _StageRuntime:
+                  declarative_group=False, tp=1, tp_rank=0,
+                  tp_group=None, tp_tail_group=None,
+                  tp_overlap=True) -> _StageRuntime:
     return _StageRuntime(
         [_as_stage_spec(b) for b in spec_blobs], stage, num_stages,
         virtual_stages, num_microbatches, optimizer, dp, dp_rank,
         group_name, fused_flush, flush_bucket_bytes,
-        declarative_group=declarative_group)
+        declarative_group=declarative_group, tp=tp, tp_rank=tp_rank,
+        tp_group=tp_group, tp_tail_group=tp_tail_group,
+        tp_overlap=tp_overlap)
 
 
 class _PipelineStageActorImpl:
@@ -955,12 +1244,15 @@ class _PipelineStageActorImpl:
 
     def __init__(self, spec_blobs, stage, num_stages, virtual_stages,
                  num_microbatches, optimizer, dp, dp_rank, group_name,
-                 fused_flush, flush_bucket_bytes, declarative_group=False):
+                 fused_flush, flush_bucket_bytes, declarative_group=False,
+                 tp=1, tp_rank=0, tp_group=None, tp_tail_group=None,
+                 tp_overlap=True):
         self._rt = _make_runtime(spec_blobs, stage, num_stages,
                                  virtual_stages, num_microbatches,
                                  optimizer, dp, dp_rank, group_name,
                                  fused_flush, flush_bucket_bytes,
-                                 declarative_group)
+                                 declarative_group, tp, tp_rank,
+                                 tp_group, tp_tail_group, tp_overlap)
 
     def ping(self):
         return "ok"
@@ -1047,12 +1339,28 @@ class PipelineTrainer:
     cross-leaf optimizers, which is also the measured unfused
     baseline). ``mode="tasks"`` runs the same chunk math as dynamic
     actor tasks through the object store (the microbenchmark baseline).
+
+    ``tensor_parallel=t`` (or ``RAY_TPU_PIPELINE_TP``) composes a THIRD
+    axis: every (dp-rank, stage) slot becomes t actors, each holding the
+    stage chunks' 1/t Megatron column/row shard (build the specs with
+    ``pipeline_stage_defs(cfg, S, tensor_parallel=t)``). Activations
+    and gradients still flow on per-rank act/grad slot rings; the
+    partial-sum reduces ride per-(stage, dp-rank) tp collective groups
+    (shm same-node / ring cross-node by the declarative rendezvous
+    rule); the dp flush reduces only each rank's 1/t shard, so dp
+    traffic drops by 1/t (weight-update sharding). Placement lands each
+    tp group on ONE node (soft node-affinity pseudo-pod) while pipeline
+    edges cross nodes. tp ranks execute a deterministic STATIC 1F1B
+    schedule — the in-jit reduce callbacks pair by execution order, so
+    the timing-dependent eager loops are structurally excluded.
     """
 
     def __init__(self, stages: Sequence[Any], *, num_microbatches: int,
                  dp: int = 1, mode: str = "channels",
                  optimizer: Any = ("sgd", 0.1),
                  virtual_stages: Optional[int] = None,
+                 tensor_parallel: Optional[int] = None,
+                 tp_overlap: bool = True,
                  fused_flush: bool = True,
                  flush_bucket_bytes: Optional[int] = None,
                  channel_depth: Optional[int] = None,
@@ -1072,6 +1380,40 @@ class PipelineTrainer:
         self._specs = [_as_stage_spec(s) for s in stages]
         core = api._require_core()
         self._core = core
+        # tensor parallel width: None takes the env knob; an explicit 0
+        # (argument or RAY_TPU_PIPELINE_TP=0) RAISES instead of silently
+        # meaning 1 (the falsy-zero lesson)
+        if tensor_parallel is None:
+            t = int(core.config.pipeline_tp)
+            t_source = "RAY_TPU_PIPELINE_TP"
+        else:
+            t = int(tensor_parallel)
+            t_source = "tensor_parallel"
+        if t < 1:
+            raise ValueError(
+                f"{t_source}={t} is invalid: tensor_parallel must be "
+                f">= 1 (1 = no tensor parallelism; 0 does not mean "
+                f"'default')")
+        self._tp = t
+        self._tp_overlap = bool(tp_overlap)
+        spec_tps = {sp.tp for sp in self._specs}
+        if spec_tps != {self._tp}:
+            raise ValueError(
+                f"tensor_parallel={self._tp} but the stage specs carry "
+                f"tp={sorted(spec_tps)} — build them with "
+                f"pipeline_stage_defs(cfg, S, tensor_parallel="
+                f"{self._tp}) so the shard layout matches the trainer "
+                f"grid")
+        if self._tp > 1 and mode != "channels":
+            raise ValueError(
+                "tensor_parallel > 1 needs mode='channels': the tasks "
+                "path runs one actor per (dp, stage) slot and cannot "
+                "pair the in-jit tp reduces")
+        if self._tp > 1 and elastic:
+            raise ValueError(
+                "tensor_parallel > 1 does not compose with elastic=True "
+                "yet: a lost tp rank's shard has no replica inside its "
+                "tp group to recover from")
         # interleaved virtual stages: None takes the env knob; an
         # explicit 0 (argument or RAY_TPU_PIPELINE_VIRTUAL_STAGES=0)
         # RAISES instead of silently meaning 1 (the falsy-zero lesson)
@@ -1133,7 +1475,7 @@ class PipelineTrainer:
         self._loop_refs: List[Any] = []
         self._actor_info: Dict[str, dict] = {}
         self._actor_subs: Dict[str, Any] = {}
-        self._slot_of_hex: Dict[str, Tuple[int, int]] = {}
+        self._slot_of_hex: Dict[str, Tuple[int, int, int]] = {}
 
         # ---- elastic membership (ISSUE 16)
         self._elastic = bool(elastic)
@@ -1159,20 +1501,63 @@ class PipelineTrainer:
         token = uuid.uuid4().hex[:8]
         self._token = token
         self._stage_opts = list(stage_options or [])
-        self._actors: List[List[Any]] = []
+
+        # axis-aware placement (tp > 1): each (dp-rank, stage) slot's tp
+        # group should land on ONE node — a pseudo-pod whose tp reduces
+        # rendezvous over shared memory — while pipeline edges cross
+        # nodes. Soft affinity: a full node falls back to the scheduler,
+        # and _build_channels verifies the outcome (ring transport keeps
+        # cross-node placement correct, just slower).
+        self._placement_plan: Optional[List[List[str]]] = None
+        if self._tp > 1 and mode == "channels":
+            try:
+                views = core._run(core.clients.get(
+                    core.controller_addr).call("node_views"))
+                self._placement_plan = _channels.plan_axis_placement(
+                    views, num_stages=self._S, dp=self._dp)
+            except Exception:
+                logger.debug("axis placement planning failed; leaving "
+                             "stage placement to the scheduler",
+                             exc_info=True)
+
+        # actor grid: dp x S x tp (tp axis is size 1 unless composed)
+        self._actors: List[List[List[Any]]] = []
         for r in range(self._dp):
             row = []
             for s in range(self._S):
-                row.append(self._spawn_stage_actor(r, s))
+                row.append([self._spawn_stage_actor(r, s, t)
+                            for t in range(self._tp)])
             self._actors.append(row)
         for r in range(self._dp):
             for s in range(self._S):
-                self._slot_of_hex[
-                    self._actors[r][s]._actor_id.hex()] = (r, s)
+                for t in range(self._tp):
+                    self._slot_of_hex[
+                        self._actors[r][s][t]._actor_id.hex()] = (r, s, t)
         import ray_tpu
 
-        ray_tpu.get([a.ping.remote() for row in self._actors for a in row],
-                    timeout=120)
+        ray_tpu.get([a.ping.remote()
+                     for row in self._actors
+                     for cell in row for a in cell], timeout=120)
+
+        if self._tp > 1:
+            # declare the per-(stage, dp-rank) tp groups (plus the
+            # ".tail" twin for the async last-block partial sums):
+            # members rendezvous lazily on their first reduce, so the
+            # control RPCs land in flush 0 and steady flushes stay
+            # RPC-free
+            from ray_tpu.util import collective as col
+
+            ranks = list(range(self._tp))
+            for r in range(self._dp):
+                for s in range(self._S):
+                    gname = self._tp_group_name(r, s)
+                    col.create_collective_group(
+                        self._actors[r][s], world_size=self._tp,
+                        ranks=ranks, backend="host", group_name=gname)
+                    col.create_collective_group(
+                        self._actors[r][s], world_size=self._tp,
+                        ranks=ranks, backend="host",
+                        group_name=gname + ".tail")
 
         if self._elastic:
             # driver-declared (resizable) dp group per stage: members
@@ -1180,9 +1565,11 @@ class PipelineTrainer:
             # re-declares at the next one
             from ray_tpu.util.collective.resizable import ResizableGroup
 
+            # elastic excludes tp > 1 (validated above), so the tp axis
+            # is always the singleton rank 0 here
             self._groups = [
                 ResizableGroup(
-                    [self._actors[r][s] for r in range(self._dp)],
+                    [self._actors[r][s][0] for r in range(self._dp)],
                     group_name=f"{name}.{token}.stage{s}", backend="host")
                 for s in range(self._S)]
 
@@ -1215,21 +1602,50 @@ class PipelineTrainer:
     def virtual_stages(self) -> int:
         return self._V
 
+    @property
+    def tensor_parallel(self) -> int:
+        return self._tp
+
     # -- build
 
-    def _spawn_stage_actor(self, r: int, s: int):
-        """Create the (r, s) stage actor — the build path and the
+    def _dp_group_name(self, s: int, t: int) -> str:
+        """Per-stage dp flush group. At tp > 1 each tp rank's dp group
+        is DISJOINT — rank t's flush reduces only its own 1/tp shard
+        (weight-update sharding: dp traffic drops by 1/tp). tp == 1
+        keeps the historical name byte-for-byte."""
+        base = f"{self._name}.{self._token}.stage{s}"
+        return base if self._tp == 1 else f"{base}.tp{t}"
+
+    def _tp_group_name(self, r: int, s: int) -> str:
+        """Per-(stage, dp-rank) tp reduce group (".tail" twin rides the
+        async last-block partial sums)."""
+        return f"{self._name}.{self._token}.stage{s}.dp{r}.tp"
+
+    def _spawn_stage_actor(self, r: int, s: int, t: int = 0):
+        """Create the (r, s, t) stage actor — the build path and the
         elastic respawn path run the exact same spawn."""
         cls = _stage_actor()
         opts = self._stage_opts
-        acls = cls.options(**opts[s]) if s < len(opts) and opts[s] \
-            else cls
+        if s < len(opts) and opts[s]:
+            acls = cls.options(**opts[s])
+        elif self._placement_plan is not None:
+            from ray_tpu.util.scheduling_strategies import (
+                NodeAffinitySchedulingStrategy)
+
+            acls = cls.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id_hex=self._placement_plan[r][s], soft=True))
+        else:
+            acls = cls
         chunk_specs = [self._specs[s + u * self._S]
                        for u in range(self._V)]
+        tp_group = self._tp_group_name(r, s) if self._tp > 1 else None
         return acls.remote(
             chunk_specs, s, self._S, self._V, self._M, self._optimizer,
-            self._dp, r, f"{self._name}.{self._token}.stage{s}",
-            self._fused, self._flush_bucket_bytes, self._elastic)
+            self._dp, r, self._dp_group_name(s, t),
+            self._fused, self._flush_bucket_bytes, self._elastic,
+            self._tp, t, tp_group,
+            tp_group + ".tail" if tp_group else None, self._tp_overlap)
 
     def _create_channel(self, node_addr, n_readers, participants, *,
                         depth: Optional[int] = None,
@@ -1253,72 +1669,95 @@ class PipelineTrainer:
                 "pipeline channels need a driver attached to a node arena")
 
         # resolve every stage actor's placement (one cluster-view
-        # snapshot for the whole dp x S pass; actors don't migrate
-        # between the per-actor ALIVE waits and channel creation)
+        # snapshot for the whole dp x S x tp pass; actors don't migrate
+        # between the per-actor ALIVE waits and channel creation) — and
+        # verify the axis plan's soft affinity landed when one exists
+        # (a miss only downgrades the tp reduces to the cross-node ring)
         views = core._run(core.clients.get(core.controller_addr).call(
             "node_views"))
         for row in self._actors:
-            for a in row:
-                hexid = a._actor_id.hex()
-                self._actor_info[hexid] = \
-                    _channels.resolve_actor_placement(
-                        core, a._actor_id, views)
+            for cell in row:
+                for a in cell:
+                    hexid = a._actor_id.hex()
+                    expect = None
+                    if self._placement_plan is not None:
+                        (r, s, _t) = self._slot_of_hex[hexid]
+                        expect = self._placement_plan[r][s]
+                    self._actor_info[hexid] = \
+                        _channels.resolve_actor_placement(
+                            core, a._actor_id, views,
+                            expect_node_id_hex=expect)
 
         # ANY participant's death closes every channel of the trainer:
-        # stages are serially dependent and dp replicas meet at the
-        # flush allreduce, so no subset can make progress alone
+        # stages are serially dependent, dp replicas meet at the flush
+        # allreduce, and tp ranks meet at every in-jit reduce, so no
+        # subset can make progress alone
         participants = {core._store_client_id}
         for info in self._actor_info.values():
             participants.add(info["worker_id_hex"])
             participants.add(f"node:{info['node_id_hex']}")
 
-        def node_of(r, s):
+        def node_of(r, s, t):
             return self._actor_info[
-                self._actors[r][s]._actor_id.hex()]["node_addr"]
+                self._actors[r][s][t]._actor_id.hex()]["node_addr"]
 
-        S, V = self._S, self._V
+        S, V, TP = self._S, self._V, self._tp
         C = S * V  # total pipeline chunks
         self._in_specs, self._label_specs = [], []
         self._report_readers: List[List[_channels.LocalChannel]] = []
-        plans: List[List[_StagePlan]] = []
+        plans: List[List[_StagePlan]] = []  # flat (r * TP + t) -> [s]
         for r in range(self._dp):
-            in_spec = self._create_channel(node_of(r, 0), 1, participants)
-            label_spec = self._create_channel(
-                node_of(r, S - 1), 1, participants)
-            # per-chunk edges between the SAME S actors: chunk c runs on
-            # actor c % S, so edge c -> c+1 lands on actor (c+1) % S's
-            # node (channels live on the READER's node). V=1 reduces to
-            # the PR-8 neighbor-chain plan exactly
-            act = [self._create_channel(
-                node_of(r, (c + 1) % S), 1, participants)
-                for c in range(C - 1)]
-            grad = [self._create_channel(node_of(r, c % S), 1, participants)
+            for t in range(TP):
+                # each tp rank runs its own full act/grad ring chain —
+                # activations are replicated across tp peers (identical
+                # math on 1/tp param shards), so rank t's chunk c feeds
+                # rank t's chunk c+1 with no cross-rank channel hop
+                in_spec = self._create_channel(
+                    node_of(r, 0, t), 1, participants)
+                label_spec = self._create_channel(
+                    node_of(r, S - 1, t), 1, participants)
+                # per-chunk edges between the SAME S actors: chunk c
+                # runs on actor c % S, so edge c -> c+1 lands on actor
+                # (c+1) % S's node (channels live on the READER's
+                # node). V=1, tp=1 reduces to the PR-8 neighbor-chain
+                # plan exactly
+                act = [self._create_channel(
+                    node_of(r, (c + 1) % S, t), 1, participants)
                     for c in range(C - 1)]
-            # reports carry one small stats dict per flush, and the
-            # driver acks flush t before scattering t+1 — depth 1 and a
-            # small buffer, not S+1 slots of activation-sized pinned
-            # arena each
-            reports = [self._create_channel(driver_node, 1, participants,
-                                            depth=1, buffer=64 * 1024)
-                       for _ in range(S)]
-            self._in_specs.append(in_spec)
-            self._label_specs.append(label_spec)
-            self._report_readers.append(
-                [self._local_channels[sp.key()] for sp in reports])
+                grad = [self._create_channel(
+                    node_of(r, c % S, t), 1, participants)
+                    for c in range(C - 1)]
+                # reports carry one small stats dict per flush, and the
+                # driver acks flush f before scattering f+1 — depth 1
+                # and a small buffer, not S+1 slots of activation-sized
+                # pinned arena each
+                reports = [self._create_channel(
+                    driver_node, 1, participants, depth=1,
+                    buffer=64 * 1024) for _ in range(S)]
+                self._in_specs.append(in_spec)
+                self._label_specs.append(label_spec)
+                self._report_readers.append(
+                    [self._local_channels[sp.key()] for sp in reports])
 
-            def stage_plan(s: int) -> _StagePlan:
-                cs = [s + u * S for u in range(V)]  # this stage's chunks
-                return _StagePlan(
-                    in_spec=in_spec if s == 0 else None,
-                    label_spec=label_spec if s == S - 1 else None,
-                    act_in=[act[c - 1] if c > 0 else None for c in cs],
-                    act_out=[act[c] if c < C - 1 else None for c in cs],
-                    grad_in=[grad[c] if c < C - 1 else None for c in cs],
-                    grad_out=[grad[c - 1] if c > 0 else None for c in cs],
-                    report=reports[s],
-                )
+                def stage_plan(s: int, in_spec=in_spec,
+                               label_spec=label_spec, act=act,
+                               grad=grad, reports=reports) -> _StagePlan:
+                    cs = [s + u * S for u in range(V)]
+                    return _StagePlan(
+                        in_spec=in_spec if s == 0 else None,
+                        label_spec=label_spec if s == S - 1 else None,
+                        act_in=[act[c - 1] if c > 0 else None
+                                for c in cs],
+                        act_out=[act[c] if c < C - 1 else None
+                                 for c in cs],
+                        grad_in=[grad[c] if c < C - 1 else None
+                                 for c in cs],
+                        grad_out=[grad[c - 1] if c > 0 else None
+                                  for c in cs],
+                        report=reports[s],
+                    )
 
-            plans.append([stage_plan(s) for s in range(S)])
+                plans.append([stage_plan(s) for s in range(S)])
 
         # driver-side input writers (local write or mirror push)
         def driver_writer(spec):
@@ -1340,9 +1779,11 @@ class PipelineTrainer:
 
         # start the run loops (they dedicate the actors until teardown)
         for r in range(self._dp):
-            for s in range(self._S):
-                self._loop_refs.append(
-                    self._actors[r][s].run_loop.remote(plans[r][s]))
+            for t in range(self._tp):
+                for s in range(self._S):
+                    self._loop_refs.append(
+                        self._actors[r][s][t].run_loop.remote(
+                            plans[r * self._tp + t][s]))
 
     # -- failure fan-out (same shape as dag._ChannelGraph)
 
@@ -1447,33 +1888,34 @@ class PipelineTrainer:
         self._loop_refs = []
         self._actor_info = {}
 
-        # 2. respawn the dead slots (budget + backoff per slot)
-        for (r, s) in dead_slots:
-            old_hex = self._actors[r][s]._actor_id.hex()
+        # 2. respawn the dead slots (budget + backoff per slot) —
+        # elastic excludes tp > 1, so the tp axis is always rank 0
+        for (r, s, _t) in dead_slots:
+            old_hex = self._actors[r][s][0]._actor_id.hex()
             self._slot_of_hex.pop(old_hex, None)
             a = self._sup.respawn(
                 ("dp", r, "stage", s),
                 lambda r=r, s=s: self._spawn_stage_actor(r, s))
-            self._actors[r][s] = a
-            self._slot_of_hex[a._actor_id.hex()] = (r, s)
+            self._actors[r][s][0] = a
+            self._slot_of_hex[a._actor_id.hex()] = (r, s, 0)
         if dead_slots:
-            ray_tpu.get([self._actors[r][s].ping.remote()
-                         for (r, s) in dead_slots], timeout=120)
+            ray_tpu.get([self._actors[r][s][0].ping.remote()
+                         for (r, s, _t) in dead_slots], timeout=120)
 
         # 3. reshard: re-declare each affected stage's dp group at the
         # next generation, then deliver params/opt state to the joiner
         # from the lowest-rank survivor (leaf-wise broadcast — no
         # checkpoint restore anywhere on this path)
         t_ms = self._sup.resize_timeout_ms
-        for s in sorted({s for (_, s) in dead_slots}):
-            dead_rs = {r for (r, ss) in dead_slots if ss == s}
+        for s in sorted({s for (_, s, _t) in dead_slots}):
+            dead_rs = {r for (r, ss, _t) in dead_slots if ss == s}
             live = [r for r in range(self._dp) if r not in dead_rs]
             if not live:
                 raise RuntimeError(
                     f"pipeline {self._name}: every dp replica of stage "
                     f"{s} died — parameters are unrecoverable without a "
                     f"checkpoint; treating the outage as terminal")
-            row = [self._actors[r][s] for r in range(self._dp)]
+            row = [self._actors[r][s][0] for r in range(self._dp)]
             self._groups[s].resize(row)
             ray_tpu.get([row[r].elastic_reset_group.remote(self._dp, r)
                          for r in range(self._dp)], timeout=120)
@@ -1542,13 +1984,18 @@ class PipelineTrainer:
                 for m, mb in enumerate(mbs[r]):
                     payload = serialization.pack(np.ascontiguousarray(mb))
                     v = vbase + 2 * m
-                    for kind, w in (self._in_writers[r],
-                                    self._label_writers[r]):
-                        if kind == "local":
-                            w.write(payload, v)
-                        else:
-                            w.push(payload, v)
-                        wrote = True
+                    # every tp rank of the replica gets the SAME
+                    # microbatch: activations are replicated across the
+                    # tp axis, only params are sharded
+                    for t in range(self._tp):
+                        idx = r * self._tp + t
+                        for kind, w in (self._in_writers[idx],
+                                        self._label_writers[idx]):
+                            if kind == "local":
+                                w.write(payload, v)
+                            else:
+                                w.push(payload, v)
+                            wrote = True
         except ChannelClosedError as e:
             self._surface_failure(e)
         except BaseException:
@@ -1563,13 +2010,13 @@ class PipelineTrainer:
         rv = 2 * (self._vflush + 1)
         reports: List[dict] = []
         try:
-            for r in range(self._dp):
-                for ch in self._report_readers[r]:
+            for idx, readers in enumerate(self._report_readers):
+                for ch in readers:
                     view = ch.read(rv)
                     rep = serialization.unpack(bytes(view))
                     del view
                     ch.ack(0, rv)
-                    rep["dp_rank"] = r
+                    rep["dp_rank"] = idx // self._tp
                     reports.append(rep)
         except ChannelClosedError as e:
             self._surface_failure(e)
@@ -1590,7 +2037,9 @@ class PipelineTrainer:
         C = S * V
         barriers = []
         for r in range(self._dp):
-            row = self._actors[r]
+            # tasks mode excludes tp > 1 (validated in __init__): the
+            # tp axis is the singleton rank 0
+            row = [cell[0] for cell in self._actors[r]]
             for m, mb in enumerate(mbs[r]):
                 # chunk c runs on actor c % S as local chunk c // S —
                 # the same interleaved layout the channel loops execute
@@ -1604,7 +2053,8 @@ class PipelineTrainer:
                 barriers.append(gref)
         ray_tpu.get(barriers, timeout=600)
         stats = ray_tpu.get(
-            [a.naive_flush.remote() for row in self._actors for a in row],
+            [cell[0].naive_flush.remote()
+             for row in self._actors for cell in row],
             timeout=600)
         self._flush += 1
         last = stats[self._S - 1::self._S]
@@ -1615,16 +2065,19 @@ class PipelineTrainer:
     # -- introspection / teardown
 
     def fetch_params(self, stage: int, dp_rank: int = 0,
-                     chunk: Optional[int] = None):
+                     chunk: Optional[int] = None, tp_rank: int = 0):
         """Stage shard params (tasks mode anytime; channels mode after
         shutdown — the run loop dedicates the actor). At
         virtual_stages=1 returns the stage's single chunk tree; at V > 1
         a list of the stage's V chunk trees (or one tree with
-        ``chunk=`` the local index)."""
+        ``chunk=`` the local index). At tensor_parallel > 1 the result
+        is ``tp_rank``'s 1/tp shard — reassemble the fused tree with
+        ``presets.reassemble_pipeline_params``."""
         import ray_tpu
 
         return ray_tpu.get(
-            self._actors[dp_rank][stage].fetch_params.remote(chunk),
+            self._actors[dp_rank][stage][tp_rank]
+                .fetch_params.remote(chunk),
             timeout=120)
 
     def shutdown(self, kill_actors: bool = True,
@@ -1666,11 +2119,12 @@ class PipelineTrainer:
             import ray_tpu
 
             for row in self._actors:
-                for a in row:
-                    try:
-                        ray_tpu.kill(a)
-                    except Exception:
-                        pass
+                for cell in row:
+                    for a in cell:
+                        try:
+                            ray_tpu.kill(a)
+                        except Exception:
+                            pass
         return stats
 
     def __del__(self):

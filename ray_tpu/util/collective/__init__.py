@@ -18,6 +18,11 @@ from ray_tpu.util.collective.collective import (  # noqa: F401
     send,
     synchronize,
 )
+from ray_tpu.util.collective.tp import (  # noqa: F401
+    TpOps,
+    make_tp_reduce_ops,
+    psum_tp_ops,
+)
 from ray_tpu.util.collective.resizable import (  # noqa: F401
     ResizableGroup,
     refresh_membership,

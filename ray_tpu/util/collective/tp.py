@@ -1,0 +1,92 @@
+"""Tensor-parallel reduce operators for sharded jitted programs.
+
+Megatron-style tensor parallelism (arXiv:1909.09756) threads two
+conjugate operators through each sharded block:
+
+  ``g`` — partial-sum allreduce in the FORWARD pass, identity in the
+  backward. Placed on every row-parallel output (attention proj,
+  ffn-down) so each rank's partial sum over its local heads / ffn
+  columns becomes the full activation.
+
+  ``f`` — identity in the forward, partial-sum allreduce in the
+  BACKWARD. Placed on every column-parallel INPUT (the norm outputs
+  feeding QKV / ffn-up) so the cotangent flowing back onto the
+  replicated residual stream / norm params is the full cross-rank sum.
+
+With this placement replicated params (norms, biases added after ``g``,
+embeddings, lm_head) receive exact replicated gradients with no extra
+flush-time sync, and sharded params receive exactly their local shard's
+gradient.
+
+Two constructions are provided:
+
+``make_tp_reduce_ops(reduce_cb)`` builds the pair over a HOST reducer
+(typically ``collective.allreduce`` on a per-(stage, dp-rank) tp group)
+via ``jax.pure_callback`` + ``jax.custom_vjp`` — the cross-process form
+the pipeline trainer uses. Every rank of a tp group must execute the
+same deterministic sequence of ``g``/``f`` applications (the callbacks
+carry no op tags — order IS the match), which is why the trainer runs a
+static schedule when tp > 1. NOTE this jaxlib's CPU callback executor is
+single-threaded and deadlocks above a few-hundred-KB payload per
+callback (see microbenchmark._probe_sleep_op) — per-reduce activations
+must stay modest on the CPU rig.
+
+``psum_tp_ops(axis_name)`` builds the pair for a SINGLE-TRACE emulation
+under ``jax.vmap(..., axis_name=...)`` over a stacked rank axis:
+``g = lax.psum``, ``f = identity``. Pass replicated leaves unbatched
+(``in_axes=None``) and vmap's broadcast-transpose supplies ``f``'s
+backward sum automatically — the clusterless parity oracle the tests
+compare against.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import numpy as np
+
+
+class TpOps(NamedTuple):
+    """The conjugate (g, f) pair; both are jax-traceable unary fns."""
+
+    g: Callable  # reduce fwd / identity bwd (row-parallel outputs)
+    f: Callable  # identity fwd / reduce bwd (column-parallel inputs)
+
+
+def make_tp_reduce_ops(reduce_cb: Callable[[np.ndarray], np.ndarray]) -> TpOps:
+    """(g, f) over a host partial-sum reducer, usable inside jit.
+
+    ``reduce_cb(arr) -> arr`` must be the tp-group allreduce (SUM); it is
+    invoked from jax's host-callback executor thread, once per ``g``
+    forward / ``f`` backward application, in program order.
+    """
+    import jax
+
+    def _reduce(x):
+        def _host(a):
+            a = np.asarray(a)
+            return np.asarray(reduce_cb(a), dtype=a.dtype).reshape(a.shape)
+
+        return jax.pure_callback(
+            _host, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+
+    @jax.custom_vjp
+    def g(x):
+        return _reduce(x)
+
+    g.defvjp(lambda x: (_reduce(x), None), lambda _, ct: (ct,))
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    f.defvjp(lambda x: (x, None), lambda _, ct: (_reduce(ct),))
+
+    return TpOps(g=g, f=f)
+
+
+def psum_tp_ops(axis_name: str = "tp") -> TpOps:
+    """(g, f) for single-trace emulation under vmap over the rank axis."""
+    import jax
+
+    return TpOps(g=lambda x: jax.lax.psum(x, axis_name), f=lambda x: x)

@@ -273,7 +273,7 @@ class TestElasticWorkloads:
         try:
             got.append(trainer.step(both)["loss"])
             got.append(trainer.step(both)["loss"])
-            ray_tpu.kill(trainer._actors[1][0])  # dp row 1, stage 0
+            ray_tpu.kill(trainer._actors[1][0][0])  # dp row 1, stage 0
             deadline = time.monotonic() + 30
             while not trainer._heal_pending \
                     and time.monotonic() < deadline:

@@ -228,7 +228,7 @@ class TestPipelineContracts:
             num_microbatches=2, optimizer=("sgd", 0.05))
         batch = _batch()
         trainer.step(batch)
-        ray_tpu.kill(trainer._actors[0][1])
+        ray_tpu.kill(trainer._actors[0][1][0])
         with pytest.raises((ChannelClosedError, ActorDiedError)):
             deadline = time.monotonic() + 30
             while time.monotonic() < deadline:
@@ -403,7 +403,7 @@ class TestInterleavedVirtualStages:
             defs, num_microbatches=2, virtual_stages=2,
             optimizer=("sgd", 0.05))
         trainer.step(batch)
-        ray_tpu.kill(trainer._actors[0][1])
+        ray_tpu.kill(trainer._actors[0][1][0])
         with pytest.raises((ChannelClosedError, ActorDiedError)):
             deadline = time.monotonic() + 30
             while time.monotonic() < deadline:
