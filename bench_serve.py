@@ -342,6 +342,214 @@ def run_loadgen(mode: str, preset: str, rate_rps: float, n: int, seed: int,
         server.shutdown()
 
 
+# ----------------------------------------------------------------- fleet
+
+
+def _fleet_arm(affinity: bool, load, *, replicas: int, slots: int,
+               prefill_chunk: int, new_cap: int, max_seq_len: int,
+               kv_pages: int, spec_k: int, skew: int, log) -> dict:
+    """One fleet measurement: `replicas` copies of the LLM app through
+    the REAL control plane (controller + router + replica actors), the
+    Zipf shared-prefix load replayed open-loop from COLD caches. With
+    ``affinity`` the router steers on prefix digests (fleet hits land on
+    the holder; skew/fail fallbacks pull pages cross-replica); without it
+    the same router runs affinity-blind pow-2 — the ISSUE-18 baseline."""
+    import threading
+
+    import ray_tpu
+    import ray_tpu.serve as serve
+    from ray_tpu._private import config as _conf_mod
+    from ray_tpu.serve.llm import build_app
+
+    os.environ["RAY_TPU_SERVE_AFFINITY"] = "1" if affinity else "0"
+    # a tight skew bound matters under a Zipf head: overflow traffic must
+    # fall back (and MIGRATE the prefix) instead of queueing on the
+    # holder — that keeps p99 TTFT flat while the hit rate stays fleet-
+    # wide (a migrated splice is still a prefix hit on the puller)
+    os.environ["RAY_TPU_SERVE_AFFINITY_SKEW"] = str(skew)
+    # the router reads the knobs at construction — refresh the cached
+    # config so each arm's router sees its own settings
+    _conf_mod._global_config = None
+    name = "fleetaff" if affinity else "fleetblind"
+    h = serve.run(build_app(num_replicas=replicas, max_new_tokens=new_cap,
+                            slots=slots, prefill_chunk=prefill_chunk,
+                            preset_overrides={"max_seq_len": max_seq_len},
+                            kv_pages=kv_pages, drafter="self",
+                            spec_k=spec_k),
+                  name=name, route_prefix=f"/{name}")
+    try:
+        # compile every replica's programs off-meter (prefill + verify +
+        # drafter); a lazily-compiling replica would pollute p99 TTFT
+        # with multi-second compiles, asymmetrically between the arms
+        h.remote({"prompt": "warmup"}).result(timeout=600)
+        router = h._get_router()
+        for rep in list(router._replicas):
+            ray_tpu.get(rep.handle_request.remote(
+                "__call__", ({"prompt": "warmup"},), {}), timeout=600)
+        time.sleep(1.0)  # let the warmup digests propagate fleet-wide
+
+        def rep_stats():
+            out = []
+            for rep in list(router._replicas):
+                out.append(ray_tpu.get(rep.handle_request.remote(
+                    "scheduler_stats", (), {}), timeout=60))
+            return out
+
+        st0 = rep_stats()
+        sh = h.options(stream=True)
+        lock = threading.Lock()
+        results = []
+        errors = [0]
+        t_start = time.perf_counter()
+
+        def one(at, prompt, budget):
+            delay = t_start + at - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            t0 = time.perf_counter()
+            times = []
+            try:
+                for _chunk in sh.remote({"prompt": prompt, "stream": True,
+                                         "max_new_tokens": budget}):
+                    times.append(time.perf_counter())
+            except Exception:
+                with lock:
+                    errors[0] += 1
+                return
+            with lock:
+                results.append((t0, times))
+
+        threads = [threading.Thread(target=one, args=req) for req in load]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        st1 = rep_stats()
+
+        def agg(key):
+            return sum(b.get(key, 0) - a.get(key, 0)
+                       for a, b in zip(st0, st1))
+
+        hits, misses = agg("prefix_hits"), agg("prefix_misses")
+        drafted, accepted = agg("spec_drafted_tokens"), agg(
+            "spec_accepted_tokens")
+        rounds = agg("spec_rounds")
+        emitted = sum(len(times) for _t0, times in results)
+        ttfts = [times[0] - t0 for t0, times in results if times]
+        wall = (max(t for _t0, ts in results for t in ts)
+                - min(t0 for t0, _ts in results))
+        out = {
+            "affinity": affinity,
+            "replicas": replicas,
+            "requests_ok": len(results),
+            "errors": errors[0],
+            "wall_s": round(wall, 3),
+            "tokens": emitted,
+            "tokens_per_sec": round(emitted / wall, 1),
+            "ttft_ms": _percentiles(ttfts),
+            "fleet_prefix_hits": hits,
+            "fleet_prefix_misses": misses,
+            "fleet_hit_rate": round(hits / max(hits + misses, 1), 4),
+            "migrations": agg("migrations"),
+            "migrated_pages": agg("migrated_pages"),
+            "migration_failures": agg("migration_failures"),
+            "spec_drafted_tokens": drafted,
+            "spec_accepted_tokens": accepted,
+            "spec_decode_accept_rate": round(
+                accepted / drafted, 4) if drafted else 0.0,
+            "spec_tokens_per_step": round(
+                sum(b.get("spec_tokens_per_step", 0.0) for b in st1)
+                / max(sum(1 for b in st1
+                          if b.get("spec_rounds", 0) > 0), 1), 3),
+            "spec_rounds": rounds,
+        }
+        log(f"{name}: hit_rate={out['fleet_hit_rate']} "
+            f"p99_ttft={out['ttft_ms']['p99']}ms "
+            f"migrations={out['migrations']} "
+            f"accept={out['spec_decode_accept_rate']}")
+        return out
+    finally:
+        serve.shutdown()
+
+
+def fleet_records(args, prov, log) -> list:
+    """The ISSUE-18 fleet record pair: affinity-steered vs affinity-blind
+    pow-2 over the same Zipf shared-prefix schedule, 4 replicas each."""
+    import ray_tpu
+
+    n = args.fleet_requests
+    prefix_len = 192  # + tail + budget + spec reserve fits max_seq_len 256
+    load = _make_prefix_load(args.seed, n, args.fleet_rate,
+                             args.new_tokens_cap, prefix_len=prefix_len,
+                             n_prefixes=args.fleet_prefixes,
+                             max_seq_len=args.max_seq_len)
+    from ray_tpu._private.config import global_config
+
+    pt = global_config().serve_page_tokens
+    pool = (args.slots * (args.max_seq_len // pt)
+            + 8 * (prefix_len // pt) + 1)
+    common = dict(replicas=args.fleet_replicas, slots=args.slots,
+                  prefill_chunk=args.prefill_chunk,
+                  new_cap=args.new_tokens_cap,
+                  max_seq_len=args.max_seq_len, kv_pages=pool,
+                  spec_k=args.spec_k, skew=args.fleet_skew, log=log)
+    ray_tpu.init(num_cpus=max(8, 2 * args.fleet_replicas),
+                 object_store_memory=512 * 1024 * 1024)
+    try:
+        log("fleet arm: affinity steering + migration + spec decode ...")
+        aff = _fleet_arm(True, load, **common)
+        log("fleet arm: affinity-blind pow-2 baseline ...")
+        blind = _fleet_arm(False, load, **common)
+    finally:
+        ray_tpu.shutdown()
+        os.environ.pop("RAY_TPU_SERVE_AFFINITY", None)
+        os.environ.pop("RAY_TPU_SERVE_AFFINITY_SKEW", None)
+        from ray_tpu._private import config as _conf_mod
+
+        _conf_mod._global_config = None
+
+    # the ISSUE-18 acceptance floor: steering must make prefix reuse a
+    # FLEET property, not a per-replica accident
+    assert aff["fleet_hit_rate"] >= 0.9, aff
+    assert aff["errors"] == 0 and blind["errors"] == 0, (aff, blind)
+    assert aff["spec_decode_accept_rate"] > 0, aff
+    assert aff["spec_tokens_per_step"] > 1.0, aff
+    detail = {"requests": n, "seed": args.seed,
+              "rate_rps": args.fleet_rate, "slots": args.slots,
+              "preset": args.preset, "prefix_len": prefix_len,
+              "max_seq_len": args.max_seq_len, "spec_k": args.spec_k,
+              "drafter": "self", "arrivals": "poisson",
+              "workload": "prefix",
+              "prefix_dist": (f"zipf(s=1.1) over {args.fleet_prefixes} x "
+                              f"{prefix_len}-token preambles, "
+                              f"4-10-token tails"),
+              "measured_from": "cold caches (no warm replay): the ramp "
+                               "IS the mechanism under test"}
+    return [
+        {"metric": "serve_fleet_affinity_hit_rate",
+         "value": aff["fleet_hit_rate"], "unit": "fraction",
+         "detail": {**aff, **detail, **prov}},
+        {"metric": "serve_fleet_blind_hit_rate",
+         "value": blind["fleet_hit_rate"], "unit": "fraction",
+         "detail": {**blind, **detail, **prov}},
+        {"metric": "serve_fleet_affinity_p99_ttft_ms",
+         "value": aff["ttft_ms"]["p99"], "unit": "ms",
+         "detail": {"vs_blind_p99_ttft_ms": blind["ttft_ms"]["p99"],
+                    "vs_blind_p50_ttft_ms": blind["ttft_ms"]["p50"],
+                    "affinity_p50_ttft_ms": aff["ttft_ms"]["p50"],
+                    "migrations": aff["migrations"],
+                    "migrated_pages": aff["migrated_pages"],
+                    **detail, **prov}},
+        {"metric": "serve_fleet_spec_decode_accept_rate",
+         "value": aff["spec_decode_accept_rate"], "unit": "fraction",
+         "detail": {"spec_tokens_per_step": aff["spec_tokens_per_step"],
+                    "spec_drafted_tokens": aff["spec_drafted_tokens"],
+                    "spec_accepted_tokens": aff["spec_accepted_tokens"],
+                    "spec_rounds": aff["spec_rounds"],
+                    **detail, **prov}},
+    ]
+
+
 def loadgen_main(args) -> None:
     log = lambda m: print(f"bench_serve: {m}", file=sys.stderr)  # noqa: E731
     prov = _probe_provenance(log)
@@ -455,19 +663,25 @@ def loadgen_main(args) -> None:
                     "baseline_p50_ttft_ms": base["ttft_ms"]["p50"],
                     **mix_detail, **prov}},
     ]
+    if args.fleet:
+        records += fleet_records(args, prov, log)
     for rec in records:
         print(json.dumps(rec))
     if args.json_out:
-        doc = {
-            "suite": "serve_llm_continuous_batching",
-            "captured": time.strftime("%Y-%m-%d %H:%M:%S"),
-            "host": __import__("platform").platform(),
-            "provenance": prov,
-            "records": records,
-        }
-        with open(args.json_out, "w") as f:
-            json.dump(doc, f, indent=2)
-            f.write("\n")
+        _write_doc(records, prov, args.json_out)
+
+
+def _write_doc(records, prov, path) -> None:
+    doc = {
+        "suite": "serve_llm_continuous_batching",
+        "captured": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "host": __import__("platform").platform(),
+        "provenance": prov,
+        "records": records,
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
 
 
 def main(argv=None) -> None:
@@ -481,6 +695,28 @@ def main(argv=None) -> None:
     ap.add_argument("--loadgen", action="store_true",
                     help="open-loop load generator: continuous vs "
                          "request-level batching at the same offered load")
+    ap.add_argument("--fleet", action="store_true",
+                    help="ISSUE-18 fleet arms: 4 replicas through the real "
+                         "control plane, prefix-affinity steering + page "
+                         "migration + speculative decoding vs affinity-"
+                         "blind pow-2, same Zipf shared-prefix schedule")
+    ap.add_argument("--fleet-replicas", type=int, default=4)
+    ap.add_argument("--fleet-rate", type=float, default=8.0,
+                    help="fleet-arm Poisson arrival rate (req/s); fast "
+                         "enough that the blind arm's cold prefills queue "
+                         "(the contrast under test) while digest "
+                         "propagation (0.5s reconcile) still keeps up")
+    ap.add_argument("--fleet-requests", type=int, default=320)
+    ap.add_argument("--fleet-prefixes", type=int, default=8,
+                    help="distinct Zipf preambles in the fleet schedule; "
+                         "affinity pins each to one holder, pow-2 "
+                         "scatters them across the fleet")
+    ap.add_argument("--fleet-skew", type=int, default=4,
+                    help="affinity load-skew bound for the fleet arms "
+                         "(holder inflight may exceed the min by this "
+                         "much before steering falls back + migrates)")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="draft tokens per speculative round (fleet arms)")
     ap.add_argument("--rate", type=float, default=75.0,
                     help="mixed-workload Poisson arrival rate (req/s); the "
                          "default saturates the request-level baseline "
@@ -510,9 +746,20 @@ def main(argv=None) -> None:
     if args.requests is None:
         args.requests = 150 if args.loadgen else 64
 
-    if args.loadgen:
+    if args.loadgen or args.fleet:
         if args.preset == "gpt2_small":
             args.preset = "llama_debug"  # loadgen default: runnable anywhere
+        if not args.loadgen:
+            # fleet-only invocation: skip the single-replica loadgen arms
+            log = lambda m: print(  # noqa: E731
+                f"bench_serve: {m}", file=sys.stderr)
+            prov = _probe_provenance(log)
+            records = fleet_records(args, prov, log)
+            for rec in records:
+                print(json.dumps(rec))
+            if args.json_out:
+                _write_doc(records, prov, args.json_out)
+            return
         loadgen_main(args)
         return
 

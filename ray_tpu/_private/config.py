@@ -147,6 +147,32 @@ class Config:
     # shares a cached prefix by page-table splice + cursor jump instead of
     # re-prefilling. Requires the paged layout
     serve_prefix_cache: bool = True
+    # ---- serve: fleet phase 2 (ISSUE 18) ----
+    # prefix-affinity routing: replicas advertise a digest of their radix
+    # cache's page-boundary prefix hashes through the controller's stats
+    # poll; the router steers a prompt to the replica holding the deepest
+    # match, falling back to pow-2 choice when load skew exceeds the bound
+    # below (affinity must never become a hotspot machine)
+    serve_affinity: bool = True
+    # affinity load-skew fallback bound: the steered replica may carry at
+    # most this many MORE inflight requests than the least-loaded replica
+    # before the router abandons affinity for pow-2 choice on this pick
+    serve_affinity_skew: int = 4
+    # cross-replica page migration budget: max pages one fleet-hit pull
+    # may copy from the holder replica. Explicit 0 (env or argument)
+    # RAISES at build — it never silently means "migration off" (the
+    # falsy-zero lesson); pass serve_affinity=False / no hint for that
+    serve_migration_budget: int = 64
+    # speculative decoding draft depth: tokens the drafter proposes per
+    # verify call. Only consulted when serve_drafter is set. Explicit 0
+    # RAISES at build (falsy-zero lesson); k=1 is the plain-decode
+    # degenerate case (bit-identical, one bonus token per step)
+    serve_spec_k: int = 4
+    # drafter model preset for speculative decoding ("" = speculation
+    # off). The drafter shares the weights arena via get_or_publish; the
+    # special value "self" reuses the target's own params (accept rate
+    # 1.0 — the shape/parity harness). Requires the paged layout
+    serve_drafter: str = ""
     # total budget for one cross-node per-step push (chunk window +
     # commit); the commit side also waits for remote reader acks under it
     channel_remote_timeout_s: float = 120.0

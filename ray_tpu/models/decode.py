@@ -15,6 +15,7 @@ from typing import Any, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ray_tpu.models.transformer import TransformerConfig, forward
@@ -381,6 +382,84 @@ def paged_decode_step(cfg: TransformerConfig, params, tokens, active,
             v=c.v.at[dest].set(nv),
             lengths=c.lengths + active))
     return logits, new_caches
+
+
+def paged_verify_step(cfg: TransformerConfig, params, tokens,
+                      read_tables, write_tables,
+                      caches: List[PagedKVCache]):
+    """Speculative-decoding verify: score K candidate tokens per slot in
+    ONE fixed-shape call over the slots axis (ISSUE 18). tokens:
+    [slots, K] int32 — each slot's [next_token, d_1..d_{K-1}] placed at
+    logical positions [cursor, cursor + K); logits[s, j] is the target
+    model's distribution over the token FOLLOWING position cursor + j,
+    i.e. the exact distribution the sequential ``paged_decode_step`` loop
+    would produce after accepting d_1..d_j. The per-slot math is the same
+    gathered-view forward as the decode step with a K-token window —
+    mask_bias always spans the full fixed view width, so per-query
+    reduction order (and therefore every attended value) is bit-identical
+    to K sequential single-token steps.
+
+    Slot cursors are NOT advanced here: acceptance length is a host-side
+    decision (accept-prefix + corrected resample), applied afterwards via
+    ``paged_rewind_slots``. KV for all K positions IS written through the
+    windowed scatter — rejected positions hold stale values that the next
+    round's writes overwrite before anything attends to them (the same
+    update-before-attend invariant the arena already relies on); shared /
+    unallocated write entries redirect to the garbage page, so a verify
+    can never scribble on prefix-cache pages.
+
+    Returns (logits [slots, K, vocab], caches)."""
+    T = caches[0].k.shape[1]
+    slots, P = read_tables.shape
+    H, D = caches[0].k.shape[2:]
+    K = tokens.shape[1]
+
+    def one(toks, length, read_row, write_row):
+        rows = []
+        for c in caches:
+            k, v = _gather_row(c, read_row)
+            rows.append(LayerKVCache(k=k, v=v, length=length))
+        positions = jnp.arange(K)[None, :] + rows[0].length
+        logits, new_rows = forward(cfg, params, toks[None, :],
+                                   positions=positions, kv_caches=rows)
+        # windowed scatter-back: the K-token window writes
+        # [cursor, cursor + K), at most ceil(K/T)+1 pages — same idiom as
+        # the prefill chunk's scatter
+        W = min(P, (K + T - 1) // T + 1)
+        w0 = rows[0].length // T
+        widx = jnp.clip(w0 + jnp.arange(W), 0, P - 1)
+        dest = write_row[widx]
+        outs_k = [r.k[0].reshape(P, T, H, D)[widx] for r in new_rows]
+        outs_v = [r.v[0].reshape(P, T, H, D)[widx] for r in new_rows]
+        return logits[0], dest, (outs_k, outs_v)
+
+    lengths = caches[0].lengths
+    logits, dest, (new_k, new_v) = jax.vmap(one, in_axes=(0, 0, 0, 0))(
+        tokens, lengths, read_tables, write_tables)
+    new_caches = []
+    for c, nk, nv in zip(caches, new_k, new_v):
+        new_caches.append(PagedKVCache(
+            k=c.k.at[dest].set(nk),
+            v=c.v.at[dest].set(nv),
+            lengths=c.lengths))
+    return logits, new_caches
+
+
+def paged_rewind_slots(caches: List[PagedKVCache],
+                       new_lengths) -> List[PagedKVCache]:
+    """Set every slot's cursor after a verify round's host-side
+    acceptance: accepted slots advance to cursor + accepted + 1, rejected
+    tails rewind by simply NOT advancing past them. Stale KV beyond a
+    slot's new cursor is causally masked until overwritten (update-before-
+    attend), and shared pages are untouched — rewinding never frees or
+    mutates a page. new_lengths: [slots] int.
+
+    Each layer gets its OWN device buffer — the decode/verify programs
+    donate their caches, and a buffer shared across layers would be
+    donated once per layer (XLA rejects the duplicate)."""
+    host = np.asarray(new_lengths, np.int32)
+    return [dataclasses.replace(c, lengths=jnp.asarray(host))
+            for c in caches]
 
 
 @partial(jax.jit, static_argnums=(0, 4, 5, 6))

@@ -1397,6 +1397,244 @@ def run_serve_chaos(
         chaos.reset()
 
 
+def run_fleet_chaos(
+    seed: int,
+    *,
+    drop_prob: float = 0.02,
+    dup_prob: float = 0.05,
+    delay_prob: float = 0.05,
+    delay_max_ms: int = 20,
+    kills: bool = True,
+) -> None:
+    """One seeded chaos run against the FLEET serve path (ISSUE 18):
+    prefix-affinity steering with a replica kill landed MID-MIGRATION.
+
+    Deploys 3 LLM replicas with affinity routing on, warms a shared
+    preamble onto one holder, then fail-marks the holder so a burst of
+    same-preamble requests falls back with a migration hint — every
+    fallback PULLS the prefix pages cross-replica. With ``kills`` the
+    seed's parity picks the victim: even seeds hard-kill the HOLDER
+    (exporter dies under the pull; the puller must degrade to a
+    bit-identical cold prefill), odd seeds hard-kill a PULLER (its
+    in-flight splices die with it; the fleet serves on). Burst requests
+    must complete with the exact temperature-0 reference output or fail
+    cleanly, the router must re-steer within the fail-mark window
+    (first exact post-kill answer within FAIL_PENALTY_S), the health
+    sweep must replace the victim, at least one migration pull or
+    migration failure must be recorded on the survivors, and every live
+    replica's paged state must return to baseline — slots retired, radix
+    refcounts zero, no pending migrations, page gauge equal to the
+    resident prefix pages."""
+    import threading
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu._private import chaos
+    from ray_tpu._private.chaos import FaultController
+    from ray_tpu._private.config import Config
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.serve.llm import LLMServerImpl
+
+    cfg = Config.from_env()
+    cfg.chaos_seed = seed
+    cfg.chaos_drop_prob = drop_prob
+    cfg.chaos_dup_prob = dup_prob
+    cfg.chaos_delay_prob = delay_prob
+    cfg.chaos_delay_max_ms = delay_max_ms
+    cfg.chaos_methods = CHAOS_METHODS
+
+    cluster = Cluster(config=cfg)
+    try:
+        cluster.add_node(num_cpus=8)
+        cluster.wait_for_nodes(1)
+        ray_tpu.init(address=cluster.address)
+        chaos.set_fault_controller(FaultController(
+            seed=seed, drop_prob=drop_prob, dup_prob=dup_prob,
+            delay_prob=delay_prob, delay_max_ms=delay_max_ms,
+            methods=CHAOS_METHODS))
+
+        class _ChaosLLMImpl(LLMServerImpl):
+            async def __call__(self, request=None):
+                if isinstance(request, dict) and request.get("__die__"):
+                    os._exit(1)  # the mid-migration replica kill
+                return await super().__call__(request)
+
+        dep = serve.deployment(name="llmfleet", max_ongoing_requests=32)(
+            _ChaosLLMImpl)
+        # preamble spans several pages (page_tokens=8): a pull moves a
+        # real multi-page chain, not a single splice
+        preamble = ("You are a helpful fleet assistant serving many "
+                    "users. Answer tersely and exactly. ")
+        prompts = [preamble + f"q{i:02d}?" for i in range(6)]
+        h = serve.run(dep.options(num_replicas=3).bind(
+            max_new_tokens=6, slots=4, prefill_chunk=8, page_tokens=8),
+            name="fleetchaos", route_prefix="/fleetchaos")
+
+        refs = {}
+        for p in prompts:
+            refs[p] = h.remote({"prompt": p}).result(timeout=300)["text"]
+            assert refs[p], "reference generation empty"
+
+        # wait for the digest long-poll so steering has a holder to aim
+        # at; the poke requests double as cache warmers
+        router = h._get_router()
+        holder_key = None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            h.remote({"prompt": prompts[0]}).result(timeout=300)
+            with router._lock:
+                if router._affinity.ready():
+                    keys = [router._replica_key(r)
+                            for r in router._replicas]
+                    chain = router._affinity.chain_for(prompts[0])
+                    if chain:
+                        holder_key, depth = router._affinity.steer(
+                            chain, keys)
+                        if holder_key is not None and depth >= 2:
+                            break
+            holder_key = None
+            time.sleep(0.5)
+        assert holder_key is not None, "digests never advertised a holder"
+        with router._lock:
+            by_key = {router._replica_key(r): r for r in router._replicas}
+        holder_rep = by_key[holder_key]
+        pullers = [r for k, r in by_key.items() if k != holder_key]
+
+        # fail-mark the holder: every burst request for the preamble now
+        # falls back with a migration hint and PULLS from the holder
+        router._note_result(holder_key, ok=False)
+
+        n_burst = 16
+        outs = [None] * n_burst
+        errs = []
+
+        def call(i):
+            try:
+                outs[i] = h.remote(
+                    {"prompt": prompts[i % len(prompts)]}).result(
+                        timeout=300)
+            except Exception as e:  # noqa: BLE001 — asserted below
+                errs.append(e)
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(n_burst)]
+        for t in threads:
+            t.start()
+        t_kill = None
+        victim = None
+        if kills:
+            time.sleep(0.25)  # land the kill while pulls are in flight
+            victim = holder_rep if seed % 2 == 0 else pullers[0]
+            t_kill = time.monotonic()
+            try:
+                ray_tpu.get(victim.handle_request.remote(
+                    "__call__", ({"__die__": True},), {}), timeout=30)
+            except Exception:
+                pass  # the dying replica cannot answer
+
+        if t_kill is not None:
+            # re-steer within the fail-mark window, asserted at the
+            # ROUTING layer (service-time recovery is asserted below —
+            # burst drain on the survivors is capacity, not routing).
+            # The dead replica keeps its digest advertisement until the
+            # controller sweeps it out, so the completion-failure fail
+            # mark is what diverts traffic. Route one through the exact
+            # path a failed completion takes (_note_result is what
+            # _watch_completion calls), lift the synthetic pre-burst
+            # mark from the holder so only the victim is penalized, and
+            # every fresh pick inside the window must avoid the corpse
+            dead_key = router._replica_key(victim)
+            router._note_result(holder_key, ok=True)
+            router._note_result(dead_key, ok=False)
+            with router._lock:
+                chain = router._affinity.chain_for(prompts[0])
+            for _ in range(8):
+                idx, rep, _hint = router._pick("", chain)
+                with router._lock:
+                    router._inflight[idx] -= 1  # probe pick, not a call
+                assert router._replica_key(rep) != dead_key, (
+                    "a fresh pick landed on the dead replica inside "
+                    "the fail-mark window")
+        for t in threads:
+            t.join()
+        for o in outs:
+            if o is not None:
+                assert o["text"] == refs[o["prompt"]], (
+                    "burst output diverged from the temperature-0 "
+                    f"reference for {o['prompt']!r}")
+        done = sum(1 for o in outs if o is not None)
+        assert done >= 1, f"every burst request failed: {errs[:3]}"
+        if not kills:
+            assert not errs, f"requests failed without a kill: {errs[:2]}"
+
+        # recovery: the health sweep replaces the victim and the
+        # deployment keeps serving the exact references
+        deadline = time.monotonic() + 60
+        ok = 0
+        while time.monotonic() < deadline and ok < 8:
+            try:
+                out = h.remote(
+                    {"prompt": prompts[ok % len(prompts)]}).result(
+                        timeout=30)
+                assert out["text"] == refs[out["prompt"]], (
+                    "post-kill output diverged: "
+                    f"{out['text']!r} for {out['prompt']!r}")
+                ok += 1
+            except AssertionError:
+                raise
+            except Exception:
+                time.sleep(0.3)
+        assert ok >= 8, "fleet did not recover from the replica kill"
+
+        def live_stats():
+            with router._lock:
+                reps = list(router._replicas)
+            out = []
+            for rep in reps:
+                try:
+                    out.append(ray_tpu.get(rep.handle_request.remote(
+                        "scheduler_stats", (), {}), timeout=30))
+                except Exception:
+                    pass  # a replica mid-replacement; resampled below
+            return out
+
+        # migration evidence on the survivors: the fail-marked holder
+        # forced fallback pulls, so SOMEONE recorded a completed pull
+        # (odd seeds: holder alive) or a failed one (even seeds: the
+        # exporter died under the puller)
+        stats = live_stats()
+        pulled = sum(s.get("migrations", 0) + s.get("migration_failures", 0)
+                     for s in stats)
+        assert pulled >= 1, (
+            f"no migration was even attempted: "
+            f"{[{k: s.get(k) for k in ('migrations', 'migration_failures')} for s in stats]}")
+        assert sum(s.get("prefix_hits", 0) for s in stats) > 0, stats
+
+        # paged-state hygiene on every live replica, gauge-proven
+        deadline = time.monotonic() + 45
+        clean = False
+        while time.monotonic() < deadline and not clean:
+            stats = live_stats()
+            clean = len(stats) >= 2 and all(
+                s["active_slots"] == 0 and s["radix_active_refs"] == 0
+                and s["migrations_pending"] == 0
+                and s["pages_in_use"] == s["radix_resident_pages"]
+                for s in stats)
+            if not clean:
+                time.sleep(0.5)
+        assert clean, (
+            f"fleet paged state did not return to baseline: {stats}")
+
+        serve.shutdown()
+    finally:
+        chaos.set_fault_controller(None)  # calm teardown
+        _maybe_flight_dump()
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        cluster.shutdown()
+        chaos.reset()
+
+
 def _drain_pins_to_baseline(pins_before: int) -> None:
     """Shared tail of every channel-workload scenario: wait for the
     driver's channel pins to return to baseline, falling back to the
@@ -2148,6 +2386,12 @@ def _run_one(seed: int, args) -> None:
             drop_prob=args.drop, dup_prob=args.dup, delay_prob=args.delay,
             delay_max_ms=args.delay_max_ms, kills=not args.no_kills)
         return
+    if args.fleet:
+        run_fleet_chaos(
+            seed,
+            drop_prob=args.drop, dup_prob=args.dup, delay_prob=args.delay,
+            delay_max_ms=args.delay_max_ms, kills=not args.no_kills)
+        return
     if args.pipeline:
         # both schedules per seed: the PR-8 one-chunk chain, then the
         # interleaved V=2 variant (twice the cross-node act/grad hops,
@@ -2293,6 +2537,16 @@ def main() -> int:
                              "refcount to baseline (gauge-proven); cancel-"
                              "mid-stream must leave the cached prefix "
                              "uncontaminated for a later admit")
+    parser.add_argument("--fleet", action="store_true",
+                        help="attack the fleet serve path (ISSUE 18): "
+                             "prefix-affinity steering with a replica "
+                             "hard-killed mid-migration — even seeds kill "
+                             "the page-export HOLDER, odd seeds kill a "
+                             "PULLER; outputs must be exact or cleanly "
+                             "errored, the router must re-steer within "
+                             "the fail-mark window, and every live "
+                             "replica's paged state must return to "
+                             "baseline (gauge-proven)")
     args = parser.parse_args()
 
     if args.one is not None:
@@ -2331,6 +2585,8 @@ def main() -> int:
             child.append("--podracer")
         if args.serve:
             child.append("--serve")
+        if args.fleet:
+            child.append("--fleet")
         proc = subprocess.run(child)
         took = time.monotonic() - t0
         if proc.returncode != 0:

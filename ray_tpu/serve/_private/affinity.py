@@ -1,0 +1,178 @@
+"""Prefix-affinity routing: fleet-level cache coordination (ISSUE 18).
+
+Each replica's RadixCache is a per-process island; this module makes the
+prefix hit rate a FLEET property. Replicas advertise a compact digest of
+their cache — one 64-bit CHAIN hash per page-boundary span on every
+root path — through the stats the controller already polls; the router
+hashes an incoming prompt's page-aligned prefix the same way and steers
+it to the replica holding the deepest match.
+
+The chain construction is what makes a single set-membership test a
+full prefix comparison: the hash at page i is
+
+    h_i = blake2b(h_{i-1} || int32(tokens of page i), digest_size=8)
+
+so ``h_i`` commits to the ENTIRE first i pages, not just page i.
+``prompt_hash[i] in replica_digest`` therefore means the replica holds a
+cached span whose first i pages are token-identical to the prompt's
+(modulo 64-bit collision — a false steer costs one cold prefill, never a
+wrong token: affinity only picks WHERE a request runs). Digests are
+maintained incrementally by the RadixCache (insert registers, evict
+unregisters, splits are hash-preserving) — no tree walk on the stats
+path.
+
+Steering must never become a hotspot machine: the router abandons
+affinity for pow-2 choice whenever the steered replica's inflight count
+exceeds the least-loaded replica's by more than the skew bound, or the
+replica carries a recent fail mark. On a fleet-hit/local-miss the router
+attaches a ``_fleet_hint`` naming the holder so the chosen replica can
+PULL the pages itself (never through the controller).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu._private.metrics import Counter
+
+m_affinity_hits = Counter(
+    "ray_tpu_serve_fleet_affinity_hits_total",
+    "Router picks steered to a replica holding the prompt's prefix")
+m_affinity_misses = Counter(
+    "ray_tpu_serve_fleet_affinity_misses_total",
+    "Router picks that fell back to pow-2 (no digest match, load skew, "
+    "or fail-marked holder)")
+m_migrations = Counter(
+    "ray_tpu_serve_fleet_migrations_total",
+    "Cross-replica prefix page pulls completed (spliced into the puller)")
+m_migrated_pages = Counter(
+    "ray_tpu_serve_fleet_migrated_pages_total",
+    "KV pages copied between replicas by completed migrations")
+
+# chain seed: the hash "before page 0". Any fixed 8 bytes works; zeros
+# keep digests reproducible across processes
+CHAIN_SEED = 0
+_DIGEST_SIZE = 8
+
+
+def extend_chain(prev: int, span: Sequence[int]) -> int:
+    """One chain step: fold one page's tokens onto the running hash."""
+    h = hashlib.blake2b(
+        prev.to_bytes(_DIGEST_SIZE, "little")
+        + b"".join(int(t).to_bytes(4, "little", signed=True) for t in span),
+        digest_size=_DIGEST_SIZE)
+    return int.from_bytes(h.digest(), "little")
+
+
+def chain_hashes(tokens: Sequence[int], page_tokens: int,
+                 seed: int = CHAIN_SEED) -> List[int]:
+    """Chain hash at every page boundary of ``tokens`` (the trailing
+    partial page is dropped — digests are page-aligned like the radix
+    tree itself). tokens of d full pages -> [h_1 .. h_d]."""
+    if page_tokens < 1:
+        raise ValueError(f"page_tokens must be >= 1, got {page_tokens}")
+    out: List[int] = []
+    prev = seed
+    full = (len(tokens) // page_tokens) * page_tokens
+    for i in range(0, full, page_tokens):
+        prev = extend_chain(prev, tokens[i:i + page_tokens])
+        out.append(prev)
+    return out
+
+
+def prompt_chain(prompt_ids: Sequence[int], page_tokens: int) -> List[int]:
+    """Chain hashes for the ROUTABLE prefix of a prompt. The last prompt
+    token is never cached (admission matches ``prompt[:-1]`` — its KV is
+    written by the sampling step), so the router must hash the same
+    clipped span or it would steer on a page no replica can ever hold."""
+    return chain_hashes(prompt_ids[:len(prompt_ids) - 1], page_tokens)
+
+
+class AffinityIndex:
+    """Router-side view of every replica's prefix digest.
+
+    ``update`` ingests the controller's ``listen_for_digests`` payload
+    (replica key -> digest dict as produced by ``RadixCache.digest``);
+    ``steer`` answers the per-pick question: which replica key holds the
+    deepest page-aligned match for this prompt chain, and how deep. All
+    methods are cheap dict/set work — the router calls them with its
+    lock held."""
+
+    def __init__(self):
+        self._sets: Dict[str, frozenset] = {}
+        self._page_tokens: Optional[int] = None
+        self._vocab_size: Optional[int] = None
+        self._tok: str = ""
+        self.version: int = -1
+
+    def update(self, payload: Dict) -> None:
+        """payload: {"version": int, "digests": {key: digest-dict}} where
+        each digest-dict carries page_tokens/vocab_size/tok/hashes."""
+        sets: Dict[str, frozenset] = {}
+        for key, d in (payload.get("digests") or {}).items():
+            if not d:
+                continue
+            self._page_tokens = d.get("page_tokens", self._page_tokens)
+            self._vocab_size = d.get("vocab_size", self._vocab_size)
+            self._tok = d.get("tok", self._tok)
+            sets[key] = frozenset(d.get("hashes") or ())
+        self._sets = sets
+        self.version = payload.get("version", self.version)
+
+    @property
+    def page_tokens(self) -> Optional[int]:
+        return self._page_tokens
+
+    def ready(self) -> bool:
+        return self._page_tokens is not None and bool(self._sets)
+
+    def tokenize(self, prompt: str) -> Optional[List[int]]:
+        """Router-side tokenization for steering. Only the byte tokenizer
+        is reproducible outside the replica; requests using any other
+        tokenizer must carry explicit ``prompt_ids`` to be steerable."""
+        if self._tok != "byte" or self._vocab_size is None:
+            return None
+        v = self._vocab_size
+        return [b % v for b in prompt.encode("utf-8")]
+
+    def chain_for(self, prompt: str = "",
+                  prompt_ids: Optional[Sequence[int]] = None
+                  ) -> List[int]:
+        if not self.ready():
+            return []
+        ids = list(prompt_ids) if prompt_ids is not None else (
+            self.tokenize(prompt))
+        if not ids:
+            return []
+        return prompt_chain(ids, self._page_tokens)
+
+    def depth(self, key: str, chain: Sequence[int]) -> int:
+        """Pages of ``chain`` the replica ``key`` holds (deepest i with
+        chain[i-1] present — chain hashes commit to the whole prefix, so
+        scanning from the deep end is exact, not heuristic)."""
+        s = self._sets.get(key)
+        if not s:
+            return 0
+        for i in range(len(chain), 0, -1):
+            if chain[i - 1] in s:
+                return i
+        return 0
+
+    def steer(self, chain: Sequence[int], keys: Sequence[str]
+              ) -> Tuple[Optional[str], int]:
+        """(holder_key, depth_pages) of the deepest match among ``keys``,
+        or (None, 0) when no replica holds even one page."""
+        best_key, best_depth = None, 0
+        for key in keys:
+            d = self.depth(key, chain)
+            if d > best_depth:
+                best_key, best_depth = key, d
+        return best_key, best_depth
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "replicas": len(self._sets),
+            "hashes": sum(len(s) for s in self._sets.values()),
+            "version": self.version,
+        }

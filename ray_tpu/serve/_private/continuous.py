@@ -34,11 +34,30 @@ jump instead of a re-prefill; eviction is LRU over refcount-0 nodes under
 arena pressure. ``kv_layout="contiguous"`` keeps the PR-9 arena as the
 measured baseline (the collective layer's ``algo="kv"`` idiom).
 
+ISSUE 18 adds the FLEET phase on top: (1) the radix cache's chain-hash
+digest is exported through ``prefix_digest()`` so the router can steer
+prompts to the replica already holding their prefix; (2) a request that
+arrives with a ``fleet_hint`` (holder replica handle + matched depth)
+PULLS the matched prefix pages from the holder before admission — the
+pull runs on a dedicated worker thread (the scheduler thread never
+blocks on a peer), the pulled KV is spliced into the local arena +
+radix tree, and admission then hits it like any local prefix; a failed
+or timed-out pull falls back to a cold prefill, bit-identical by
+construction; (3) speculative decoding: a ``speculative.Drafter``
+proposes up to ``spec_k`` tokens per slot and ONE fixed-shape
+``paged_verify_step`` call (the third and only third compiled program)
+scores them all, with exact accept-prefix + corrected-resample
+semantics (temperature-0 output is the sequential greedy path's, token
+for token).
+
 Knobs: ``RAY_TPU_SERVE_SLOTS`` (arena width), ``RAY_TPU_SERVE_PREFILL_CHUNK``
 (prefill chunk tokens), ``RAY_TPU_SERVE_KV_LAYOUT``,
 ``RAY_TPU_SERVE_PAGE_TOKENS``, ``RAY_TPU_SERVE_KV_PAGES`` (0 = size the
-pool to the contiguous worst case), ``RAY_TPU_SERVE_PREFIX_CACHE``; all
-overridable per-deployment via LLMServer init.
+pool to the contiguous worst case), ``RAY_TPU_SERVE_PREFIX_CACHE``,
+``RAY_TPU_SERVE_MIGRATION_BUDGET`` (pages per cross-replica pull),
+``RAY_TPU_SERVE_SPEC_K`` (draft tokens per verify round),
+``RAY_TPU_SERVE_DRAFTER`` (drafter preset; ``"self"`` shares the target's
+weights); all overridable per-deployment via LLMServer init.
 """
 
 from __future__ import annotations
@@ -47,6 +66,8 @@ import threading
 import time
 from collections import deque
 from functools import partial
+from queue import Empty as _QueueEmpty
+from queue import Queue as _Queue
 from typing import Any, Dict, List, Optional
 
 from ray_tpu._private import flight
@@ -59,6 +80,8 @@ _F_ADMIT = flight.intern("serve.admit")
 _F_PREFILL = flight.intern("serve.prefill")
 _F_DECODE = flight.intern("serve.decode")
 _F_RETIRE = flight.intern("serve.retire")
+_F_VERIFY = flight.intern("serve.verify")
+_F_MIGRATE = flight.intern("serve.migrate")
 
 _m_steps = Counter(
     "ray_tpu_serve_decode_steps_total",
@@ -100,7 +123,8 @@ class _Seq:
                  "seed", "slot", "state", "n_generated", "next_token",
                  "queue", "loop", "cancelled", "t_submit", "t_first_token",
                  "rng", "cached_len", "cursor", "owned_pages", "radix_node",
-                 "table_fill")
+                 "table_fill", "fleet_hint", "migration_node",
+                 "drafter_len", "drafter_pending")
 
     def __init__(self, prompt: List[int], max_new: int, temperature: float,
                  seed: int, loop, queue):
@@ -125,6 +149,12 @@ class _Seq:
         self.owned_pages: List[int] = []  # pages this slot must free
         self.radix_node = None         # ref-counted prefix-cache node
         self.table_fill = 0            # logical pages present in the table
+        # ---- fleet phase (ISSUE 18) ----
+        self.fleet_hint = None         # {"handle", "tokens"} from the router
+        self.migration_node = None     # pin on a just-migrated prefix span
+        # ---- speculative decoding (per-slot drafter sync state) ----
+        self.drafter_len = -1          # drafter's valid context length
+        self.drafter_pending: List[int] = []  # tokens drafter must catch up
 
 
 class ContinuousScheduler:
@@ -145,7 +175,10 @@ class ContinuousScheduler:
                  kv_layout: Optional[str] = None,
                  page_tokens: Optional[int] = None,
                  kv_pages: Optional[int] = None,
-                 prefix_cache: Optional[bool] = None):
+                 prefix_cache: Optional[bool] = None,
+                 drafter=None,
+                 spec_k: Optional[int] = None,
+                 migration_budget: Optional[int] = None):
         import numpy as np
         import jax
 
@@ -257,6 +290,52 @@ class ContinuousScheduler:
                                  donate_argnums=(3,))
             self._caches = init_slot_caches(cfg, self.slots, self.arena_len,
                                             cache_dtype)
+        # ---- speculative decoding (ISSUE 18): the drafter proposes, one
+        # extra fixed-shape verify program scores — the two-compiles
+        # contract becomes exactly three with speculation on
+        self.spec_k = int(conf.serve_spec_k if spec_k is None else spec_k)
+        if self.spec_k < 1:
+            # explicit 0 (arg or RAY_TPU_SERVE_SPEC_K=0) raises — never
+            # silently the config default through a falsy `or`
+            raise ValueError(f"spec_k must be >= 1, got {self.spec_k}")
+        self.migration_budget = int(conf.serve_migration_budget
+                                    if migration_budget is None
+                                    else migration_budget)
+        if self.migration_budget < 1:
+            raise ValueError(f"migration_budget must be >= 1, got "
+                             f"{self.migration_budget}")
+        self._drafter = drafter
+        self._verify = None
+        if drafter is not None:
+            if not self._paged:
+                raise ValueError(
+                    "speculative decoding requires kv_layout='paged' (the "
+                    "verify step scores K tokens through page tables)")
+            if drafter.slots != self.slots:
+                raise ValueError(
+                    f"drafter has {drafter.slots} slots, scheduler has "
+                    f"{self.slots} — they must share the slot numbering")
+            from ray_tpu.models.decode import paged_verify_step
+
+            self._verify = jax.jit(partial(paged_verify_step, cfg),
+                                   donate_argnums=(4,))
+        # ---- cross-replica page migration (ISSUE 18): a dedicated
+        # worker thread does the blocking peer pull; the scheduler thread
+        # only splices finished results between iterations. _commands
+        # carries EXPORT requests from peer replicas (RPC threads) onto
+        # the scheduler thread, which owns the radix tree and the caches.
+        self._migrating: List[_Seq] = []
+        self._mig_requests: _Queue = _Queue()
+        self._mig_results: _Queue = _Queue()
+        self._mig_thread: Optional[threading.Thread] = None
+        self._commands: deque = deque()
+        self._n_migrations = 0
+        self._n_migrated_pages = 0
+        self._n_migration_failures = 0
+        self._n_spec_rounds = 0
+        self._n_drafted = 0
+        self._n_accepted = 0
+        self._n_spec_emitted = 0
         self._slot_seqs: List[Optional[_Seq]] = [None] * self.slots
         self._prefill_rr = 0  # round-robin cursor over prefilling slots
         self._pending: deque = deque()
@@ -292,15 +371,25 @@ class ContinuousScheduler:
         if self._paged:
             effective = min(effective,
                             self._arena.usable_pages * self.page_tokens)
+        # with speculation on, a verify round near the end of generation
+        # writes up to spec_k positions past the final cursor — reserve
+        # them so the windowed scatter can never clip onto the slot's
+        # last real page
+        reserve = self.spec_k if self._drafter is not None else 0
         by_pad = (effective // c) * c
-        return min(by_pad, effective - max_new)
+        return min(by_pad, effective - max_new - reserve)
 
     def submit(self, prompt_ids: List[int], *, max_new_tokens: int,
                temperature: float = 0.0, seed: int = 0,
-               loop=None, queue=None) -> _Seq:
+               loop=None, queue=None, fleet_hint=None) -> _Seq:
         """Enqueue a generation. Tokens/end/error events arrive on ``queue``
         via ``loop.call_soon_threadsafe`` as ``("tok", id)``, ``("end",
-        reason)`` or ``("err", message)`` tuples. Thread/loop-safe."""
+        reason)`` or ``("err", message)`` tuples. Thread/loop-safe.
+
+        ``fleet_hint`` (router-attached): ``{"handle": holder_replica,
+        "tokens": matched_depth}`` — before admission the scheduler pulls
+        the matched prefix pages from the holder and splices them locally;
+        any pull failure degrades to a cold prefill."""
         if not prompt_ids:
             raise ValueError("prompt must be non-empty")
         if max_new_tokens < 1:
@@ -313,6 +402,8 @@ class ContinuousScheduler:
                 f"chunks)")
         seq = _Seq(list(prompt_ids), max_new_tokens, temperature, seed,
                    loop, queue)
+        if fleet_hint and self._paged and self._radix is not None:
+            seq.fleet_hint = dict(fleet_hint)
         with self._lock:
             if self._closed:
                 raise SchedulerClosedError(
@@ -359,7 +450,16 @@ class ContinuousScheduler:
         self._read_tables[slot, :] = 0
         self._write_tables[slot, :] = 0
 
+    def _release_migration_ref(self, seq: _Seq) -> None:
+        """A migrated-prefix pin must drop no matter how the sequence
+        ends — including cancellation BEFORE it ever took a slot (the
+        node ref is held while the sequence waits in the pending queue)."""
+        if seq.migration_node is not None and self._radix is not None:
+            self._radix.release(seq.migration_node)
+            seq.migration_node = None
+
     def _retire(self, seq: _Seq, reason: str) -> None:
+        self._release_migration_ref(seq)
         self._release_slot_resources(seq)
         if seq.slot is not None:
             flight.instant(_F_RETIRE, seq.slot)
@@ -371,6 +471,7 @@ class ContinuousScheduler:
         self._emit(seq, ("end", reason))
 
     def _fail(self, seq: _Seq, msg: str) -> None:
+        self._release_migration_ref(seq)
         self._release_slot_resources(seq)
         if seq.slot is not None:
             self._slot_seqs[seq.slot] = None
@@ -502,6 +603,9 @@ class ContinuousScheduler:
                 self._write_tables[free, :] = 0
                 if self._radix is not None:
                     self._splice_prefix(seq)
+                    # a migrated prefix was pinned only so eviction could
+                    # not race admission; the splice holds its own ref now
+                    self._release_migration_ref(seq)
                 seq.cursor = seq.cached_len
                 seq.remaining_prompt = seq.prompt[seq.cached_len:]
                 self._caches = paged_reset_slot(self._caches, free,
@@ -617,6 +721,387 @@ class ContinuousScheduler:
                 self._radix.release(seq.radix_node)
             seq.radix_node = node
 
+    # ------------------------------------------- cross-replica migration
+
+    def _requeue(self, seq: _Seq) -> None:
+        with self._lock:
+            self._pending.appendleft(seq)
+            _m_queue_depth.set(float(len(self._pending)))
+
+    def _ensure_mig_thread(self) -> None:
+        if self._mig_thread is None:
+            t = threading.Thread(target=self._migration_worker,
+                                 name="serve-migration-puller", daemon=True)
+            self._mig_thread = t
+            t.start()
+
+    def _migration_worker(self) -> None:
+        """Blocking peer pulls live here, NEVER on the scheduler thread —
+        a dead or slow holder must not stall in-flight decodes. The pull
+        is replica→replica (PR-2 pull idiom): the controller is not on
+        the data path."""
+        import ray_tpu
+
+        while True:
+            item = self._mig_requests.get()
+            if item is None:
+                return
+            seq, handle, tokens = item
+            try:
+                res = ray_tpu.get(handle.export_prefix.remote(list(tokens)),
+                                  timeout=30.0)
+            except Exception as e:  # noqa: BLE001 — any failure = cold path
+                res = {"__error__": f"{type(e).__name__}: {e}"}
+            self._mig_results.put((seq, res))
+            self._wake.set()
+
+    def _start_migrations(self) -> None:
+        """Pre-admission pass: pending sequences carrying a router fleet
+        hint are parked in ``_migrating`` while the worker pulls their
+        prefix from the holder. The want-length is page-aligned, clamped
+        to what is NOT already cached locally, and bounded by the
+        migration budget — a hint that buys nothing re-queues for normal
+        (cold or locally-warm) admission immediately."""
+        if not self._paged or self._radix is None:
+            return
+        with self._lock:
+            flagged = [s for s in self._pending if s.fleet_hint is not None]
+            for s in flagged:
+                self._pending.remove(s)
+            if flagged:
+                _m_queue_depth.set(float(len(self._pending)))
+        for seq in flagged:
+            hint = seq.fleet_hint or {}
+            seq.fleet_hint = None
+            handle = hint.get("handle")
+            hint_tokens = int(hint.get("tokens") or 0)
+            if seq.cancelled:
+                self._retire(seq, "cancelled")
+                continue
+            T = self.page_tokens
+            _pages, matched, node = self._radix.match(seq.prompt[:-1])
+            if node is not None:
+                self._radix.release(node)
+            want = min(hint_tokens, len(seq.prompt) - 1)
+            want = (want // T) * T
+            want = min(want, matched + self.migration_budget * T)
+            if handle is None or want <= matched:
+                self._requeue(seq)
+                continue
+            self._ensure_mig_thread()
+            self._migrating.append(seq)
+            self._mig_requests.put((seq, handle, seq.prompt[:want]))
+
+    def _finish_migrations(self) -> None:
+        """Drain completed pulls (success or failure) and re-queue their
+        sequences for normal admission — a successful splice means the
+        admission-time ``_splice_prefix`` now hits the migrated span, a
+        failed pull means a plain cold prefill. Either way the OUTPUT is
+        the same tokens; migration only moves where the KV comes from."""
+        if not self._paged:
+            return
+        while True:
+            try:
+                seq, res = self._mig_results.get_nowait()
+            except _QueueEmpty:
+                return
+            try:
+                self._migrating.remove(seq)
+            except ValueError:
+                pass
+            if seq.state == _DONE:
+                continue
+            if seq.cancelled:
+                self._retire(seq, "cancelled")
+                continue
+            ok = (isinstance(res, dict) and "__error__" not in res
+                  and int(res.get("matched_len") or 0) > 0
+                  and int(res.get("page_tokens") or 0) == self.page_tokens)
+            if ok:
+                try:
+                    self._splice_migrated(seq, res)
+                except Exception:  # noqa: BLE001 — abandon to cold prefill
+                    self._n_migration_failures += 1
+            else:
+                self._n_migration_failures += 1
+            self._requeue(seq)
+
+    def _splice_migrated(self, seq: _Seq, res: Dict[str, Any]) -> None:
+        """Copy pulled prefix KV into freshly-allocated local pages and
+        insert the span into the radix tree (pinned via the sequence's
+        ``migration_node`` until admission splices it). Any failure —
+        allocation, shape, dtype — propagates to the caller, which counts
+        it and lets the sequence prefill cold; nothing here is ever
+        half-applied: pages are only reachable once ``insert`` succeeds."""
+        import dataclasses
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ray_tpu.serve._private.affinity import (m_migrated_pages,
+                                                     m_migrations)
+        from ray_tpu.serve._private.paging import OutOfPagesError
+
+        T = self.page_tokens
+        matched = (int(res["matched_len"]) // T) * T
+        n = matched // T
+        if n <= 0:
+            raise ValueError("empty migration payload")
+        t0 = flight.now()
+        try:
+            pages = self._arena.alloc(n)
+        except OutOfPagesError:
+            self._radix.evict(n - self._arena.free_pages)
+            pages = self._arena.alloc(n)
+        try:
+            idx = jnp.asarray(np.asarray(pages, np.int32))
+            out = []
+            for li, c in enumerate(self._caches):
+                k = jnp.asarray(np.asarray(res["k"][li]), c.k.dtype)
+                v = jnp.asarray(np.asarray(res["v"][li]), c.v.dtype)
+                out.append(dataclasses.replace(
+                    c, k=c.k.at[idx].set(k), v=c.v.at[idx].set(v)))
+            self._jax.block_until_ready(out[0].k)
+            self._caches = out
+            dups, node = self._radix.insert(seq.prompt[:matched], pages)
+        except BaseException:
+            self._arena.free(pages)
+            raise
+        if dups:
+            # spans another sequence cached while we pulled: keep theirs
+            self._arena.free(dups)
+        if node is not None:
+            seq.migration_node = node
+        self._n_migrations += 1
+        self._n_migrated_pages += n - len(dups)
+        m_migrations.inc()
+        m_migrated_pages.inc(n - len(dups))
+        flight.span_since(_F_MIGRATE, t0)
+
+    # -------------------------------------------------- prefix export
+
+    def export_prefix(self, tokens: List[int],
+                      timeout_s: float = 30.0) -> Dict[str, Any]:
+        """Serve a migration pull FROM a peer replica. Called on an RPC
+        thread; the actual radix match + device gather must run on the
+        scheduler thread (sole owner of the tree and the donated caches),
+        so this enqueues a command and waits. The matched node is pinned
+        only for the duration of the gather."""
+        if not self._paged or self._radix is None:
+            return {"matched_len": 0, "page_tokens": self.page_tokens,
+                    "k": [], "v": []}
+        box: Dict[str, Any] = {}
+        done = threading.Event()
+        with self._lock:
+            if self._closed:
+                raise SchedulerClosedError("scheduler is shut down")
+            self._commands.append((list(tokens), box, done))
+        self._wake.set()
+        if not done.wait(timeout=timeout_s):
+            raise TimeoutError(
+                f"export_prefix timed out after {timeout_s:.0f}s")
+        if "error" in box:
+            raise RuntimeError(box["error"])
+        return box["result"]
+
+    def _process_commands(self) -> None:
+        while self._commands:
+            try:
+                tokens, box, done = self._commands.popleft()
+            except IndexError:
+                return
+            try:
+                box["result"] = self._export_prefix_now(tokens)
+            except BaseException as e:  # noqa: BLE001 — crosses threads
+                box["error"] = f"{type(e).__name__}: {e}"
+            done.set()
+
+    def _export_prefix_now(self, tokens: List[int]) -> Dict[str, Any]:
+        import numpy as np
+
+        pages, matched, node = self._radix.match(tokens)
+        if matched == 0:
+            return {"matched_len": 0, "page_tokens": self.page_tokens,
+                    "k": [], "v": []}
+        n = matched // self.page_tokens
+        idx = np.asarray(pages[:n], np.int32)
+        ks, vs = [], []
+        try:
+            for c in self._caches:
+                ks.append(np.asarray(c.k[idx]))
+                vs.append(np.asarray(c.v[idx]))
+        finally:
+            self._radix.release(node)
+        return {"matched_len": n * self.page_tokens,
+                "page_tokens": self.page_tokens, "k": ks, "v": vs}
+
+    def prefix_digest(self) -> Dict[str, Any]:
+        """Chain-hash digest of the radix cache for the affinity router.
+        Probed OFF the scheduler thread (the stats path), so the rare
+        mid-mutation dict iteration is retried rather than locked — the
+        digest is advisory; a stale read costs one cold prefill at most."""
+        if not self._paged or self._radix is None:
+            return {}
+        for _ in range(8):
+            try:
+                return self._radix.digest()
+            except RuntimeError:
+                continue
+        return {}
+
+    # ------------------------------------------------ speculative decode
+
+    def _prime_drafter(self, seq: _Seq) -> None:
+        """First speculative round for a freshly-decoding slot: give the
+        drafter the sequence's full context up to the cursor. A drafter
+        sharing the target's params ADOPTS the paged KV by gather (prefix
+        splices included — the TTFT win survives); a distinct drafter
+        must run the prompt through its own model."""
+        if self._drafter.shares_target:
+            self._drafter.adopt_from_paged(
+                seq.slot, self._caches, self._read_tables[seq.slot],
+                int(seq.cursor), self.page_tokens)
+        else:
+            self._drafter.prefill_prompt(seq.slot, seq.prompt,
+                                         self.prefill_chunk)
+        seq.drafter_len = int(seq.cursor)
+        seq.drafter_pending = []
+
+    def _decode_spec(self) -> bool:
+        """One speculative round over every DECODE slot: exactly
+        ``spec_k`` batched drafter steps propose tokens, ONE fixed-shape
+        ``paged_verify_step`` scores every proposal, and exact
+        accept-prefix + corrected-resample emits 1..spec_k+1 tokens per
+        live sequence. Rejections rewind CURSORS only (host-side) — pages
+        are never freed or mutated by a rejection; stale KV past a cursor
+        is causally masked until overwritten.
+
+        Drafter sync: the drafter always steps ``spec_k`` times (fixed
+        program shapes), but after a fully-accepted round it first
+        catches up on the accepted token it never consumed
+        (``drafter_pending``), producing one fewer draft that round."""
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from ray_tpu.models.decode import paged_rewind_slots
+        from ray_tpu.serve._private.speculative import (_softmax,
+                                                        accept_greedy,
+                                                        accept_sample,
+                                                        m_spec_accepted,
+                                                        m_spec_drafted)
+
+        k = self.spec_k
+        K = k + 1
+        live: List[_Seq] = []
+        for seq in self._slot_seqs:
+            if seq is None or seq.state != _DECODE:
+                continue
+            if seq.cancelled:
+                self._retire(seq, "cancelled")
+                continue
+            # the verify scatter writes positions [cursor, cursor + K)
+            if not self._ensure_pages(seq, seq.cursor + K):
+                continue
+            live.append(seq)
+        if not live:
+            return False
+        for seq in live:
+            if seq.drafter_len < 0:
+                self._prime_drafter(seq)
+        # ---- draft: k batched drafter steps, sampled host-side --------
+        feed = {s.slot: list(s.drafter_pending) + [s.next_token]
+                for s in live}
+        pend0 = {s.slot: list(s.drafter_pending) for s in live}
+        drafts: Dict[int, List[int]] = {s.slot: [] for s in live}
+        dprobs: Dict[int, List[Any]] = {s.slot: [] for s in live}
+        toks = np.zeros(self.slots, np.int32)
+        active = np.zeros(self.slots, np.int32)
+        for s in live:
+            active[s.slot] = 1
+        for _ in range(k):
+            for s in live:
+                sl = s.slot
+                toks[sl] = feed[sl].pop(0) if feed[sl] else drafts[sl][-1]
+            la = self._drafter.step(toks, active)
+            for s in live:
+                sl = s.slot
+                if feed[sl]:
+                    continue  # still catching up; not at the draft frontier
+                if s.temperature <= 0.0:
+                    d = int(la[sl].argmax())
+                else:
+                    if s.rng is None:
+                        s.rng = np.random.default_rng(s.seed)
+                    p = _softmax(la[sl], s.temperature)
+                    dprobs[sl].append(p)
+                    d = int(s.rng.choice(len(p), p=p))
+                drafts[sl].append(d)
+        # ---- verify: ONE fixed-shape K-token target call --------------
+        vt = np.zeros((self.slots, K), np.int32)
+        for s in live:
+            row = [s.next_token] + drafts[s.slot]
+            vt[s.slot, :len(row)] = row
+        t0 = flight.now()
+        vlogits, self._caches = self._verify(
+            self.params, jnp.asarray(vt),
+            jnp.asarray(self._read_tables),
+            jnp.asarray(self._write_tables), self._caches)
+        va = np.asarray(vlogits)
+        flight.span_since(_F_VERIFY, t0)
+        self._n_steps += 1
+        _m_steps.inc()
+        self._n_spec_rounds += 1
+        self._max_active_slots = max(self._max_active_slots, len(live))
+        # ---- exact acceptance + host-side cursor rewind ---------------
+        new_lengths = np.asarray(self._caches[0].lengths, np.int32).copy()
+        dlen = self._drafter.lengths().copy()
+        for s in live:
+            sl = s.slot
+            ds = drafts[sl]
+            old = s.cursor
+            nxt = s.next_token
+            if s.temperature <= 0.0:
+                a, emitted = accept_greedy(ds, va[sl])
+            else:
+                if s.rng is None:
+                    s.rng = np.random.default_rng(s.seed)
+                pt = [_softmax(va[sl, j], s.temperature)
+                      for j in range(len(ds) + 1)]
+                a, emitted = accept_sample(ds, dprobs[sl], pt, s.rng)
+            self._n_drafted += len(ds)
+            self._n_accepted += a
+            if ds:
+                m_spec_drafted.inc(len(ds))
+            if a:
+                m_spec_accepted.inc(a)
+            new_cursor = old + a + 1
+            s.cursor = new_cursor
+            new_lengths[sl] = new_cursor
+            # drafter sync: positions [L0, L0 + k) were consumed this
+            # round; the valid prefix stops at the last accepted position,
+            # and whatever accepted tokens the drafter missed become next
+            # round's catch-up feed
+            L0 = s.drafter_len
+            valid = min(L0 + k, new_cursor)
+            hist = pend0[sl] + [nxt] + list(ds[:a])
+            s.drafter_pending = hist[valid - L0:new_cursor - L0]
+            s.drafter_len = valid
+            dlen[sl] = valid
+            finished = False
+            for tok in emitted:
+                s.next_token = tok
+                self._n_spec_emitted += 1
+                if self._emit_token(s, tok):
+                    finished = True
+                    break
+            if finished:
+                self._retire(s, "eos" if self.eos_id is not None
+                             and s.next_token == self.eos_id else "length")
+        self._caches = paged_rewind_slots(self._caches, new_lengths)
+        self._drafter.set_lengths(dlen)
+        return True
+
     def _decode_once(self) -> bool:
         """One batched decode iteration over every DECODE slot."""
         import jax.numpy as jnp
@@ -669,16 +1154,24 @@ class ContinuousScheduler:
                 with self._lock:
                     if self._closed:
                         break
+                if self._paged:
+                    self._process_commands()
+                    self._finish_migrations()
+                    self._start_migrations()
                 self._admit()
                 did = self._prefill_one()
-                did = self._decode_once() or did
+                if self._drafter is not None:
+                    did = self._decode_spec() or did
+                else:
+                    did = self._decode_once() or did
                 _m_active.set(float(sum(
                     1 for s in self._slot_seqs if s is not None)))
                 if not did:
                     with self._lock:
-                        idle = not self._pending and all(
-                            s is None or s.cancelled
-                            for s in self._slot_seqs)
+                        idle = (not self._pending and not self._commands
+                                and not self._migrating and all(
+                                    s is None or s.cancelled
+                                    for s in self._slot_seqs))
                         if idle:
                             self._wake.clear()
                     self._wake.wait(timeout=1.0)
@@ -694,12 +1187,27 @@ class ContinuousScheduler:
                 self._pending.clear()
             for seq in pending:
                 self._fail(seq, f"{type(e).__name__}: {e}")
+            for seq in list(self._migrating):
+                self._fail(seq, f"{type(e).__name__}: {e}")
+            self._migrating.clear()
+            self._drain_commands("scheduler crashed")
         finally:
             with self._lock:
                 self._closed = True
             _m_active.set(0.0)
 
     # --------------------------------------------------------- lifecycle
+
+    def _drain_commands(self, msg: str) -> None:
+        """Unblock every RPC thread waiting in ``export_prefix`` with an
+        error — a peer's pull degrades to its cold prefill."""
+        while self._commands:
+            try:
+                _tokens, box, done = self._commands.popleft()
+            except IndexError:
+                return
+            box["error"] = msg
+            done.set()
 
     def shutdown(self, timeout_s: float = 5.0) -> None:
         with self._lock:
@@ -715,6 +1223,12 @@ class ContinuousScheduler:
         for seq in list(self._slot_seqs):
             if seq is not None:
                 self._fail(seq, "scheduler shut down")
+        for seq in list(self._migrating):
+            self._fail(seq, "scheduler shut down")
+        self._migrating.clear()
+        self._drain_commands("scheduler shut down")
+        if self._mig_thread is not None:
+            self._mig_requests.put(None)
         if self._radix is not None:
             # every slot ref is gone; drain the cache so the page gauge
             # returns to zero (chaos_soak asserts this after a kill)
@@ -725,11 +1239,17 @@ class ContinuousScheduler:
         return self._closed
 
     def compiled_programs(self) -> int:
-        """Total compiled program count across the scheduler's two jitted
+        """Total compiled program count across the scheduler's jitted
         entry points — the two-compiles contract says this is exactly 2
         (one prefill shape + one decode shape) no matter how lengths,
-        pages and prefix hits churn."""
-        return int(self._prefill._cache_size() + self._step._cache_size())
+        pages and prefix hits churn; speculative decoding adds the verify
+        program as the only new shape (and the plain decode step, never
+        driven in spec mode, stays uncompiled — the total remains 2; the
+        drafter's own programs are reported separately in stats)."""
+        n = self._prefill._cache_size() + self._step._cache_size()
+        if self._verify is not None:
+            n += self._verify._cache_size()
+        return int(n)
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
@@ -762,4 +1282,22 @@ class ContinuousScheduler:
             if self._radix is not None:
                 out.update(self._radix.stats())
                 out["prefix_hit_tokens"] = self._n_prefix_hit_tokens
+                out["migrations"] = self._n_migrations
+                out["migrated_pages"] = self._n_migrated_pages
+                out["migration_failures"] = self._n_migration_failures
+                out["migrations_pending"] = len(self._migrating)
+        if self._drafter is not None:
+            out["spec_k"] = self.spec_k
+            out["drafter"] = self._drafter.name
+            out["spec_rounds"] = self._n_spec_rounds
+            out["spec_drafted_tokens"] = self._n_drafted
+            out["spec_accepted_tokens"] = self._n_accepted
+            out["spec_accept_rate"] = (
+                self._n_accepted / self._n_drafted
+                if self._n_drafted else 0.0)
+            out["spec_tokens_per_step"] = (
+                self._n_spec_emitted / self._n_spec_rounds
+                if self._n_spec_rounds else 0.0)
+            out["drafter_compiled_programs"] = (
+                self._drafter.compiled_programs())
         return out

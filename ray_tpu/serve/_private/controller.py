@@ -54,6 +54,10 @@ class _DeploymentState:
         self.target = spec["num_replicas"]
         self.status = "UPDATING"
         self.deleted = False
+        # prefix-affinity digests (ISSUE 18): replica key -> the digest
+        # its stats last reported; version bumps wake listen_for_digests
+        self.digests: Dict[str, Dict[str, Any]] = {}
+        self.digest_version = 0
         # serializes scale operations: delete (scale→0) racing the
         # reconcile loop (scale→target) would otherwise livelock,
         # alternately killing and recreating the same replica
@@ -78,10 +82,17 @@ class ServeController:
         # routers hold a listen_for_change call open; any replica-set
         # version bump wakes them
         self._change_event = asyncio.Event()
+        # separate event for digest pushes: digests churn far faster than
+        # replica sets and must not wake every replica-set listener
+        self._digest_event = asyncio.Event()
 
     def _notify_change(self) -> None:
         self._change_event.set()
         self._change_event = asyncio.Event()
+
+    def _notify_digest(self) -> None:
+        self._digest_event.set()
+        self._digest_event = asyncio.Event()
 
     async def _ensure_loop(self):
         if self._loop_task is None:
@@ -144,9 +155,37 @@ class ServeController:
             try:
                 await self._reconcile_once()
                 await self._autoscale()
+                await self._collect_digests()
             except Exception:
                 logger.exception("reconcile error")
             await asyncio.sleep(0.5)
+
+    async def _collect_digests(self):
+        """Pull each ready replica's prefix digest through its stats —
+        the controller POLLS, replicas never push (they make zero
+        control-plane RPCs in steady state); routers long-poll
+        ``listen_for_digests`` and only wake on real digest churn."""
+        for app in self._apps.values():
+            for st in app.values():
+                fresh: Dict[str, Dict[str, Any]] = {}
+                for holder in st.replicas:
+                    if not holder.ready:
+                        continue
+                    try:
+                        s = await asyncio.wait_for(
+                            holder.handle.stats.remote(), timeout=5)
+                    except Exception:
+                        continue
+                    d = s.get("prefix_digest") or {}
+                    if d:
+                        fresh[holder.handle._actor_id.hex()] = d
+                sig_old = {k: v.get("version")
+                           for k, v in st.digests.items()}
+                sig_new = {k: v.get("version") for k, v in fresh.items()}
+                if sig_new != sig_old:
+                    st.digests = fresh
+                    st.digest_version += 1
+                    self._notify_digest()
 
     async def _reconcile_once(self):
         for app in list(self._apps.values()):
@@ -394,6 +433,33 @@ class ServeController:
             if remaining <= 0:
                 return await self.get_replicas(app_name, deployment_name)
             ev = self._change_event
+            try:
+                await asyncio.wait_for(ev.wait(), timeout=remaining)
+            except asyncio.TimeoutError:
+                pass
+
+    async def get_digests(self, app_name: str, deployment_name: str):
+        st = self._apps.get(app_name, {}).get(deployment_name)
+        if st is None:
+            return {"version": -1, "digests": {}}
+        return {"version": st.digest_version, "digests": dict(st.digests)}
+
+    async def listen_for_digests(self, app_name: str, deployment_name: str,
+                                 known_version: int,
+                                 timeout_s: float = 30.0):
+        """Long-poll for prefix-affinity digests, mirroring
+        ``listen_for_change``: returns as soon as the digest version moves
+        past ``known_version`` (or unchanged state after ``timeout_s``)."""
+        deadline = asyncio.get_running_loop().time() + timeout_s
+        while True:
+            st = self._apps.get(app_name, {}).get(deployment_name)
+            version = st.digest_version if st is not None else -1
+            if version != known_version:
+                return await self.get_digests(app_name, deployment_name)
+            remaining = deadline - asyncio.get_running_loop().time()
+            if remaining <= 0:
+                return await self.get_digests(app_name, deployment_name)
+            ev = self._digest_event
             try:
                 await asyncio.wait_for(ev.wait(), timeout=remaining)
             except asyncio.TimeoutError:

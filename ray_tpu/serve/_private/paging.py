@@ -31,6 +31,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ray_tpu._private import flight
 from ray_tpu._private.metrics import Counter, Gauge
+from ray_tpu.serve._private.affinity import CHAIN_SEED, chain_hashes
 
 F_PREFIX_HIT = flight.intern("serve.prefix_hit")
 F_PAGE_ALLOC = flight.intern("serve.page_alloc")
@@ -148,16 +149,25 @@ class PageArena:
 
 class _RadixNode:
     __slots__ = ("tokens", "pages", "children", "parent", "refs",
-                 "last_used")
+                 "last_used", "hashes")
 
     def __init__(self, tokens: Tuple[int, ...], pages: List[int],
-                 parent: Optional["_RadixNode"]):
+                 parent: Optional["_RadixNode"],
+                 hashes: Optional[List[int]] = None):
         self.tokens = tokens          # this EDGE's token span
         self.pages = pages            # pages backing exactly that span
         self.children: Dict[int, "_RadixNode"] = {}  # first-token -> child
         self.parent = parent
         self.refs = 0                 # live slots holding this node
         self.last_used = 0.0
+        # per-page CHAIN hashes (affinity digest): hashes[i] commits to
+        # the whole root path through this node's page i. Parallel to
+        # ``pages``; splits slice it, never recompute it
+        self.hashes: List[int] = hashes if hashes is not None else []
+
+    def chain_end(self) -> int:
+        """The chain value new children extend from."""
+        return self.hashes[-1] if self.hashes else CHAIN_SEED
 
 
 class RadixCache:
@@ -182,6 +192,12 @@ class RadixCache:
         self._hits = 0
         self._misses = 0
         self._evicted_pages = 0
+        # affinity digest: count per chain hash (counts, not a set — two
+        # sibling subtrees can't share a chain value, but a hash that
+        # reappears after an evict/re-insert race must not flicker) and a
+        # version stamp the long-poll channel keys on
+        self._digest: Dict[int, int] = {}
+        self._digest_version = 0
 
     # ------------------------------------------------------------ match
 
@@ -261,12 +277,17 @@ class RadixCache:
         the refs (live slots reference the FULL path content)."""
         T = self.page_tokens
         upper = _RadixNode(tuple(node.tokens[:at]),
-                           node.pages[: at // T], node.parent)
+                           node.pages[: at // T], node.parent,
+                           hashes=node.hashes[: at // T])
         upper.last_used = node.last_used
         node.parent.children[upper.tokens[0]] = upper
         lower_tokens = tuple(node.tokens[at:])
         node.tokens = lower_tokens
         node.pages = node.pages[at // T:]
+        # chain hashes commit to the whole root path, so redistributing
+        # them across the split needs no recompute — the digest set is
+        # unchanged by a split
+        node.hashes = node.hashes[at // T:]
         node.parent = upper
         upper.children[lower_tokens[0]] = node
         return upper
@@ -296,9 +317,12 @@ class RadixCache:
         while rest:
             child, n = self._advance(node, rest, now)
             if child is None:
-                new = _RadixNode(tuple(rest), rest_pages, node)
+                new = _RadixNode(
+                    tuple(rest), rest_pages, node,
+                    hashes=chain_hashes(rest, T, seed=node.chain_end()))
                 new.last_used = now
                 node.children[rest[0]] = new
+                self._digest_add(new.hashes)
                 node = new
                 rest, rest_pages = [], []
                 break
@@ -356,6 +380,7 @@ class RadixCache:
                 if freed >= need_pages:
                     break
                 victim.parent.children.pop(victim.tokens[0])
+                self._digest_remove(victim.hashes)
                 self.arena.free(victim.pages)
                 freed += len(victim.pages)
                 self._evicted_pages += len(victim.pages)
@@ -366,6 +391,37 @@ class RadixCache:
         """Drop every unreferenced node (shutdown / tests); still-referenced
         nodes survive. Returns pages freed."""
         return self.evict(1 << 30)
+
+    # --------------------------------------------------------- digest
+
+    def _digest_add(self, hashes: List[int]) -> None:
+        for h in hashes:
+            self._digest[h] = self._digest.get(h, 0) + 1
+        if hashes:
+            self._digest_version += 1
+
+    def _digest_remove(self, hashes: List[int]) -> None:
+        for h in hashes:
+            n = self._digest.get(h, 0) - 1
+            if n <= 0:
+                self._digest.pop(h, None)
+            else:
+                self._digest[h] = n
+        if hashes:
+            self._digest_version += 1
+
+    def digest(self) -> Dict:
+        """Affinity digest snapshot (ISSUE 18): every page-boundary chain
+        hash currently resident, plus a version stamp. Maintained
+        incrementally by insert/evict/split — this is a dict-keys copy,
+        safe to call from the stats path at poll rates. Callers that ship
+        it off-process add tokenizer metadata (vocab_size / tok) so the
+        router can hash prompts the same way."""
+        return {
+            "page_tokens": self.page_tokens,
+            "hashes": list(self._digest.keys()),
+            "version": self._digest_version,
+        }
 
     # ---------------------------------------------------------- stats
 

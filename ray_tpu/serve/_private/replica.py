@@ -280,6 +280,29 @@ class ReplicaActor:
         except Exception:
             return 0.0
 
+    def _prefix_digest(self) -> Dict[str, Any]:
+        """Prefix-affinity digest (ISSUE 18), relayed through stats so the
+        controller's EXISTING poll carries it — replicas never originate a
+        control-plane RPC for affinity."""
+        probe = getattr(self._callable, "prefix_digest", None)
+        if callable(probe):
+            try:
+                return probe() or {}
+            except Exception:
+                return {}
+        return {}
+
+    async def export_prefix(self, tokens, timeout_s: float = 30.0):
+        """Migration pull entry (peer replica → this replica). The
+        callable's scheduler does the radix match + gather on its own
+        thread; run the blocking wait off the actor loop so health checks
+        and requests keep flowing during a large export."""
+        probe = getattr(self._callable, "export_prefix", None)
+        if not callable(probe):
+            return {"matched_len": 0, "page_tokens": 0, "k": [], "v": []}
+        return await asyncio.get_running_loop().run_in_executor(
+            None, lambda: probe(tokens, timeout_s=timeout_s))
+
     async def stats(self) -> Dict[str, Any]:
         # actively-consumed streams count as ongoing work for autoscaling;
         # abandoned ones must not pin the replica at scale. queue_depth
@@ -289,7 +312,8 @@ class ReplicaActor:
         return {"ongoing": self._ongoing + self._active_streams(),
                 "queue_depth": self._queue_depth(),
                 "total": self._total,
-                "uptime_s": time.time() - self._started}
+                "uptime_s": time.time() - self._started,
+                "prefix_digest": self._prefix_digest()}
 
     async def check_health(self) -> bool:
         if hasattr(self._callable, "check_health"):

@@ -78,7 +78,10 @@ class LLMServerImpl:
                  share_weights: bool = True,
                  weights_key: Optional[str] = None,
                  weights_bcast: Optional[Dict[str, Any]] = None,
-                 eos_id: Optional[int] = None):
+                 eos_id: Optional[int] = None,
+                 drafter: Optional[str] = None,
+                 spec_k: Optional[int] = None,
+                 migration_budget: Optional[int] = None):
         import jax
         import jax.numpy as jnp
 
@@ -145,6 +148,10 @@ class LLMServerImpl:
         self._tokenize = tokenize or partial(
             _byte_tokenize, vocab_size=self.cfg.vocab_size)
         self._detokenize = detokenize or _byte_detokenize
+        # the router can only steer (prefix affinity) on prompts it can
+        # tokenize itself — true for the reproducible byte tokenizer;
+        # custom tokenizers need explicit prompt_ids in the request
+        self._byte_tok = tokenize is None
         # jitted programs for the request-level baseline + legacy streaming
         self._prefill = jax.jit(partial(prefill, self.cfg))
         self._decode_step = jax.jit(partial(decode_step, self.cfg))
@@ -159,27 +166,84 @@ class LLMServerImpl:
         if scheduler == "continuous":
             from ray_tpu.serve._private.continuous import ContinuousScheduler
 
+            drafter_obj = self._build_drafter(drafter, slots, arena_len,
+                                              _weights)
             self._sched = ContinuousScheduler(
                 self.cfg, self.params, slots=slots,
                 prefill_chunk=prefill_chunk, arena_len=arena_len,
                 eos_id=eos_id, kv_layout=kv_layout,
                 page_tokens=page_tokens, kv_pages=kv_pages,
-                prefix_cache=prefix_cache)
+                prefix_cache=prefix_cache, drafter=drafter_obj,
+                spec_k=spec_k, migration_budget=migration_budget)
+        elif drafter:
+            raise ValueError(
+                "speculative decoding (drafter=...) requires "
+                "scheduler='continuous'")
+
+    def _build_drafter(self, drafter: Optional[str], slots, arena_len,
+                       _weights):
+        """Resolve the drafter knob (arg, else RAY_TPU_SERVE_DRAFTER; ""
+        = off) into a ``speculative.Drafter``. ``"self"`` reuses this
+        replica's own device params (zero extra weight memory, KV adopted
+        from the paged cache); any other name is a preset whose weights
+        come from the shared per-node arena like the target's
+        (``get_or_publish``) — a drafter must share the target's
+        vocabulary or its proposals would be meaningless token ids."""
+        import jax
+
+        from ray_tpu._private.config import global_config
+        from ray_tpu.models.transformer import init_params
+
+        conf = global_config()
+        name = conf.serve_drafter if drafter is None else drafter
+        if not name:
+            return None
+        slots_r = int(conf.serve_slots if slots is None else slots)
+        arena_r = int(self.cfg.max_seq_len if arena_len is None
+                      else arena_len)
+        if name == "self":
+            d_cfg, d_params, shares = self.cfg, self.params, True
+        else:
+            try:
+                d_cfg = getattr(presets, name)()
+            except AttributeError:
+                raise ValueError(f"unknown drafter preset {name!r}")
+            if d_cfg.vocab_size != self.cfg.vocab_size:
+                raise ValueError(
+                    f"drafter {name!r} vocab_size ({d_cfg.vocab_size}) != "
+                    f"target vocab_size ({self.cfg.vocab_size})")
+            d_host, self._drafter_weights_info = _weights.get_or_publish(
+                f"llm:{name}:seed0",
+                lambda: init_params(d_cfg, jax.random.PRNGKey(0)))
+            self._drafter_host_params = d_host
+            d_params = jax.device_put(d_host)
+            shares = False
+        if arena_r > d_cfg.max_seq_len:
+            raise ValueError(
+                f"drafter {name!r} max_seq_len ({d_cfg.max_seq_len}) is "
+                f"shorter than the serving arena ({arena_r})")
+        from ray_tpu.serve._private.speculative import Drafter
+
+        return Drafter(d_cfg, d_params, slots=slots_r, arena_len=arena_r,
+                       name=name, shares_target=shares)
 
     # ------------------------------------------------------- continuous
 
-    def _submit(self, ids: List[int], max_new: int, temperature: float):
+    def _submit(self, ids: List[int], max_new: int, temperature: float,
+                fleet_hint=None):
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
         self._seq_counter += 1
         seq = self._sched.submit(
             ids, max_new_tokens=max_new, temperature=temperature,
-            seed=self._seq_counter, loop=loop, queue=q)
+            seed=self._seq_counter, loop=loop, queue=q,
+            fleet_hint=fleet_hint)
         return seq, q
 
     async def _run_continuous(self, ids: List[int], max_new: int,
-                              temperature: float) -> List[int]:
-        seq, q = self._submit(ids, max_new, temperature)
+                              temperature: float,
+                              fleet_hint=None) -> List[int]:
+        seq, q = self._submit(ids, max_new, temperature, fleet_hint)
         toks: List[int] = []
         try:
             while True:
@@ -195,11 +259,11 @@ class LLMServerImpl:
             raise
 
     async def _stream_continuous(self, ids: List[int], max_new: int,
-                                 temperature: float):
+                                 temperature: float, fleet_hint=None):
         """Streaming = a consumer of the scheduler's per-slot token queue.
         Abandoning the generator (consumer gone) cancels the sequence,
         which retires its slot on the scheduler's next iteration."""
-        seq, q = self._submit(ids, max_new, temperature)
+        seq, q = self._submit(ids, max_new, temperature, fleet_hint)
         try:
             while True:
                 kind, val = await q.get()
@@ -290,15 +354,25 @@ class LLMServerImpl:
         if isinstance(request, str):
             request = {"prompt": request}
         prompt = request.get("prompt", "")
-        ids = self._tokenize(prompt)
+        if request.get("prompt_ids") is not None:
+            # explicit token ids (custom-tokenizer clients; also what the
+            # affinity router hashed, so steering and execution agree)
+            ids = [int(t) for t in request["prompt_ids"]]
+        else:
+            ids = self._tokenize(prompt)
         if not ids:
             raise ValueError("prompt must be non-empty")
         max_new = int(request.get("max_new_tokens", self.max_new_tokens))
         temperature = float(request.get("temperature", self.temperature))
+        # router-attached pull hint (fleet hit on another replica); only
+        # meaningful to the continuous scheduler
+        fleet_hint = request.get("_fleet_hint")
         if self._sched is not None:
             if request.get("stream"):
-                return self._stream_continuous(ids, max_new, temperature)
-            out_ids = await self._run_continuous(ids, max_new, temperature)
+                return self._stream_continuous(ids, max_new, temperature,
+                                               fleet_hint)
+            out_ids = await self._run_continuous(ids, max_new, temperature,
+                                                 fleet_hint)
         else:
             # the request-level path has no per-sequence cache bound of its
             # own (the continuous scheduler validates at submit): guard the
@@ -335,6 +409,30 @@ class LLMServerImpl:
         if self._sched is not None:
             return int(self._sched.stats().get("queue_depth", 0))
         return 0
+
+    def prefix_digest(self) -> Dict[str, Any]:
+        """The radix cache's chain-hash digest plus what the router needs
+        to hash prompts the same way (tokenizer kind + vocab). Empty when
+        there is nothing advertisable (batch scheduler, contiguous
+        layout, prefix cache off)."""
+        if self._sched is None:
+            return {}
+        probe = getattr(self._sched, "prefix_digest", None)
+        d = probe() if callable(probe) else {}
+        if d:
+            d = dict(d)
+            d["vocab_size"] = self.cfg.vocab_size
+            d["tok"] = "byte" if self._byte_tok else "opaque"
+        return d
+
+    def export_prefix(self, tokens: List[int],
+                      timeout_s: float = 30.0) -> Dict[str, Any]:
+        """Peer-replica migration pull: the longest cached prefix of
+        ``tokens`` as per-layer KV page arrays (replica→replica, never
+        through the controller)."""
+        if self._sched is None:
+            return {"matched_len": 0, "page_tokens": 0, "k": [], "v": []}
+        return self._sched.export_prefix(list(tokens), timeout_s=timeout_s)
 
     def weights_info(self) -> Dict[str, Any]:
         return dict(self._weights_info)
