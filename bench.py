@@ -249,6 +249,7 @@ def data_regime_main(regime: str) -> None:
     import numpy as np
 
     import ray_tpu
+    from ray_tpu.data._internal.exchange import ExchangeExecutor
     from ray_tpu.data._internal.streaming import StreamingExecutor
     from ray_tpu.models import gpt2_small
     from ray_tpu.models.training import (OptimizerConfig, init_train_state,
@@ -333,6 +334,59 @@ def data_regime_main(regime: str) -> None:
                 "bare_step_ms": round(step_dt * 1e3, 2),
                 "block_delay_ms": round(delay * 1e3, 2),
                 "steps": measured,
+                "batch": batch, "seq": seq,
+                **prov,
+            },
+        }
+        print(json.dumps(rec))
+
+        # -- second arm: the SAME throttled loader, but the plan ends in
+        # a seeded random_shuffle run on the streaming all-to-all
+        # exchange (producer stage -> R x C channel mesh -> consumer
+        # merge), fed to the trainer with the same ack-after-step
+        # contract. One loader lane keeps the regime semantics identical
+        # to the arm above: input_bound still offers 2x the trainer's
+        # demand, so its stall fraction stays large by construction.
+        ds2 = ray_tpu.data.range(
+            steps * batch, parallelism=steps).map_batches(
+            functools.partial(_feed_tokens_batch, cfg.vocab_size, seq,
+                              delay)).random_shuffle(seed=1)
+        # drop_last: the hash deal leaves ragged per-consumer tails and
+        # a jitted train step recompiles per shape — fixed [batch, seq]
+        # is the honest trainer-feeding contract
+        ex2 = ExchangeExecutor(ds2._ops, batch_size=batch, epochs=3,
+                               seed=0, num_producers=1, num_consumers=2,
+                               drop_last=True)
+        # a silent barrier fallback would report the wrong data path
+        assert ex2.is_channel_backed, "exchange arm is not channel-backed"
+        stall[0], last_end[0], n_steps[0] = 0.0, None, 0
+        t_first_end = None
+        try:
+            for _ in ex2.feed(train_step):
+                if t_first_end is None:
+                    t_first_end = last_end[0]
+                    stall[0] = 0.0
+                if n_steps[0] >= steps:
+                    break
+        finally:
+            ex2.shutdown()
+        total = max(last_end[0] - t_first_end, 1e-9)
+        measured = n_steps[0] - 1
+        ep_stats = ex2.epoch_stats
+        rec = {
+            "metric": "gpt2s_exchange_stall_fraction",
+            "value": round(stall[0] / total, 3),
+            "unit": "fraction",
+            "detail": {
+                "regime": regime,
+                "feed": "ExchangeExecutor.feed",
+                "mesh": "1x2",
+                "steps_per_sec": round(measured / total, 2),
+                "bare_step_ms": round(step_dt * 1e3, 2),
+                "block_delay_ms": round(delay * 1e3, 2),
+                "steps": measured,
+                "consumer_skew": (round(ep_stats[0]["skew"], 3)
+                                  if ep_stats else None),
                 "batch": batch, "seq": seq,
                 **prov,
             },

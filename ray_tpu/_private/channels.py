@@ -721,6 +721,25 @@ def plan_axis_placement(views, *, num_stages: int, dp: int = 1
              for s in range(num_stages)] for r in range(dp)]
 
 
+def plan_mesh_placement(views, *, num_producers: int, num_consumers: int
+                        ) -> "tuple[list[str], list[str]]":
+    """Node model for an R x C exchange mesh: (producer node_id_hex
+    list, consumer node_id_hex list). Producers and consumers each
+    round-robin across live nodes INDEPENDENTLY, so on a multi-node
+    cluster both roles spread (every node hosts producers and
+    consumers) and the R x C channel mesh splits its edges between
+    same-node seqlock hops and cross-node mirror pushes instead of
+    funneling every bucket through one host. Nodes are taken
+    alive-first in sorted-id order — deterministic for a given view."""
+    nodes = sorted(v["node_id_hex"] for v in views if v.get("alive", True))
+    if not nodes:
+        nodes = sorted(v["node_id_hex"] for v in views)
+    if not nodes:
+        raise RuntimeError("plan_mesh_placement: empty cluster view")
+    return ([nodes[r % len(nodes)] for r in range(num_producers)],
+            [nodes[c % len(nodes)] for c in range(num_consumers)])
+
+
 def resolve_actor_placement(core, actor_id, views=None, *,
                             expect_node_id_hex=None) -> dict:
     """Wait (bounded) for the actor to be ALIVE, then snapshot its

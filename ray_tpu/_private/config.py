@@ -188,6 +188,17 @@ class Config:
     # no shuffle, but an EXPLICIT RAY_TPU_DATA_SHUFFLE_BUFFER=0 raises at
     # build instead of silently meaning "off"
     data_shuffle_buffer: int = 0
+    # slot-ring depth of every exchange-mesh channel in the streaming
+    # all-to-all (data/_internal/exchange.py): how many bucket frames a
+    # producer may run ahead of each consumer — the shuffle's
+    # backpressure bound. Explicit RAY_TPU_DATA_EXCHANGE_DEPTH=0 raises
+    # at build (the PR-8/PR-9 falsy-zero lesson)
+    data_exchange_depth: int = 4
+    # max ROWS per bucket frame on an exchange edge: a (block, consumer)
+    # bucket larger than this streams as several frames, bounding the
+    # per-slot channel buffer independently of block size. Explicit
+    # RAY_TPU_DATA_EXCHANGE_BUCKET_ROWS=0 raises at build
+    data_exchange_bucket_rows: int = 4096
     # ---- Podracer RL topologies (rllib/podracer.py) ----
     # slot-ring depth of each runner->learner trajectory channel: how many
     # rollout batches a runner may stream ahead of its learner consuming

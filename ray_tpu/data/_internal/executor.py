@@ -18,6 +18,7 @@ reference's push-based shuffle (`_internal/planner/exchange/`).
 from __future__ import annotations
 
 import itertools
+import logging
 from collections import deque
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
@@ -28,6 +29,8 @@ from ray_tpu.data._internal.logical import (ActorPoolMap, AllToAll, InputData,
                                             Limit, LogicalOp, OneToOne, Read,
                                             Union, Zip, fuse_transforms)
 from ray_tpu.data.block import (Block, block_meta, concat_blocks, slice_block)
+
+logger = logging.getLogger(__name__)
 
 DEFAULT_CONCURRENCY = 8
 # memory-aware backpressure: a stage narrows its in-flight window when
@@ -317,6 +320,17 @@ class AllToAllStage:
         self.concurrency = concurrency
 
     def run(self, upstream) -> Iterator[RefMeta]:
+        if self.kind in ("shuffle", "repartition"):
+            # surfaced, never silent: this is the materializing slow
+            # path — seeded shuffle/repartition plans stream through the
+            # channel exchange via iter_batches(streaming=True) /
+            # streaming_split instead of stalling at this barrier
+            logger.info(
+                "AllToAll %r running as a task-executor BARRIER "
+                "(every upstream block materializes in the object "
+                "store); the streaming exchange "
+                "(data/_internal/exchange.py) runs it as channel "
+                "stages", self.kind)
         pairs = list(upstream)  # barrier: consume the whole upstream
         refs = [p[0] for p in pairs]
         metas = [resolve_meta(p[1]) for p in pairs]

@@ -172,21 +172,28 @@ def epoch_order(num_shards: int, seed: Optional[int],
     return rng.permutation(num_shards)
 
 
-def shuffle_rng(seed: Optional[int], epoch: int) -> np.random.Generator:
+def shuffle_rng(seed: Optional[int], epoch: int,
+                rank: int = 0) -> np.random.Generator:
     """The windowed-shuffle RNG of one epoch — shared by the batcher
     stage and the task-based baseline so shuffled epochs stay
     batch-for-batch identical. An explicit seed is REQUIRED: silently
     substituting a fixed seed would make every "unseeded" run's shuffle
     bit-identical across restarts (worse than no shuffle entropy), and
     substituting fresh entropy would break the streaming/task parity
-    contract."""
+    contract.
+
+    ``rank``: the exchange's per-consumer stream index — each consumer
+    rank draws an independent rng stream. rank 0 keeps the original key
+    (single-batcher sequences are unchanged)."""
     if seed is None:
         raise ValueError(
             "the windowed shuffle buffer needs an explicit seed "
             "(pass seed=/local_shuffle_seed=; the shuffle is derived "
             "per-epoch from (seed, epoch))")
-    return np.random.default_rng(
-        [int(seed) & 0x7FFFFFFF, 0xBA7C, int(epoch)])
+    key = [int(seed) & 0x7FFFFFFF, 0xBA7C, int(epoch)]
+    if rank:
+        key.append(int(rank))
+    return np.random.default_rng(key)
 
 
 # --------------------------------------------- numpy-batch stream plumbing

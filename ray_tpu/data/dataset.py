@@ -12,6 +12,7 @@ by host Arrow blocks + async device transfer (SURVEY §5 backend note).
 from __future__ import annotations
 
 import builtins
+import logging
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Union as TUnion
 
 import numpy as np
@@ -25,6 +26,8 @@ from ray_tpu.data._internal.executor import (DEFAULT_CONCURRENCY,
 from ray_tpu.data.block import (Block, batch_to_block, block_meta,
                                 block_rows, block_to_batch, even_cuts)
 from ray_tpu.data.iterator import DataIterator, _BlockStreamIterator
+
+logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass
@@ -232,7 +235,20 @@ class Dataset:
         batches for ``epochs`` passes, the shard order re-seeded per
         epoch; the iterator's ``.epoch_stats`` carries per-epoch stall
         and RPC accounting and ``.executor`` exposes ``feed()`` for
-        handing batches to a trainer without a copy."""
+        handing batches to a trainer without a copy.
+
+        A plan ending in a seeded ``random_shuffle()`` or
+        ``repartition()`` compiles onto the streaming ALL-TO-ALL
+        exchange (`data/_internal/exchange.py`): R producers partition
+        rows into per-consumer bucket frames over an R x C channel mesh
+        instead of a task-executor barrier. An all-to-all plan the
+        exchange can't run (unseeded shuffle, sort/groupby, chained
+        barriers) RAISES with the reason — the barrier path stays
+        available via iter_batches without streaming=True, never as a
+        silent fallback."""
+        from ray_tpu.data._internal import logical as _L
+        from ray_tpu.data._internal.exchange import (
+            ExchangeBatches, exchange_incompatible_reason)
         from ray_tpu.data._internal.streaming import StreamingBatches
 
         if depth is None and prefetch_batches is not None \
@@ -242,6 +258,19 @@ class Dataset:
             # task-path default of 1 means "default depth" here. An
             # explicit 0 on either raises inside (the falsy-zero lesson)
             depth = prefetch_batches
+        if any(isinstance(op, _L.AllToAll) for op in self._ops):
+            reason = exchange_incompatible_reason(self._ops)
+            if reason is not None:
+                raise ValueError(
+                    f"streaming execution of this all-to-all plan is "
+                    f"not supported: {reason}; run it on the "
+                    f"task-based executor (iter_batches without "
+                    f"streaming=True)")
+            return ExchangeBatches(
+                self._ops, batch_size=batch_size, epochs=epochs,
+                seed=seed, shuffle_buffer=shuffle_buffer,
+                num_producers=num_readers, depth=depth,
+                drop_last=drop_last, **kw)
         return StreamingBatches(
             self._ops, batch_size=batch_size, epochs=epochs, seed=seed,
             shuffle_buffer=shuffle_buffer, num_readers=num_readers,
@@ -358,14 +387,41 @@ class Dataset:
         ]
 
     def streaming_split(self, n: int, *, equal: bool = False,
-                        locality_hints=None) -> List[DataIterator]:
+                        locality_hints=None, epochs: int = 1,
+                        seed: Optional[int] = 0) -> List[DataIterator]:
         """n iterators fed by one shared streaming execution
         (reference: Dataset.streaming_split / _StreamSplitDataIterator).
-        Blocks are handed out first-come-first-served by a coordinator
-        actor, so faster consumers do more work."""
-        from ray_tpu.data.iterator import (_SplitCoordinator,
+
+        A plan ending in a seeded ``random_shuffle()``/``repartition()``
+        compiles onto the streaming all-to-all exchange: n consumer
+        stages each own one output channel and every iterator reads its
+        own rank's stream — deterministic partition-assigned splits
+        (exact vs the task baseline at the same seed), with
+        ``locality_hints`` (node_id_hex per rank) steering each
+        consumer onto the node its reader lives on. Other plans are fed
+        first-come-first-served by a coordinator actor (faster
+        consumers do more work — the dynamic-balancing path); an
+        all-to-all plan the exchange can't run (unseeded shuffle,
+        sort/groupby) falls back to the coordinator WITH a logged
+        reason, never silently."""
+        from ray_tpu.data._internal.exchange import (
+            ExchangeExecutor, exchange_incompatible_reason)
+        from ray_tpu.data.iterator import (_ExchangeSplitIterator,
+                                           _SplitCoordinator,
                                            _StreamSplitIterator)
 
+        if any(isinstance(op, L.AllToAll) for op in self._ops):
+            reason = exchange_incompatible_reason(self._ops)
+            if reason is None:
+                ex = ExchangeExecutor(
+                    self._ops, batch_size=None, epochs=epochs, seed=seed,
+                    num_consumers=n, locality_hints=locality_hints)
+                return [_ExchangeSplitIterator(ex, rank=i)
+                        for i in builtins.range(n)]
+            logger.warning(
+                "streaming_split falling back to the coordinator-fed "
+                "task executor (all-to-all runs as a BARRIER): %s",
+                reason)
         coord = ray_tpu.remote(_SplitCoordinator).options(
             num_cpus=0.1).remote(self._ops, self._concurrency, n, equal)
         return [_StreamSplitIterator(coord, rank=i) for i in builtins.range(n)]

@@ -323,6 +323,39 @@ class _SplitCoordinator:
             self._returned.append(entry[0])
 
 
+class _ExchangeSplitIterator(DataIterator):
+    """One rank of a streaming-split over the all-to-all exchange
+    (`data/_internal/exchange.py`): this iterator reads its own
+    consumer's output channel — deterministic partition-assigned
+    splits (rank c gets exactly the rows
+    ``exchange_assignments(...) == c``, exact vs the task baseline at
+    the same seed), where the coordinator-fed split is
+    first-come-first-served. Each ``iter_batches``/``_block_iter`` call
+    consumes the NEXT epoch of the shared executor (built with
+    ``epochs=``); ``close()`` tears the whole mesh down for every rank
+    (the executor is shared)."""
+
+    def __init__(self, executor, rank: int):
+        self._ex = executor
+        self._rank = rank
+
+    @property
+    def executor(self):
+        return self._ex
+
+    def _block_iter(self) -> Iterator[Block]:
+        from ray_tpu.data.block import batch_to_block
+
+        for b in self._ex.rank_epoch(self._rank):
+            yield batch_to_block(b)
+
+    def stats(self) -> List[dict]:
+        return self._ex.rank_epoch_stats(self._rank)
+
+    def close(self) -> None:
+        self._ex.shutdown()
+
+
 class _StreamSplitIterator(DataIterator):
     def __init__(self, coordinator, rank: int):
         self._coord = coordinator

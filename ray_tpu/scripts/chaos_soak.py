@@ -999,6 +999,162 @@ def run_data_chaos(
         chaos.reset()
 
 
+def run_shuffle_chaos(
+    seed: int,
+    *,
+    drop_prob: float = 0.02,
+    dup_prob: float = 0.05,
+    delay_prob: float = 0.05,
+    delay_max_ms: int = 20,
+    kills: bool = True,
+) -> None:
+    """One seeded chaos run against the streaming all-to-all exchange
+    (`data/_internal/exchange.py`).
+
+    Builds a 2-node cluster with the R producers opposite the driver
+    and the C consumers SPLIT across both nodes, so the R x C mesh
+    carries both edge kinds at once: producer->consumer bucket frames
+    into the driver-side consumer cross the wire, and the far-side
+    consumer's batch channel back to the driver crosses it the other
+    way — all chunked small (``bucket_rows`` under the per-bucket row
+    count + 2 KiB transfer chunks) so every bucket streams several
+    attacked ``channel_write_chunk`` + ``channel_commit`` frames. Two
+    full shuffled epochs must match the task-based barrier AllToAll's
+    batches EXACTLY at the same seed — chaos may cost retries, never a
+    wrong, reordered, or mis-bucketed batch (absolute slot-ring
+    versions make dropped/duplicated push frames converge). With
+    ``kills``, a mesh participant is then hard-killed mid-shuffle —
+    even seeds a PRODUCER, odd seeds a CONSUMER — and the whole mesh
+    must close: the driver surfaces a clean ChannelClosedError/
+    ActorDiedError (never a hang, never a silently truncated epoch)
+    and the channel pins must return to baseline.
+    """
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private import chaos
+    from ray_tpu._private.chaos import FaultController
+    from ray_tpu._private.config import Config
+    from ray_tpu.cluster_utils import Cluster
+
+    cfg = Config.from_env()
+    cfg.chaos_seed = seed
+    cfg.chaos_drop_prob = drop_prob
+    cfg.chaos_dup_prob = dup_prob
+    cfg.chaos_delay_prob = delay_prob
+    cfg.chaos_delay_max_ms = delay_max_ms
+    cfg.chaos_methods = CHAOS_METHODS
+    # bucket frames stream as several chunk frames per push
+    cfg.object_transfer_chunk_bytes = 2048
+
+    cluster = Cluster(config=cfg)
+    try:
+        cluster.add_node(num_cpus=4, resources={"n0": 100})
+        cluster.add_node(num_cpus=4, resources={"n1": 100})
+        cluster.wait_for_nodes(2)
+        ray_tpu.init(address=cluster.address)
+        chaos.set_fault_controller(FaultController(
+            seed=seed, drop_prob=drop_prob, dup_prob=dup_prob,
+            delay_prob=delay_prob, delay_max_ms=delay_max_ms,
+            methods=CHAOS_METHODS))
+
+        from ray_tpu import data as rd
+        from ray_tpu._private import api as _api
+        from ray_tpu._private.exceptions import (ActorDiedError,
+                                                 ChannelClosedError,
+                                                 TaskError)
+        from ray_tpu.data._internal import exchange as dx
+
+        @ray_tpu.remote
+        def _where():
+            from ray_tpu._private import api
+
+            return tuple(api._core.supervisor_addr)
+
+        core = _api._core
+        n0_addr = ray_tpu.get(
+            _where.options(resources={"n0": 1}).remote(), timeout=60)
+        here = "n0" if tuple(core.supervisor_addr) == n0_addr else "n1"
+        there = "n1" if here == "n0" else "n0"
+
+        def store_pins():
+            stats = core._run(core.clients.get(core.supervisor_addr).call(
+                "store_stats", timeout=60))
+            return stats["pins_total"]
+
+        base_seed = 100 + seed
+        d = rd.range(600, parallelism=12).map_batches(
+            _data_chaos_transform).random_shuffle(seed=200 + seed)
+        R = C = 2
+        stage_kw = dict(
+            producer_options=[{"resources": {there: 1}}] * R,
+            consumer_options=[{"resources": {here: 1}},
+                              {"resources": {there: 1}}])
+
+        pins_before = store_pins()
+        ex = dx.ExchangeExecutor(
+            d._ops, batch_size=40, epochs=2, seed=base_seed,
+            num_producers=R, num_consumers=C, bucket_rows=16, **stage_kw)
+        assert ex.is_channel_backed and ex.channel_depth > 1, (
+            "shuffle chaos run is not on the slot-ring channel mesh")
+        got = [[], []]
+        for b in ex.batches():
+            got[len(ex.epoch_stats)].append(b)
+        for epoch, act in enumerate(got, start=1):
+            exp = list(dx.task_exchange_batches(
+                d._ops, batch_size=40, num_consumers=C, epoch=epoch,
+                seed=base_seed))
+            assert len(exp) == len(act), (
+                f"epoch {epoch}: {len(act)} exchanged batches != "
+                f"{len(exp)} from the barrier baseline")
+            for i, (e, a) in enumerate(zip(exp, act)):
+                for k in e:
+                    assert np.array_equal(e[k], a[k]), (
+                        f"epoch {epoch} batch {i} column {k}: the "
+                        f"exchange diverged from the barrier baseline — "
+                        f"chaos corrupted the shuffle")
+        ex.shutdown()
+        _drain_pins_to_baseline(pins_before)
+
+        if kills:
+            # participant hard-kill MID-SHUFFLE: the mesh is one
+            # dataflow, so killing EITHER role must close every channel
+            # and fail the in-flight epoch clean — never truncate it
+            ex = dx.ExchangeExecutor(
+                d._ops, batch_size=8, epochs=50, seed=base_seed,
+                num_producers=R, num_consumers=C, depth=2,
+                bucket_rows=16, **stage_kw)
+            it = ex.batches()
+            for _ in range(3):
+                next(it)
+            victim = (ex._producers[(seed // 2) % R] if seed % 2 == 0
+                      else ex._consumers[(seed // 2) % C])
+            ray_tpu.kill(victim)
+            try:
+                deadline = time.monotonic() + 120
+                while time.monotonic() < deadline:
+                    next(it)
+                raise AssertionError(
+                    "exchange kept yielding past a dead participant")
+            except (ChannelClosedError, ActorDiedError, TaskError) as e:
+                msg = str(e).lower()
+                assert ("closed" in msg or "dead" in msg or "died" in msg
+                        or isinstance(e, (ActorDiedError, TaskError))), (
+                    f"unclean error after mesh participant kill: {e!r}")
+            except StopIteration:
+                raise AssertionError(
+                    "exchange ended silently after a mid-shuffle kill")
+            ex.shutdown()
+            _drain_pins_to_baseline(pins_before)
+    finally:
+        chaos.set_fault_controller(None)  # calm teardown
+        _maybe_flight_dump()  # before shutdown, while dumps exist
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        cluster.shutdown()
+        chaos.reset()
+
+
 def run_podracer_chaos(
     seed: int,
     *,
@@ -2420,6 +2576,12 @@ def _run_one(seed: int, args) -> None:
             drop_prob=args.drop, dup_prob=args.dup, delay_prob=args.delay,
             delay_max_ms=args.delay_max_ms, kills=not args.no_kills)
         return
+    if args.shuffle:
+        run_shuffle_chaos(
+            seed,
+            drop_prob=args.drop, dup_prob=args.dup, delay_prob=args.delay,
+            delay_max_ms=args.delay_max_ms, kills=not args.no_kills)
+        return
     if args.collective_overlap:
         run_collective_overlap_chaos(
             seed,
@@ -2448,6 +2610,14 @@ def _run_one(seed: int, args) -> None:
             seed,
             drop_prob=args.drop, dup_prob=args.dup, delay_prob=args.delay,
             delay_max_ms=args.delay_max_ms)
+    if not args.no_shuffle:
+        # the streaming all-to-all joined the default sweep (ISSUE 19):
+        # every default seed also attacks the exchange mesh (parity vs
+        # the barrier baseline + a producer/consumer kill by seed parity)
+        run_shuffle_chaos(
+            seed,
+            drop_prob=args.drop, dup_prob=args.dup, delay_prob=args.delay,
+            delay_max_ms=args.delay_max_ms, kills=not args.no_kills)
 
 
 def main() -> int:
@@ -2488,6 +2658,21 @@ def main() -> int:
                              "two shuffled epochs must match the task-based "
                              "loader's batches EXACTLY, a mid-epoch reader "
                              "kill must fail clean and unwind pins")
+    parser.add_argument("--shuffle", action="store_true",
+                        help="attack the streaming all-to-all exchange "
+                             "(ISSUE 19): an R x C producer/consumer "
+                             "mesh split across 2 nodes, bucket frames "
+                             "as small chunked pushes under "
+                             "drop/dup/delay; two shuffled epochs must "
+                             "match the barrier AllToAll baseline "
+                             "EXACTLY, then a mid-shuffle kill (even "
+                             "seeds a producer, odd seeds a consumer) "
+                             "must close the whole mesh clean and "
+                             "unwind pins")
+    parser.add_argument("--no-shuffle", action="store_true",
+                        help="default workload only: skip the exchange "
+                             "scenario that joined the default sweep "
+                             "with ISSUE 19")
     parser.add_argument("--flight-dump", default="",
                         help="directory for a merged flight-recorder "
                              "timeline (Perfetto JSON) per seed; a red "
@@ -2571,6 +2756,12 @@ def main() -> int:
             child.append("--no-controller-restart")
         if args.no_preempt:
             child.append("--no-preempt")
+        if args.no_shuffle:
+            child.append("--no-shuffle")
+        if args.shuffle:
+            child.append("--shuffle")
+        if args.data:
+            child.append("--data")
         if args.controller:
             child.append("--controller")
         if args.preempt:

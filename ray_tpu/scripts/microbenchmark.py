@@ -899,6 +899,77 @@ def run_all(budget_s: float = 2.0) -> List[Dict[str, float]]:
     finally:
         d_ex.shutdown()
 
+    # -- streaming all-to-all exchange: a seeded shuffle through the
+    # R x C channel mesh vs the SAME shuffle as a task-executor barrier
+    # AllToAll at identical semantics (same partition assignments, same
+    # consumer shuffle/batch streams, same driver merge order — exact
+    # batch parity is test-proven, so the ratio isolates the barrier's
+    # cost: every block materialized + one split task per block + per-
+    # bucket gathers vs streamed bucket frames). Acceptance bar: >= 3x.
+    from ray_tpu.data._internal import exchange as dexch
+
+    dx_ds = d_ds.random_shuffle(seed=1)
+    dx_C = 2
+
+    def data_barrier_epoch():
+        n = 0
+        for _ in dexch.task_exchange_batches(
+                dx_ds._ops, batch_size=d_bs, num_consumers=dx_C,
+                epoch=1, seed=0):
+            n += 1
+        # the hash deal is uneven, so each consumer's ragged tail can
+        # add a batch over the uniform count
+        assert d_epoch_batches <= n <= d_epoch_batches + dx_C
+        return n
+
+    # the barrier epoch is seconds-scale; at smoke budgets one epoch IS
+    # the warmup and the measurement
+    data_barrier_rate = _rate(data_barrier_epoch, budget_s,
+                              warmup=1 if full_data else 0)
+    record("data_shuffle_barrier_batches_per_sec", data_barrier_rate,
+           unit="batches/s")
+
+    dstream.quiesce_driver_rpcs()
+    dx_ex = dexch.ExchangeExecutor(
+        dx_ds._ops, batch_size=d_bs, epochs=100_000, seed=0,
+        num_producers=2, num_consumers=dx_C)
+    # a silent barrier fallback would score ~1x and vacuously pass a
+    # "no worse" gate — the probe must be ON the channel mesh
+    assert dx_ex.is_channel_backed, (
+        "shuffle exchange probe is not channel-backed")
+    assert dx_ex.channel_depth > 1, (
+        f"exchange channels at depth {dx_ex.channel_depth}; the "
+        f"backpressure bound needs a slot ring")
+    try:
+        dx_it = dx_ex.batches()
+        while len(dx_ex.epoch_stats) < 1:  # epoch 1 absorbs spin-up
+            next(dx_it)
+        t0 = time.perf_counter()
+        n = 0
+        while time.perf_counter() - t0 < budget_s:
+            next(dx_it)
+            n += 1
+        data_exchange_rate = n / (time.perf_counter() - t0)
+        # steady-state proof: warm exchange epochs carry zero
+        # control-plane RPCs on every producer, consumer and the driver
+        while len(dx_ex.epoch_stats) < 3:
+            next(dx_it)
+        for st in dx_ex.epoch_stats[-2:]:
+            assert st["consumer_rpc_calls"] == 0, st
+            for rep in st["stage_reports"]:
+                assert rep["rpc_calls"] == 0, (
+                    "steady exchange epoch issued control-plane RPCs",
+                    rep)
+        record("data_exchange_batches_per_sec", data_exchange_rate,
+               unit="batches/s")
+        results.append({"benchmark": "data_shuffle_streaming_vs_barrier",
+                        "value": round(
+                            data_exchange_rate
+                            / max(data_barrier_rate, 1e-9), 2),
+                        "unit": "x"})
+    finally:
+        dx_ex.shutdown()
+
     # -- collectives: 4-rank host-backend allreduce. The p2p data plane
     # (same-node: shared-memory channel rounds, zero steady-state control
     # RPCs) against the legacy controller-KV rounds (every rank's full

@@ -214,7 +214,9 @@ class TestStreamingSurface:
     def test_unsupported_plans_raise(self, ray_init):
         with pytest.raises(ValueError, match="Read source"):
             rd.from_items([{"a": 1}]).stream_batches(batch_size=1)
-        with pytest.raises(ValueError, match="read->map"):
+        # all-to-all plans now compile onto the streaming exchange —
+        # the UNSEEDED shuffle is what still (loudly) refuses to stream
+        with pytest.raises(ValueError, match="unseeded"):
             rd.range(10).random_shuffle().stream_batches(batch_size=2)
         with pytest.raises(ValueError, match="read->map"):
             rd.range(10).limit(5).stream_batches(batch_size=2)
