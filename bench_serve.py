@@ -269,13 +269,15 @@ def run_loadgen(mode: str, preset: str, rate_rps: float, n: int, seed: int,
                 kv_layout: str = "contiguous",
                 prefix_cache: bool = False,
                 prefix_len: int = 224, max_seq_len: int = 256,
-                kv_pages: int = 0) -> dict:
+                kv_pages: int = 0, attn: str = None) -> dict:
     """One open-loop run against a directly-instantiated replica callable
     (the serve path minus transport: scheduler + jitted programs — what
     the ISSUE-9/13 comparisons are about). mode: "continuous" | "batch";
     workload: "mixed" (ISSUE 9) | "prefix" (ISSUE 13 Zipf shared-prefix);
     kv_layout/prefix_cache select the paged arena + radix cache vs the
-    PR-9 contiguous arena (continuous mode only)."""
+    PR-9 contiguous arena (continuous mode only); attn selects the paged
+    attention lane (ISSUE 20: in-place "reference"/"pallas" vs the
+    gathered-view "gather" baseline; None = the config default)."""
     from ray_tpu.serve.llm import LLMServerImpl
 
     kw = {}
@@ -284,6 +286,8 @@ def run_loadgen(mode: str, preset: str, rate_rps: float, n: int, seed: int,
               "prefix_cache": prefix_cache if kv_layout == "paged" else None}
         if kv_layout == "paged" and kv_pages:
             kw["kv_pages"] = kv_pages
+        if kv_layout == "paged" and attn is not None:
+            kw["attn"] = attn
     if workload == "prefix":
         # the shared preambles need a context window wider than the debug
         # preset's 128 (production few-shot preambles dwarf the tails);
@@ -586,7 +590,7 @@ def loadgen_main(args) -> None:
     paged = run_loadgen("continuous", args.preset, args.prefix_rate,
                         args.requests, args.seed, workload="prefix",
                         kv_layout="paged", prefix_cache=True,
-                        kv_pages=pool, **common)
+                        kv_pages=pool, attn=args.attn, **common)
     log("PR-9 contiguous continuous (zipf shared-prefix workload) ...")
     cont_p = run_loadgen("continuous", args.preset, args.prefix_rate,
                          args.requests, args.seed, workload="prefix",
@@ -628,6 +632,42 @@ def loadgen_main(args) -> None:
                     "continuous_p50_ttft_ms": cont_p["ttft_ms"]["p50"],
                     **pfx_detail, **prov}},
     ]
+
+    # ---- ISSUE-20: paged attention lane, in-place vs gathered-view ----
+    # the SAME paged scheduler + radix cache + offered load, only the
+    # attention lane differs: the in-place lane attends through the page
+    # table, the gather baseline materializes every slot's provisioned
+    # logical view per layer per step. attn_bytes_moved in the detail is
+    # the audit trail — the gather arm's traffic tracks provisioning
+    lane = paged["scheduler"]["attn_lane"]
+    if lane != "gather":
+        log("paged+prefix continuous, gathered-view attn lane "
+            "(measured baseline) ...")
+        paged_g = run_loadgen("continuous", args.preset, args.prefix_rate,
+                              args.requests, args.seed, workload="prefix",
+                              kv_layout="paged", prefix_cache=True,
+                              kv_pages=pool, attn="gather", **common)
+        assert paged_g["scheduler"]["attn_lane"] == "gather", (
+            "gather arm resolved the wrong lane")
+        lane_speedup = paged["tokens_per_sec"] / max(
+            paged_g["tokens_per_sec"], 1e-9)
+        records += [
+            {"metric": "serve_loadgen_paged_gather_tokens_per_sec",
+             "value": paged_g["tokens_per_sec"], "unit": "tokens/s",
+             "detail": {**paged_g, **pfx_detail, **prov}},
+            {"metric": "serve_paged_attn_lane_speedup",
+             "value": round(lane_speedup, 2), "unit": "x",
+             "detail": {"vs": "gathered-view lane, same paged scheduler "
+                              "and offered load",
+                        "attn_lane": lane,
+                        "inplace_attn_bytes_moved":
+                            paged["scheduler"]["attn_bytes_moved"],
+                        "gather_attn_bytes_moved":
+                            paged_g["scheduler"]["attn_bytes_moved"],
+                        "inplace_p99_ttft_ms": paged["ttft_ms"]["p99"],
+                        "gather_p99_ttft_ms": paged_g["ttft_ms"]["p99"],
+                        **pfx_detail, **prov}},
+        ]
 
     # ---- ISSUE-9 continuity: mixed workload, continuous vs batch ----
     # (the PR-9 record, re-measured on the PR-9 contiguous arena: the
@@ -737,6 +777,12 @@ def main(argv=None) -> None:
     ap.add_argument("--max-seq-len", type=int, default=256,
                     help="context-window override for the prefix workload "
                          "(preamble + tail + budget must fit)")
+    ap.add_argument("--attn", default=None,
+                    choices=["auto", "pallas", "reference", "gather"],
+                    help="paged attention lane for the paged loadgen arm "
+                         "(default: the RAY_TPU_SERVE_PAGED_ATTN config "
+                         "default); when it resolves in-place, a gather-"
+                         "lane arm runs too for the ISSUE-20 comparison")
     ap.add_argument("--json-out", default="",
                     help="also write the full loadgen suite to this file")
     ap.add_argument("--concurrency", type=int, default=16)

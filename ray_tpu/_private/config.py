@@ -147,6 +147,15 @@ class Config:
     # shares a cached prefix by page-table splice + cursor jump instead of
     # re-prefilling. Requires the paged layout
     serve_prefix_cache: bool = True
+    # paged-attention lane of the decode/verify/prefill programs (paged
+    # layout only): "auto" = the in-place lane (Pallas kernel on TPU, its
+    # pure-JAX twin elsewhere — attention reads KV pages straight from the
+    # pool, no gathered view); "pallas"/"reference" force one in-place
+    # impl; "gather" keeps the original gathered-view + scatter-back
+    # programs (the measured baseline, selectable like
+    # collective_algo="kv"). Unknown/falsy values ("0", "") are REJECTED
+    # at scheduler build — never a silent fallback
+    serve_paged_attn: str = "auto"
     # ---- serve: fleet phase 2 (ISSUE 18) ----
     # prefix-affinity routing: replicas advertise a digest of their radix
     # cache's page-boundary prefix hashes through the controller's stats

@@ -18,7 +18,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ray_tpu.models.transformer import TransformerConfig, forward
+from ray_tpu.models.transformer import (TransformerConfig, _mlp, _norm,
+                                        forward)
+from ray_tpu.ops.paged_attention import paged_attention
+from ray_tpu.ops.rotary import apply_rotary, rope_frequencies
 
 
 @jax.tree_util.register_dataclass
@@ -288,20 +291,121 @@ def _gather_row(c: PagedKVCache, table):
             c.v[table].reshape(1, P * T, H, D))
 
 
+# attention lanes for the paged programs (ISSUE 20). "gather" is the
+# measured-baseline gathered-view path (the original ISSUE-13 programs,
+# kept selectable like collective_algo="kv" — never a silent fallback);
+# "reference"/"pallas" are the in-place lanes: each layer writes the new
+# tokens' k/v straight into their pages and attends THROUGH the page table
+# (ops/paged_attention.py), so no contiguous [arena_len] view ever exists
+# and step cost tracks allocated pages, not pool provisioning.
+PAGED_ATTN_LANES = ("gather", "reference", "pallas")
+
+
+def _check_attn_lane(attn: str) -> None:
+    if attn not in PAGED_ATTN_LANES:
+        raise ValueError(
+            f"unknown paged attention lane {attn!r}; expected one of "
+            f"{list(PAGED_ATTN_LANES)}")
+
+
+def _layer_params(cfg: TransformerConfig, params, i: int):
+    if cfg.scan_layers:
+        return jax.tree.map(lambda a, i=i: a[i], params["blocks"])
+    return params["blocks"][str(i)]
+
+
+def _paged_forward_inplace(cfg: TransformerConfig, params, tokens, positions,
+                           lengths, read_tables, write_tables, caches, impl,
+                           advance):
+    """The in-place twin of the gathered-view programs: one K-token-window
+    forward over all S slots where each layer (1) writes the window's k/v
+    DIRECTLY into its pages — ``pool.at[page, offset].set`` through the
+    write table, write-before-attend, so XLA updates the donated pool in
+    place — and (2) attends through the page table via
+    ``ops.paged_attention`` (no ``_gather_row`` view, no whole-page
+    scatter-back). Layer math mirrors ``transformer._block`` exactly.
+
+    tokens/positions: [S, K]; lengths: [S] attention cursors;
+    read_tables/write_tables: [S, P]. ``advance(lengths)`` maps one
+    layer's cursor buffer to its updated value (each layer must return
+    its OWN buffer — the callers donate caches, and a shared buffer would
+    be donated once per layer). Positions on unallocated/shared pages
+    redirect to the garbage page through the write table, same contract
+    as the scatter-back lane. Returns (logits [S, K, vocab], caches)."""
+    T = caches[0].k.shape[1]
+    P = read_tables.shape[1]
+    x = params["embed"]["table"].astype(cfg.dtype)[tokens]
+    if cfg.pos == "learned":
+        x = x + params["pos_embed"]["table"].astype(cfg.dtype)[positions]
+        rope = None
+    else:
+        rope = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
+                                cfg.rope_theta)
+    pages = jnp.take_along_axis(
+        write_tables, jnp.clip(positions // T, 0, P - 1), axis=1)
+    offs = positions % T
+    new_caches = []
+    for i in range(cfg.num_layers):
+        p = _layer_params(cfg, params, i)
+        c = caches[i]
+        h = _norm(cfg, p["ln1"], x)
+        ap = p["attn"]
+        q = jnp.einsum("bsd,dhk->bshk", h, ap["wq"].astype(cfg.dtype))
+        k = jnp.einsum("bsd,dhk->bshk", h, ap["wk"].astype(cfg.dtype))
+        v = jnp.einsum("bsd,dhk->bshk", h, ap["wv"].astype(cfg.dtype))
+        if rope is not None:
+            cos, sin = rope
+            q = apply_rotary(q, cos, sin, positions)
+            k = apply_rotary(k, cos, sin, positions)
+        ck = c.k.at[pages, offs].set(k.astype(c.k.dtype))
+        cv = c.v.at[pages, offs].set(v.astype(c.v.dtype))
+        o = paged_attention(q, ck, cv, read_tables, lengths, impl=impl)
+        x = x + jnp.einsum("bshk,hkd->bsd", o, ap["wo"].astype(cfg.dtype))
+        m, _ = _mlp(cfg, p["mlp"], _norm(cfg, p["ln2"], x))
+        x = x + m
+        new_caches.append(PagedKVCache(k=ck, v=cv,
+                                       lengths=advance(c.lengths)))
+    x = _norm(cfg, params["final_norm"], x)
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("bsd,vd->bsv", x,
+                            params["embed"]["table"].astype(cfg.dtype))
+    else:
+        logits = jnp.einsum("bsd,dv->bsv", x,
+                            params["lm_head"]["kernel"].astype(cfg.dtype))
+    return logits, new_caches
+
+
 def paged_prefill_into_slot(cfg: TransformerConfig, params, tokens, real_len,
                             slot, read_row, write_row,
-                            caches: List[PagedKVCache]):
-    """``prefill_into_slot`` through a page table: gather the slot's
-    logical view from the pool, run the identical chunk forward, scatter
-    the view back through ``write_row``. read_row/write_row: [P] int32 —
-    shared (prefix-cache) pages appear in read_row but are redirected to
-    the garbage page in write_row, so their content is immutable here.
+                            caches: List[PagedKVCache], *,
+                            attn: str = "gather"):
+    """``prefill_into_slot`` through a page table. read_row/write_row: [P]
+    int32 — shared (prefix-cache) pages appear in read_row but are
+    redirected to the garbage page in write_row, so their content is
+    immutable here.
+
+    attn="gather" (the measured baseline): gather the slot's logical view
+    from the pool, run the identical chunk forward, scatter the view back
+    through ``write_row``. attn="reference"/"pallas": the in-place lane —
+    chunk k/v written straight into their pages, attention through the
+    page table (see ``_paged_forward_inplace``).
 
     Caller contract (scheduler-enforced): every page covering the REAL
     tokens [cursor, cursor + real_len) is allocated and OWNED (write_row
     == read_row there); pad positions beyond real_len may fall on
     unallocated entries — their writes redirect to the garbage page and
     their reads are causally masked. cursor + C fits the logical view."""
+    _check_attn_lane(attn)
+    if attn != "gather":
+        lengths = lax.dynamic_slice(caches[0].lengths, (slot,), (1,))
+        positions = jnp.arange(tokens.shape[1])[None, :] + lengths[:, None]
+        logits, new_caches = _paged_forward_inplace(
+            cfg, params, tokens, positions, lengths, read_row[None],
+            write_row[None], caches, attn,
+            lambda l: l.at[slot].add(real_len))
+        last = lax.dynamic_index_in_dim(logits[0], real_len - 1,
+                                        keepdims=False)
+        return last, new_caches
     T = caches[0].k.shape[1]
     P = read_row.shape[0]
     rows = []
@@ -337,16 +441,30 @@ def paged_prefill_into_slot(cfg: TransformerConfig, params, tokens, real_len,
 
 def paged_decode_step(cfg: TransformerConfig, params, tokens, active,
                       read_tables, write_tables,
-                      caches: List[PagedKVCache]):
+                      caches: List[PagedKVCache], *, attn: str = "gather"):
     """``slot_decode_step`` through page tables: one fixed-shape program
     over the whole arena. tokens/active: [slots] int32; read_tables/
-    write_tables: [slots, P] int32. The per-slot math is the contiguous
-    path's vmapped single-sequence forward over the GATHERED view, so an
-    attended value can never differ from the contiguous arena; the scatter
-    through write_tables persists each slot's view back into the pool
-    (shared + unallocated entries land on the garbage page).
+    write_tables: [slots, P] int32.
+
+    attn="gather" (the measured baseline): the per-slot math is the
+    contiguous path's vmapped single-sequence forward over the GATHERED
+    view, so an attended value can never differ from the contiguous
+    arena; the scatter through write_tables persists each slot's view
+    back into the pool (shared + unallocated entries land on the garbage
+    page). attn="reference"/"pallas": the in-place lane — each layer
+    writes the token's k/v at ``pool[page, offset]`` and attends through
+    the page table, never materializing the view (temperature-0 token
+    parity with the gather lane, asserted in tests/test_paged_attention).
 
     Returns (logits [slots, vocab], caches)."""
+    _check_attn_lane(attn)
+    if attn != "gather":
+        lengths = caches[0].lengths
+        logits, new_caches = _paged_forward_inplace(
+            cfg, params, tokens[:, None], lengths[:, None], lengths,
+            read_tables, write_tables, caches, attn,
+            lambda l: l + active)
+        return logits[:, 0], new_caches
     T = caches[0].k.shape[1]
     slots, P = read_tables.shape
     H, D = caches[0].k.shape[2:]
@@ -386,7 +504,7 @@ def paged_decode_step(cfg: TransformerConfig, params, tokens, active,
 
 def paged_verify_step(cfg: TransformerConfig, params, tokens,
                       read_tables, write_tables,
-                      caches: List[PagedKVCache]):
+                      caches: List[PagedKVCache], *, attn: str = "gather"):
     """Speculative-decoding verify: score K candidate tokens per slot in
     ONE fixed-shape call over the slots axis (ISSUE 18). tokens:
     [slots, K] int32 — each slot's [next_token, d_1..d_{K-1}] placed at
@@ -397,7 +515,10 @@ def paged_verify_step(cfg: TransformerConfig, params, tokens,
     gathered-view forward as the decode step with a K-token window —
     mask_bias always spans the full fixed view width, so per-query
     reduction order (and therefore every attended value) is bit-identical
-    to K sequential single-token steps.
+    to K sequential single-token steps. The in-place lanes
+    (attn="reference"/"pallas") keep that property within themselves: each
+    query row reduces over pages in ascending order under a full-width
+    mask, exactly the reduction a K=1 in-place decode performs.
 
     Slot cursors are NOT advanced here: acceptance length is a host-side
     decision (accept-prefix + corrected resample), applied afterwards via
@@ -409,6 +530,15 @@ def paged_verify_step(cfg: TransformerConfig, params, tokens,
     can never scribble on prefix-cache pages.
 
     Returns (logits [slots, K, vocab], caches)."""
+    _check_attn_lane(attn)
+    if attn != "gather":
+        K = tokens.shape[1]
+        lengths = caches[0].lengths
+        positions = lengths[:, None] + jnp.arange(K, dtype=jnp.int32)[None]
+        logits, new_caches = _paged_forward_inplace(
+            cfg, params, tokens, positions, lengths,
+            read_tables, write_tables, caches, attn, lambda l: l)
+        return logits, new_caches
     T = caches[0].k.shape[1]
     slots, P = read_tables.shape
     H, D = caches[0].k.shape[2:]

@@ -1,5 +1,11 @@
 """Attention dispatcher: picks the Pallas flash kernel on TPU (or when forced),
-the XLA reference otherwise. Single entry point for all models."""
+the XLA reference otherwise. Single entry point for all models.
+
+Also home of the PAGED-attention lane resolver (ISSUE 20): the serve
+scheduler's decode/verify/prefill programs pick between the in-place paged
+lanes (``ops.paged_attention``) and the measured-baseline gathered-view
+path via ``RAY_TPU_SERVE_PAGED_ATTN`` — resolved here so every consumer
+rejects unknown/falsy values identically and loudly."""
 
 from __future__ import annotations
 
@@ -9,6 +15,15 @@ from typing import Optional
 import jax
 
 from ray_tpu.ops.flash_attention import flash_attention, reference_attention
+
+ATTN_IMPLS = ("auto", "flash", "reference")
+
+# "auto" -> the Pallas paged kernel on TPU, the pure-JAX in-place reference
+# elsewhere; "gather" keeps the original gathered-view programs (the
+# measured baseline — selectable like collective_algo="kv", never a silent
+# fallback). Resolution happens ONCE at scheduler build, so stats() always
+# names the real lane.
+PAGED_ATTN_CHOICES = ("auto", "pallas", "reference", "gather")
 
 
 def attention(q, k, v, *, causal: bool = True, sm_scale: Optional[float] = None,
@@ -20,6 +35,12 @@ def attention(q, k, v, *, causal: bool = True, sm_scale: Optional[float] = None,
     and the XLA reference elsewhere (the kernel still runs everywhere via
     interpret mode when explicitly selected, which is how CPU tests cover it).
     """
+    if impl not in ATTN_IMPLS:
+        # a typo must not silently fall through to the reference path —
+        # the caller believes it selected a kernel
+        raise ValueError(
+            f"unknown attention impl {impl!r}; expected one of "
+            f"{list(ATTN_IMPLS)}")
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     if bias is not None:
@@ -29,3 +50,26 @@ def attention(q, k, v, *, causal: bool = True, sm_scale: Optional[float] = None,
     if impl == "flash":
         return flash_attention(q, k, v, sm_scale, causal)
     return reference_attention(q, k, v, sm_scale, causal, bias=bias)
+
+
+def resolve_paged_attn_lane(choice: Optional[str] = None) -> str:
+    """Resolve the serve paged-attention lane to a concrete program lane.
+
+    choice=None reads the ``serve_paged_attn`` config flag
+    (``RAY_TPU_SERVE_PAGED_ATTN``). Unknown values — including explicit
+    falsy spellings like "0"/"" — are rejected loudly (the falsy-zero
+    lesson: 0 never silently means a default lane). Returns one of
+    'pallas' | 'reference' | 'gather'.
+    """
+    if choice is None:
+        from ray_tpu._private.config import global_config
+
+        choice = global_config().serve_paged_attn
+    if choice not in PAGED_ATTN_CHOICES:
+        raise ValueError(
+            f"unknown paged attention lane {choice!r} (serve_paged_attn / "
+            f"RAY_TPU_SERVE_PAGED_ATTN); expected one of "
+            f"{list(PAGED_ATTN_CHOICES)}")
+    if choice == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "reference"
+    return choice

@@ -38,6 +38,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ray_tpu.ops._pallas import should_interpret
+
 NEG_INF = -1e30
 _LANES = 128
 
@@ -391,7 +393,7 @@ def flash_attention(q, k, v, sm_scale=None, causal=True,
 def _fwd_rule(q, k, v, sm_scale, causal, block_q, block_k, block_h=None):
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    interpret = jax.default_backend() != "tpu"
+    interpret = should_interpret()
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
@@ -404,7 +406,7 @@ def _bwd_rule(sm_scale, causal, block_q, block_k, block_h, res, g):
     qt, kt, vt, ot, lse = res
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(qt.shape[-1])
-    interpret = jax.default_backend() != "tpu"
+    interpret = should_interpret()
     dot = g.transpose(0, 2, 1, 3)
     dq, dk, dv = _flash_bwd(qt, kt, vt, ot, lse, dot, sm_scale, causal,
                             block_q, block_k, block_h, interpret)

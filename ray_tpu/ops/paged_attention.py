@@ -1,0 +1,227 @@
+"""Paged attention: flash-style online-softmax THROUGH the page table.
+
+The paged KV arena (models/decode.py, ISSUE 13) stores each layer's cache as
+a pool ``[num_pages, page_tokens, Hkv, D]`` plus per-slot page tables. The
+original decode/verify programs materialize every slot's full logical
+``[pages_per_slot * page_tokens]`` view with a gather before attending — an
+O(arena_len)·layers·slots copy per single-token step, so decode cost scales
+with pool PROVISIONING rather than the tokens actually attended. This module
+computes attention directly against the pool:
+
+  * ``paged_attention(..., impl='pallas')`` — a Pallas TPU kernel, one grid
+    cell per (slot, kv-head). The page table and slot lengths ride in as
+    scalar-prefetch operands (SMEM), the K/V pools stay in HBM
+    (``memory_space=ANY``), and the kernel async-copies ONE page at a time
+    into VMEM scratch — only ``ceil((length+K)/page_tokens)`` pages per slot,
+    a dynamic trip count. No contiguous view ever exists.
+  * ``paged_attention(..., impl='reference')`` — pure JAX with IDENTICAL
+    math (same page order, same online-softmax update, same -1e30 mask):
+    one fori_loop over pages, trip count = the batch max of allocated
+    pages. This is the parity oracle for the kernel and the production
+    lane off-TPU.
+
+Mask semantics match ``LayerKVCache.mask_bias``: query row ``i`` of slot
+``s`` sits at logical position ``lengths[s] + i`` and may attend logical
+position ``j`` iff ``j <= lengths[s] + i``. Page-table entries past a slot's
+allocation point at the reserved garbage page 0; every position they cover
+is ``> lengths[s] + i``, so the mask zeroes them EXACTLY (exp(-1e30 - m)
+underflows to 0.0f) — garbage content can never leak into an attended
+value, and masked pages contribute bit-exact zeros to the online
+accumulator (the same invariant the gathered-view lane relies on).
+
+Decode is the K=1 case; the fixed-K verify window shares the same kernel —
+each query row reduces over pages in ascending order with a full-width
+mask, so per-row reduction order matches K sequential decode steps.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ray_tpu.ops._pallas import should_interpret
+
+NEG_INF = -1e30
+
+PAGED_ATTN_IMPLS = ("pallas", "reference")
+
+
+def paged_attention(q, k_pool, v_pool, tables, lengths, *,
+                    sm_scale: Optional[float] = None, impl: str = "reference"):
+    """Attention for q at positions [lengths[s], lengths[s] + K) of each slot.
+
+    q: [S, K, H, D] queries (K = 1 decode, K > 1 verify/prefill window).
+    k_pool/v_pool: [N, T, Hkv, D] page pools (page 0 = garbage page).
+    tables: [S, P] int32 page tables; lengths: [S] int32 slot cursors.
+    Returns [S, K, H, D] in q.dtype.
+
+    The new tokens' k/v must already be WRITTEN into their pages (write-
+    before-attend, the arena's standing invariant) — this op only reads.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if impl not in PAGED_ATTN_IMPLS:
+        raise ValueError(
+            f"unknown paged attention impl {impl!r}; expected one of "
+            f"{list(PAGED_ATTN_IMPLS)} (the 'gather' lane is not an op — "
+            "models/decode.py dispatches it before reaching here)")
+    if q.shape[0] != tables.shape[0] or q.shape[0] != lengths.shape[0]:
+        raise ValueError(
+            f"slot axis mismatch: q {q.shape}, tables {tables.shape}, "
+            f"lengths {lengths.shape}")
+    if q.shape[3] != k_pool.shape[3] or q.shape[2] % k_pool.shape[2] != 0:
+        raise ValueError(
+            f"head mismatch: q {q.shape} vs pool {k_pool.shape} "
+            "(H must be a multiple of Hkv, D must match)")
+    if impl == "pallas":
+        return _paged_attention_pallas(q, k_pool, v_pool, tables, lengths,
+                                       sm_scale)
+    return _paged_attention_reference(q, k_pool, v_pool, tables, lengths,
+                                      sm_scale)
+
+
+# ------------------------------------------------------------- reference
+
+
+def _paged_attention_reference(q, k_pool, v_pool, tables, lengths, sm_scale):
+    """Pure-JAX twin of the kernel: one fori_loop over pages, all slots
+    batched per iteration. Trip count is the BATCH MAX of pages any slot
+    needs — pages past a slot's own need hit its garbage-page table tail
+    and contribute exact zeros, so each slot's result is bit-identical to
+    looping only its own pages."""
+    S, K, H, D = q.shape
+    N, T, Hkv, _ = k_pool.shape
+    P = tables.shape[1]
+    G = H // Hkv
+    # [S, K, Hkv, G, D] f32 — kv-head-major grouping, like the flash kernel
+    qf = q.reshape(S, K, Hkv, G, D).astype(jnp.float32)
+    qpos = lengths[:, None] + jnp.arange(K, dtype=jnp.int32)[None, :]  # [S,K]
+    n_pages = lax.div(jnp.max(lengths) + K + T - 1, jnp.int32(T))
+    n_pages = jnp.minimum(n_pages, jnp.int32(P))
+
+    def body(p, carry):
+        m, l, acc = carry
+        pids = lax.dynamic_index_in_dim(tables, p, axis=1, keepdims=False)
+        kpg = k_pool[pids].astype(jnp.float32)   # [S, T, Hkv, D]
+        vpg = v_pool[pids].astype(jnp.float32)
+        s_ = jnp.einsum("skhgd,sthd->skhgt", qf, kpg) * sm_scale
+        kpos = p * T + jnp.arange(T, dtype=jnp.int32)            # [T]
+        allowed = kpos[None, None, :] <= qpos[:, :, None]        # [S, K, T]
+        s_ = jnp.where(allowed[:, :, None, None, :], s_, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s_, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        pr = jnp.exp(s_ - m_new[..., None])
+        l_new = l * alpha + jnp.sum(pr, axis=-1)
+        acc_new = (acc * alpha[..., None]
+                   + jnp.einsum("skhgt,sthd->skhgd", pr, vpg))
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((S, K, Hkv, G), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((S, K, Hkv, G), jnp.float32)
+    a0 = jnp.zeros((S, K, Hkv, G, D), jnp.float32)
+    _, l, acc = lax.fori_loop(0, n_pages, body, (m0, l0, a0))
+    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked row (can't happen: j=0
+    #                                  is always allowed) -> 0, not NaN
+    out = acc / l[..., None]
+    return out.reshape(S, K, H, D).astype(q.dtype)
+
+
+# ---------------------------------------------------------------- kernel
+
+
+def _paged_kernel(lengths_ref, tables_ref,          # scalar prefetch (SMEM)
+                  q_ref,                            # [1, 1, K*G, D] VMEM
+                  k_pool_ref, v_pool_ref,           # [N, T, Hkv, D] HBM/ANY
+                  o_ref,                            # [1, 1, K*G, D] VMEM
+                  k_scr, v_scr, sem_k, sem_v,       # [T, D] VMEM + DMA sems
+                  *, page_tokens, qk, group, sm_scale):
+    s = pl.program_id(0)
+    h = pl.program_id(1)
+    T = page_tokens
+    length = lengths_ref[s]
+    n_pages = lax.div(length + qk + T - 1, jnp.int32(T))
+    q = q_ref[0, 0].astype(jnp.float32)             # [K*G, D]
+    # row r = i * group + g is query token i: position length + i
+    row_pos = length + lax.broadcasted_iota(jnp.int32, (qk * group, 1),
+                                            0) // group
+
+    def body(p, carry):
+        m, l, acc = carry
+        pid = tables_ref[s, p]
+        cp_k = pltpu.make_async_copy(k_pool_ref.at[pid, :, h, :], k_scr,
+                                     sem_k)
+        cp_v = pltpu.make_async_copy(v_pool_ref.at[pid, :, h, :], v_scr,
+                                     sem_v)
+        cp_k.start()
+        cp_v.start()
+        cp_k.wait()
+        cp_v.wait()
+        kpg = k_scr[...].astype(jnp.float32)        # [T, D]
+        vpg = v_scr[...].astype(jnp.float32)
+        s_ = jax.lax.dot_general(q, kpg, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        s_ = s_ * sm_scale                          # [K*G, T]
+        kpos = p * T + lax.broadcasted_iota(jnp.int32, (1, T), 1)
+        s_ = jnp.where(kpos <= row_pos, s_, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s_, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        pr = jnp.exp(s_ - m_new)
+        l_new = l * alpha + jnp.sum(pr, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            pr, vpg, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    D = q.shape[-1]
+    m0 = jnp.full((qk * group, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((qk * group, 1), jnp.float32)
+    a0 = jnp.zeros((qk * group, D), jnp.float32)
+    _, l, acc = lax.fori_loop(0, n_pages, body, (m0, l0, a0))
+    l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+
+
+def _paged_attention_pallas(q, k_pool, v_pool, tables, lengths, sm_scale):
+    S, K, H, D = q.shape
+    N, T, Hkv, _ = k_pool.shape
+    G = H // Hkv
+    # kv-head-major rows: [S, Hkv, K*G, D]; row i*G+g = (token i, group g)
+    qr = q.reshape(S, K, Hkv, G, D).transpose(0, 2, 1, 3, 4)
+    qr = qr.reshape(S, Hkv, K * G, D)
+    kernel = functools.partial(_paged_kernel, page_tokens=T, qk=K, group=G,
+                               sm_scale=sm_scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, Hkv),
+        in_specs=[
+            pl.BlockSpec((1, 1, K * G, D), lambda s, h, *_: (s, h, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, 1, K * G, D),
+                               lambda s, h, *_: (s, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((T, D), k_pool.dtype),
+            pltpu.VMEM((T, D), v_pool.dtype),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, Hkv, K * G, D), q.dtype),
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=should_interpret(),
+    )(lengths.astype(jnp.int32), tables.astype(jnp.int32),
+      qr, k_pool, v_pool)
+    out = out.reshape(S, Hkv, K, G, D).transpose(0, 2, 1, 3, 4)
+    return out.reshape(S, K, H, D)

@@ -82,6 +82,7 @@ _F_DECODE = flight.intern("serve.decode")
 _F_RETIRE = flight.intern("serve.retire")
 _F_VERIFY = flight.intern("serve.verify")
 _F_MIGRATE = flight.intern("serve.migrate")
+_F_ATTN = flight.intern("serve.attn")
 
 _m_steps = Counter(
     "ray_tpu_serve_decode_steps_total",
@@ -101,6 +102,12 @@ _m_retired = Counter(
 _m_active = Gauge(
     "ray_tpu_serve_slots_active",
     "KV arena slots currently holding a live sequence")
+_m_attn_bytes = Counter(
+    "ray_tpu_serve_attn_bytes_moved_total",
+    "KV-cache bytes the paged attention lane streamed per program call "
+    "(host-side mirror arithmetic, labelled by lane: the gather lane "
+    "materializes the full provisioned arena, the in-place lanes only "
+    "pages covering live tokens)")
 _m_queue_depth = Gauge(
     "ray_tpu_serve_queue_depth",
     "Requests waiting for a free KV arena slot")
@@ -178,7 +185,8 @@ class ContinuousScheduler:
                  prefix_cache: Optional[bool] = None,
                  drafter=None,
                  spec_k: Optional[int] = None,
-                 migration_budget: Optional[int] = None):
+                 migration_budget: Optional[int] = None,
+                 attn: Optional[str] = None):
         import numpy as np
         import jax
 
@@ -257,18 +265,36 @@ class ContinuousScheduler:
                 (self.slots, self._pages_per_slot), np.int32)
             self._write_tables = np.zeros(
                 (self.slots, self._pages_per_slot), np.int32)
+            from ray_tpu.ops.attention import resolve_paged_attn_lane
+
+            # the attention lane resolves ONCE at build — a typo'd
+            # RAY_TPU_SERVE_PAGED_ATTN fails the constructor, not some
+            # later decode step, and stats() always names the real lane
+            self.attn_lane = resolve_paged_attn_lane(
+                conf.serve_paged_attn if attn is None else attn)
             # donated caches: the pool mutates in place across iterations;
             # the tables are tiny per-call host->device uploads
-            self._prefill = jax.jit(partial(paged_prefill_into_slot, cfg),
-                                    donate_argnums=(6,))
-            self._step = jax.jit(partial(paged_decode_step, cfg),
-                                 donate_argnums=(5,))
+            self._prefill = jax.jit(
+                partial(paged_prefill_into_slot, cfg, attn=self.attn_lane),
+                donate_argnums=(6,))
+            self._step = jax.jit(
+                partial(paged_decode_step, cfg, attn=self.attn_lane),
+                donate_argnums=(5,))
             self._caches = init_paged_caches(
                 cfg, self.slots, self.num_pages, self.page_tokens,
                 self._pages_per_slot, cache_dtype)
+            self._kv_itemsize = int(self._caches[0].k.dtype.itemsize)
         else:
             from ray_tpu._private.config import env_flag_explicit
 
+            if attn is not None:
+                # the lane picks between paged attention programs; the
+                # contiguous arena has no page tables to attend through,
+                # so an explicit lane request here is a configuration bug
+                raise ValueError(
+                    "attn lane selection requires kv_layout='paged' "
+                    "(the contiguous arena has no page tables)")
+            self.attn_lane = None
             env_on = env_flag_explicit("serve_prefix_cache")
             if prefix_cache or (prefix_cache is None and env_on):
                 # explicit intent conflicts loudly. "Explicit" means the
@@ -317,8 +343,9 @@ class ContinuousScheduler:
                     f"{self.slots} — they must share the slot numbering")
             from ray_tpu.models.decode import paged_verify_step
 
-            self._verify = jax.jit(partial(paged_verify_step, cfg),
-                                   donate_argnums=(4,))
+            self._verify = jax.jit(
+                partial(paged_verify_step, cfg, attn=self.attn_lane),
+                donate_argnums=(4,))
         # ---- cross-replica page migration (ISSUE 18): a dedicated
         # worker thread does the blocking peer pull; the scheduler thread
         # only splices finished results between iterations. _commands
@@ -349,6 +376,7 @@ class ContinuousScheduler:
         self._n_admitted = 0
         self._n_retired = 0
         self._n_tokens = 0
+        self._n_attn_bytes = 0
         self._n_prefix_hit_tokens = 0
         self._admitted_mid_flight = 0
         self._max_active_slots = 0
@@ -620,6 +648,35 @@ class ContinuousScheduler:
                 # an admission while other sequences are mid-generation
                 self._admitted_mid_flight += 1
 
+    def _record_attn(self, t0: int, qk: int, n_slots: int,
+                     longest: Optional[int] = None) -> None:
+        """Stamp the ``serve.attn`` span and account the KV bytes the
+        attention lane streamed for one attention-bearing program call.
+        Pure host-side mirror arithmetic (cursors, table shapes) — no
+        device readback on the hot loop. The gather lane materializes a
+        contiguous ``[pages_per_slot * page_tokens]`` view per slot per
+        layer regardless of how little of it is live; the in-place lanes
+        stream only pages covering the longest live sequence."""
+        if not self._paged:
+            return
+        flight.span_since(_F_ATTN, t0)
+        cfg = self.cfg
+        T = self.page_tokens
+        row = cfg.kv_heads * cfg.head_dim * self._kv_itemsize
+        if self.attn_lane == "gather":
+            pages = n_slots * self._pages_per_slot
+        else:
+            if longest is None:
+                longest = max((s.cursor for s in self._slot_seqs
+                               if s is not None), default=0)
+            pages = n_slots * min(-(-(longest + qk) // T),
+                                  self._pages_per_slot)
+        # k + v pools, every layer: pages read through the table plus the
+        # qk freshly-written rows per slot
+        moved = 2 * cfg.num_layers * row * (pages * T + n_slots * qk)
+        self._n_attn_bytes += moved
+        _m_attn_bytes.inc(moved, labels={"lane": self.attn_lane})
+
     def _prefill_one(self) -> bool:
         """Advance ONE prefilling sequence by one chunk, round-robin over
         slots — concurrent prompts interleave their chunks, so one long
@@ -672,6 +729,9 @@ class ContinuousScheduler:
                 # sync from the np.asarray below)
                 jax.block_until_ready(logits)
             flight.span_since(_F_PREFILL, t0)
+            if self._paged:
+                self._record_attn(t0, self.prefill_chunk, 1,
+                                  longest=seq.cursor - real)
             self._n_prefill_chunks += 1
             _m_prefill_chunks.inc()
             if self._paged and self._radix is not None \
@@ -1049,6 +1109,7 @@ class ContinuousScheduler:
             jnp.asarray(self._write_tables), self._caches)
         va = np.asarray(vlogits)
         flight.span_since(_F_VERIFY, t0)
+        self._record_attn(t0, K, self.slots)
         self._n_steps += 1
         _m_steps.inc()
         self._n_spec_rounds += 1
@@ -1135,6 +1196,7 @@ class ContinuousScheduler:
                 self._caches)
         la = np.asarray(logits)
         flight.span_since(_F_DECODE, t0)
+        self._record_attn(t0, 1, self.slots)
         self._n_steps += 1
         _m_steps.inc()
         self._max_active_slots = max(self._max_active_slots, len(live))
@@ -1278,6 +1340,8 @@ class ContinuousScheduler:
         if self._paged:
             out["page_tokens"] = self.page_tokens
             out["pages_per_slot"] = self._pages_per_slot
+            out["attn_lane"] = self.attn_lane
+            out["attn_bytes_moved"] = self._n_attn_bytes
             out.update(self._arena.stats())
             if self._radix is not None:
                 out.update(self._radix.stats())

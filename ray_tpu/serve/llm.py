@@ -81,7 +81,8 @@ class LLMServerImpl:
                  eos_id: Optional[int] = None,
                  drafter: Optional[str] = None,
                  spec_k: Optional[int] = None,
-                 migration_budget: Optional[int] = None):
+                 migration_budget: Optional[int] = None,
+                 attn: Optional[str] = None):
         import jax
         import jax.numpy as jnp
 
@@ -174,11 +175,16 @@ class LLMServerImpl:
                 eos_id=eos_id, kv_layout=kv_layout,
                 page_tokens=page_tokens, kv_pages=kv_pages,
                 prefix_cache=prefix_cache, drafter=drafter_obj,
-                spec_k=spec_k, migration_budget=migration_budget)
+                spec_k=spec_k, migration_budget=migration_budget,
+                attn=attn)
         elif drafter:
             raise ValueError(
                 "speculative decoding (drafter=...) requires "
                 "scheduler='continuous'")
+        elif attn is not None:
+            raise ValueError(
+                "attn lane selection (attn=...) requires "
+                "scheduler='continuous' with kv_layout='paged'")
 
     def _build_drafter(self, drafter: Optional[str], slots, arena_len,
                        _weights):

@@ -1,0 +1,446 @@
+"""Paged attention lanes (ISSUE 20): gather-free decode/verify that reads
+KV pages in place.
+
+Covers the op-level contracts of ``ops.paged_attention`` (the pure-JAX
+reference against a full-softmax gathered-view oracle; the Pallas kernel —
+interpret mode on CPU — bitwise against the reference; garbage-page
+redirects, shared prefix pages, length-0 and page-boundary edges), the
+in-place model lanes in ``models.decode`` (temperature-0 token parity of
+the ``attn="reference"``/``"pallas"`` lanes against the measured-baseline
+``"gather"`` lane across prefill/decode/verify), the lane dispatcher
+(unknown/falsy spellings rejected loudly at every layer, satellite: the
+``ops.attention`` impl typo guard), and the scheduler end to end (token
+streams identical across lanes under mixed lengths, slot reuse and prefix
+hits; spec-decode acceptance unchanged; the two-compiles contract with the
+in-place lane on; ``attn_bytes_moved`` showing the gather lane's
+provisioning-proportional traffic).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+SLOTS = 4
+CHUNK = 8
+NEW = 6
+PAGE = 4
+
+PROMPTS = ["hi", "hello 123", "a much longer prompt than the others!"]
+
+
+# --------------------------------------------------------------- op level
+
+
+def _mk_pools(rng, S, K, H, Hkv, D, T, P, lengths, garbage_fill=0.0):
+    """Random pools + per-slot tables covering ``lengths[s] + K`` tokens;
+    table entries past a slot's need point at the garbage page 0, whose
+    content is ``garbage_fill`` (non-zero proves redirects can't leak)."""
+    need = [min(P, -(-(int(L) + K) // T)) for L in lengths]
+    N = sum(need) + 1
+    kp = rng.standard_normal((N, T, Hkv, D)).astype(np.float32)
+    vp = rng.standard_normal((N, T, Hkv, D)).astype(np.float32)
+    kp[0] = garbage_fill
+    vp[0] = garbage_fill
+    tables = np.zeros((S, P), np.int32)
+    pid = 1
+    for s in range(S):
+        for j in range(need[s]):
+            tables[s, j] = pid
+            pid += 1
+    q = rng.standard_normal((S, K, H, D)).astype(np.float32)
+    return (jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(tables), jnp.asarray(np.asarray(lengths, np.int32)))
+
+
+def _full_softmax_oracle(q, kp, vp, tables, lengths):
+    """The gathered-view answer: materialize each slot's contiguous
+    logical view and run a plain masked softmax — the semantics the
+    in-place lanes must reproduce without ever building the view."""
+    q, kp, vp = np.asarray(q), np.asarray(kp), np.asarray(vp)
+    tables, lengths = np.asarray(tables), np.asarray(lengths)
+    S, K, H, D = q.shape
+    N, T, Hkv, _ = kp.shape
+    P = tables.shape[1]
+    G = H // Hkv
+    sm = 1.0 / np.sqrt(D)
+    out = np.zeros_like(q)
+    for s in range(S):
+        kv = kp[tables[s]].reshape(P * T, Hkv, D)
+        vv = vp[tables[s]].reshape(P * T, Hkv, D)
+        for i in range(K):
+            qpos = lengths[s] + i
+            for h in range(H):
+                scores = kv[:, h // G] @ q[s, i, h] * sm
+                scores[np.arange(P * T) > qpos] = -np.inf
+                w = np.exp(scores - scores.max())
+                w /= w.sum()
+                out[s, i, h] = w @ vv[:, h // G]
+    return out
+
+
+class TestPagedAttentionOp:
+    def test_reference_matches_full_softmax_oracle(self):
+        """Mixed lengths — including 0 and an exact page-boundary multiple
+        — for both the decode (K=1) and verify (K=3) windows, with the
+        garbage page stuffed with huge values: the online-softmax
+        page-streaming reference must equal the materialized-view
+        softmax."""
+        from ray_tpu.ops.paged_attention import paged_attention
+
+        rng = np.random.default_rng(0)
+        for K in (1, 3):
+            lengths = [0, 5, 8, 13]  # 8 = exactly two full pages (T=4)
+            args = _mk_pools(rng, S=4, K=K, H=4, Hkv=2, D=8, T=4, P=6,
+                             lengths=lengths, garbage_fill=1e4)
+            got = np.asarray(paged_attention(*args, impl="reference"))
+            want = _full_softmax_oracle(*args)
+            np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+    def test_pallas_interpret_bitwise_equals_reference(self):
+        """The kernel (interpret mode on CPU) and the pure-JAX reference
+        share page order, mask constant and online-softmax update — their
+        outputs must match BITWISE, not just to tolerance."""
+        from ray_tpu.ops.paged_attention import paged_attention
+
+        rng = np.random.default_rng(1)
+        for K in (1, 4):
+            args = _mk_pools(rng, S=3, K=K, H=4, Hkv=2, D=8, T=4, P=8,
+                             lengths=[0, 7, 16], garbage_fill=123.0)
+            ref = np.asarray(paged_attention(*args, impl="reference"))
+            pal = np.asarray(paged_attention(*args, impl="pallas"))
+            assert np.array_equal(ref, pal), \
+                f"pallas diverged from reference (max |d| = " \
+                f"{np.abs(ref - pal).max()})"
+
+    def test_shared_prefix_pages_between_slots(self):
+        """Two slots whose tables point at the SAME physical pages (a
+        radix prefix hit) with equal cursors must produce identical rows —
+        paging relocates bytes, never values."""
+        from ray_tpu.ops.paged_attention import paged_attention
+
+        rng = np.random.default_rng(2)
+        q, kp, vp, tables, lengths = _mk_pools(
+            rng, S=2, K=1, H=4, Hkv=2, D=8, T=4, P=4, lengths=[9, 9])
+        q = jnp.concatenate([q[:1], q[:1]])          # same query both slots
+        tables = jnp.concatenate([tables[:1], tables[:1]])  # shared pages
+        for impl in ("reference", "pallas"):
+            out = np.asarray(paged_attention(q, kp, vp, tables, lengths,
+                                             impl=impl))
+            assert np.array_equal(out[0], out[1])
+
+    def test_garbage_page_content_never_leaks(self):
+        """Masked pages must contribute bit-exact zeros to the online
+        accumulator: stuffing the garbage page with huge values cannot
+        change a single output bit."""
+        from ray_tpu.ops.paged_attention import paged_attention
+
+        for impl in ("reference", "pallas"):
+            outs = []
+            for fill in (0.0, 1e4):
+                rng = np.random.default_rng(3)  # same content both times
+                args = _mk_pools(rng, S=3, K=2, H=4, Hkv=2, D=8, T=4, P=8,
+                                 lengths=[2, 6, 11], garbage_fill=fill)
+                outs.append(np.asarray(paged_attention(*args, impl=impl)))
+            assert np.array_equal(outs[0], outs[1]), impl
+
+    def test_length_zero_attends_only_the_new_token(self):
+        """Cursor 0, K=1: the only legal position is the just-written
+        token itself, so the output IS its value row, exactly (a
+        single-position softmax has weight 1.0)."""
+        from ray_tpu.ops.paged_attention import paged_attention
+
+        rng = np.random.default_rng(4)
+        q, kp, vp, tables, lengths = _mk_pools(
+            rng, S=1, K=1, H=4, Hkv=2, D=8, T=4, P=4, lengths=[0])
+        for impl in ("reference", "pallas"):
+            out = np.asarray(paged_attention(q, kp, vp, tables, lengths,
+                                             impl=impl))
+            want = np.asarray(vp)[np.asarray(tables)[0, 0], 0]  # [Hkv, D]
+            for h in range(4):
+                assert np.array_equal(out[0, 0, h], want[h // 2])
+
+    def test_unknown_impl_and_shape_mismatches_rejected(self):
+        from ray_tpu.ops.paged_attention import paged_attention
+
+        rng = np.random.default_rng(5)
+        q, kp, vp, tables, lengths = _mk_pools(
+            rng, S=2, K=1, H=4, Hkv=2, D=8, T=4, P=4, lengths=[3, 3])
+        # 'gather' is a models/decode.py lane, not an op impl — the error
+        # must say so instead of silently running the reference
+        with pytest.raises(ValueError, match="gather"):
+            paged_attention(q, kp, vp, tables, lengths, impl="gather")
+        with pytest.raises(ValueError, match="slot axis"):
+            paged_attention(q[:1], kp, vp, tables, lengths)
+        with pytest.raises(ValueError, match="head"):
+            paged_attention(q[:, :, :3], kp, vp, tables, lengths)
+
+
+# -------------------------------------------------------------- model lanes
+
+
+def _tiny_cfg():
+    from ray_tpu.models.transformer import TransformerConfig
+
+    return TransformerConfig(vocab_size=64, num_layers=2, embed_dim=32,
+                             num_heads=4, num_kv_heads=2, mlp_dim=64,
+                             max_seq_len=32, dtype=jnp.float32,
+                             param_dtype=jnp.float32, scan_layers=False,
+                             remat=False)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    from ray_tpu.models.transformer import init_params
+
+    cfg = _tiny_cfg()
+    return cfg, init_params(cfg, jax.random.PRNGKey(0))
+
+
+def _arena(cfg, S, T, P):
+    from ray_tpu.models.decode import init_paged_caches
+
+    caches = init_paged_caches(cfg, S, S * P + 1, T, P, jnp.float32)
+    tables = np.zeros((S, P), np.int32)
+    pid = 1
+    for s in range(S):
+        for j in range(P):
+            tables[s, j] = pid
+            pid += 1
+    return caches, jnp.asarray(tables)
+
+
+def _drive_lane(cfg, params, attn, prompts, new_tokens, T=4, P=8):
+    """Prefill mixed-length prompts into slots, then greedy-decode
+    ``new_tokens`` steps. Returns (tokens per slot, stacked logits)."""
+    from functools import partial
+
+    from ray_tpu.models.decode import (paged_decode_step,
+                                       paged_prefill_into_slot)
+
+    S = len(prompts)
+    caches, tables = _arena(cfg, S, T, P)
+    prefill = jax.jit(partial(paged_prefill_into_slot, cfg, attn=attn),
+                      static_argnames=())
+    step = jax.jit(partial(paged_decode_step, cfg, attn=attn))
+    next_tok = []
+    for s, ids in enumerate(prompts):
+        padded = list(ids) + [0] * (CHUNK - len(ids))
+        last, caches = prefill(params, jnp.asarray([padded], jnp.int32),
+                               np.int32(len(ids)), np.int32(s),
+                               tables[s], tables[s], caches)
+        next_tok.append(int(np.asarray(last).argmax()))
+    toks, active = np.asarray(next_tok, np.int32), np.ones(S, np.int32)
+    out = [[t] for t in next_tok]
+    traces = []
+    for _ in range(new_tokens):
+        logits, caches = step(params, jnp.asarray(toks),
+                              jnp.asarray(active), tables, tables, caches)
+        la = np.asarray(logits)
+        traces.append(la)
+        toks = la.argmax(-1).astype(np.int32)
+        for s in range(S):
+            out[s].append(int(toks[s]))
+    return out, np.stack(traces), caches, tables
+
+
+class TestInPlaceLanes:
+    PROMPT_IDS = [[1, 2, 3], [4, 5, 6, 7], [8] * 8, [9, 10, 11, 12, 13]]
+
+    def test_decode_token_parity_and_pallas_bitwise(self, tiny_model):
+        """Temperature-0 token streams must be identical across all three
+        lanes under mixed prompt lengths (one exactly page-aligned), and
+        the pallas lane's logits must equal the reference lane's BITWISE
+        at every step."""
+        cfg, params = tiny_model
+        gather, _, _, _ = _drive_lane(cfg, params, "gather",
+                                      self.PROMPT_IDS, NEW)
+        ref, ref_tr, _, _ = _drive_lane(cfg, params, "reference",
+                                        self.PROMPT_IDS, NEW)
+        pal, pal_tr, _, _ = _drive_lane(cfg, params, "pallas",
+                                        self.PROMPT_IDS, NEW)
+        assert ref == gather, "in-place lane token stream diverged"
+        assert pal == gather
+        assert np.array_equal(ref_tr, pal_tr), \
+            "pallas logits diverged from reference bitwise"
+
+    def test_verify_window_parity(self, tiny_model):
+        """A K=3 verify window after mixed-length prefill: per-position
+        argmax must agree across lanes (so acceptance decisions are
+        unchanged), pallas bitwise equal to reference."""
+        from functools import partial
+
+        from ray_tpu.models.decode import paged_verify_step
+
+        cfg, params = tiny_model
+        outs = {}
+        for attn in ("gather", "reference", "pallas"):
+            toks, _, caches, tables = _drive_lane(
+                cfg, params, attn, self.PROMPT_IDS, 1)
+            vt = np.asarray([[t[-1], 1, 2] for t in toks], np.int32)
+            verify = jax.jit(partial(paged_verify_step, cfg, attn=attn))
+            logits, _ = verify(params, jnp.asarray(vt), tables, tables,
+                               caches)
+            outs[attn] = np.asarray(logits)
+        assert np.array_equal(outs["gather"].argmax(-1),
+                              outs["reference"].argmax(-1))
+        assert np.array_equal(outs["reference"], outs["pallas"])
+
+    def test_unknown_lane_rejected_before_any_math(self):
+        from ray_tpu.models.decode import (paged_decode_step,
+                                           paged_prefill_into_slot,
+                                           paged_verify_step)
+
+        for fn, nargs in ((paged_decode_step, 6),
+                          (paged_verify_step, 5),
+                          (paged_prefill_into_slot, 7)):
+            with pytest.raises(ValueError, match="unknown paged attention"):
+                fn(None, *([None] * nargs), attn="turbo")
+
+
+# ------------------------------------------------------------- dispatchers
+
+
+class TestLaneResolution:
+    def test_attention_impl_typo_rejected(self):
+        """Satellite: a typo'd ``attention(..., impl=)`` must raise with
+        the valid choices, never silently fall through to the reference
+        path."""
+        from ray_tpu.ops.attention import attention
+
+        q = jnp.zeros((1, 2, 2, 4))
+        with pytest.raises(ValueError, match="flash"):
+            attention(q, q, q, impl="flsah")
+        # and a valid impl still runs
+        out = attention(q, q, q, impl="reference")
+        assert out.shape == q.shape
+
+    def test_resolver_choices_and_falsy_rejection(self):
+        from ray_tpu.ops.attention import resolve_paged_attn_lane
+
+        # conftest pins the backend to CPU: auto means the in-place
+        # pure-JAX lane, never a silent gather fallback
+        assert resolve_paged_attn_lane("auto") == "reference"
+        assert resolve_paged_attn_lane("gather") == "gather"
+        assert resolve_paged_attn_lane("pallas") == "pallas"
+        for bad in ("0", "", "off", "turbo"):
+            with pytest.raises(ValueError, match="RAY_TPU_SERVE_PAGED_ATTN"):
+                resolve_paged_attn_lane(bad)
+
+    def test_env_falsy_lane_fails_scheduler_build(self, monkeypatch):
+        """RAY_TPU_SERVE_PAGED_ATTN=0 must fail the CONSTRUCTOR — lane
+        resolution happens once at build, not on some later decode step."""
+        import ray_tpu._private.config as config_mod
+        from ray_tpu._private.config import Config
+        from ray_tpu.serve._private.continuous import ContinuousScheduler
+
+        class _Cfg:  # never reaches jit — validation fires first
+            max_seq_len = 128
+
+        monkeypatch.setenv("RAY_TPU_SERVE_PAGED_ATTN", "0")
+        monkeypatch.setattr(config_mod, "_global_config",
+                            Config.from_env(), raising=False)
+        try:
+            with pytest.raises(ValueError, match="paged attention lane"):
+                ContinuousScheduler(_Cfg(), None)
+        finally:
+            monkeypatch.setattr(config_mod, "_global_config", None,
+                                raising=False)
+
+    def test_attn_requires_paged_layout(self):
+        from ray_tpu.serve._private.continuous import ContinuousScheduler
+
+        class _Cfg:
+            max_seq_len = 128
+
+        with pytest.raises(ValueError, match="paged"):
+            ContinuousScheduler(_Cfg(), None, kv_layout="contiguous",
+                                attn="reference")
+
+    def test_attn_requires_continuous_scheduler(self):
+        from ray_tpu.serve.llm import LLMServerImpl
+
+        with pytest.raises(ValueError, match="continuous"):
+            LLMServerImpl(scheduler="batch", share_weights=False,
+                          attn="reference")
+
+
+# ------------------------------------------------------------- end to end
+
+
+def _sequential_reference(srv, prompt, new_tokens=NEW):
+    from ray_tpu.models.decode import init_caches
+
+    ids = srv._tokenize(prompt)
+    toks = jnp.asarray([ids], jnp.int32)
+    caches = init_caches(srv.cfg, 1, len(ids) + new_tokens)
+    logits, caches = srv._prefill(srv.params, toks, caches)
+    out = []
+    for _ in range(new_tokens):
+        t = int(np.asarray(logits).argmax(-1)[0])
+        out.append(t)
+        logits, caches = srv._decode_step(
+            srv.params, jnp.asarray([[t]], jnp.int32), caches)
+    return srv._detokenize(out)
+
+
+class TestSchedulerLanes:
+    def _drive(self, attn):
+        from ray_tpu.serve.llm import LLMServerImpl
+
+        srv = LLMServerImpl(max_new_tokens=NEW, slots=SLOTS,
+                            prefill_chunk=CHUNK, page_tokens=PAGE,
+                            share_weights=False, attn=attn)
+        try:
+            async def go():
+                reqs = [{"prompt": p} for p in PROMPTS * 3]  # > slots
+                return await asyncio.gather(*[srv(r) for r in reqs])
+
+            outs = asyncio.run(go())
+            return [o["text"] for o in outs], srv.scheduler_stats()
+        finally:
+            srv.shutdown()
+
+    def test_token_streams_identical_across_lanes(self):
+        """The acceptance bar: temperature-0 token streams from the
+        in-place lanes are identical to the gathered-view lane under mixed
+        lengths, slot reuse (3x slots) and prefix hits — and every lane
+        keeps the two-compiles contract. The gather lane's byte accounting
+        must dwarf the in-place lanes' (it materializes the full
+        provisioned view every step)."""
+        texts = {}
+        stats = {}
+        for lane in ("gather", "reference", "pallas"):
+            texts[lane], stats[lane] = self._drive(lane)
+            assert stats[lane]["attn_lane"] == lane
+            assert stats[lane]["compiled_programs"] == 2, stats[lane]
+            assert stats[lane]["prefix_hits"] > 0
+            assert stats[lane]["attn_bytes_moved"] > 0
+        assert texts["reference"] == texts["gather"]
+        assert texts["pallas"] == texts["gather"]
+        assert stats["gather"]["attn_bytes_moved"] > \
+            2 * stats["reference"]["attn_bytes_moved"]
+
+    def test_spec_decode_acceptance_unchanged_on_inplace_lane(self):
+        """Speculative decoding rides the in-place verify lane unchanged:
+        self-drafter at temperature 0 still accepts EVERY draft and the
+        emitted text still equals the sequential greedy reference."""
+        from ray_tpu.serve.llm import LLMServerImpl
+
+        srv = LLMServerImpl(max_new_tokens=NEW, slots=SLOTS,
+                            prefill_chunk=CHUNK, page_tokens=PAGE,
+                            share_weights=False, attn="reference",
+                            drafter="self", spec_k=3)
+        try:
+            ref = _sequential_reference(srv, "hello 123")
+            out = asyncio.run(srv({"prompt": "hello 123"}))
+            assert out["text"] == ref
+            st = srv.scheduler_stats()
+            assert st["attn_lane"] == "reference"
+            assert st["spec_accept_rate"] == 1.0
+            assert st["compiled_programs"] == 2
+        finally:
+            srv.shutdown()
